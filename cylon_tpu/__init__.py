@@ -1,0 +1,80 @@
+"""cylon_tpu — a TPU-native distributed dataframe / relational-algebra engine.
+
+A ground-up rebuild of the capabilities of Cylon (reference:
+``cpp/src/cylon/table.hpp``, ``python/pycylon/frame.py``) designed for
+TPUs: tables live in HBM as struct-of-column device arrays, relational
+kernels are XLA/Pallas programs built around sorts and segment
+reductions (MXU/VPU friendly, static shapes), and distribution is SPMD
+over a ``jax.sharding.Mesh`` with XLA collectives on ICI — replacing
+the reference's MPI/UCX channel + async all-to-all stack
+(``cpp/src/cylon/net/``).
+
+Public surface mirrors PyCylon:
+
+- :class:`cylon_tpu.table.Table` — columnar table (reference
+  ``cpp/src/cylon/table.hpp:46``)
+- :class:`cylon_tpu.context.CylonEnv` — execution context / device mesh
+  (reference ``python/pycylon/frame.py:88``)
+- :class:`cylon_tpu.frame.DataFrame` — pandas-like facade (reference
+  ``python/pycylon/frame.py:183``)
+- ``cylon_tpu.ops`` — local relational kernels (join/groupby/sort/...)
+- ``cylon_tpu.parallel`` — mesh, shuffle, collectives
+"""
+
+import os as _os
+
+import jax as _jax
+
+# Tabular data is int64/float64-shaped (reference benchmarks and the whole
+# pycylon surface assume 64-bit keys); without x64 JAX silently downcasts.
+# Opt out with CYLON_TPU_NO_X64=1 for bf16/int32-only pipelines.
+if not _os.environ.get("CYLON_TPU_NO_X64"):
+    _jax.config.update("jax_enable_x64", True)
+
+from cylon_tpu import dtypes
+from cylon_tpu.column import Column
+from cylon_tpu.config import (
+    CSVReadOptions,
+    CSVWriteOptions,
+    JoinAlgorithm,
+    JoinConfig,
+    JoinType,
+    SortOptions,
+)
+from cylon_tpu.context import CylonEnv, TPUConfig, LocalConfig
+from cylon_tpu.errors import (
+    CylonError,
+    Code,
+    IndexError_,
+    InvalidArgument,
+    KeyError_,
+    NotImplemented_,
+    OutOfCapacity,
+    TypeError_,
+)
+from cylon_tpu.table import Table
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Column",
+    "CSVReadOptions",
+    "CSVWriteOptions",
+    "CylonEnv",
+    "CylonError",
+    "Code",
+    "IndexError_",
+    "InvalidArgument",
+    "JoinAlgorithm",
+    "JoinConfig",
+    "JoinType",
+    "KeyError_",
+    "LocalConfig",
+    "NotImplemented_",
+    "OutOfCapacity",
+    "SortOptions",
+    "Table",
+    "TPUConfig",
+    "TypeError_",
+    "dtypes",
+]

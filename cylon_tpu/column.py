@@ -1,0 +1,165 @@
+"""Device column: a fixed-width JAX array + optional validity + host dictionary.
+
+Parity target: ``cpp/src/cylon/column.hpp:31`` (Column wraps an
+``arrow::ChunkedArray``). TPU-first redesign: a column is a *single*
+contiguous HBM buffer (chunking is an artifact of Arrow's incremental
+builders; XLA wants one static-shape array), nulls are a separate bool
+validity array (like Arrow's validity bitmap, but byte-per-row — TPU has
+no cheap bit addressing and XLA packs bools), and variable-width values
+live host-side in a dictionary with int32 codes on device.
+"""
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cylon_tpu import dtypes
+from cylon_tpu.errors import TypeError_
+
+
+class Dictionary:
+    """Host-side dictionary for STRING/BINARY columns (numpy object array,
+    sorted ascending so device code order == lexicographic value order).
+
+    Hash/eq are by identity: dictionaries ride in pytree aux-data, and jit
+    cache keys only need stability, not deep equality.
+    """
+
+    __slots__ = ("values",)
+
+    def __init__(self, values: np.ndarray):
+        self.values = np.asarray(values, dtype=object)
+
+    def __len__(self):
+        return len(self.values)
+
+    def __repr__(self):
+        return f"Dictionary(n={len(self.values)})"
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class Column:
+    """One named column's device payload.
+
+    data:      [capacity, ...] device array (physical dtype of ``dtype``)
+    validity:  [capacity] bool, True = non-null. None means all-valid.
+    dtype:     logical dtype (aux)
+    dictionary: host dictionary for variable-width types (aux)
+    """
+
+    data: jax.Array
+    validity: Optional[jax.Array] = None
+    dtype: dtypes.DType = dtypes.int64
+    dictionary: Optional[Dictionary] = None
+
+    # -- pytree protocol -------------------------------------------------
+    def tree_flatten(self):
+        return (self.data, self.validity), (self.dtype, self.dictionary)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        data, validity = children
+        dtype, dictionary = aux
+        return cls(data, validity, dtype, dictionary)
+
+    # -- constructors ----------------------------------------------------
+    @staticmethod
+    def from_numpy(arr: np.ndarray, capacity: int | None = None) -> "Column":
+        """Host array -> Column. Dictionary-encodes strings/objects; extracts
+        a validity mask from NaN/None. Pads to ``capacity`` if given."""
+        arr = np.asarray(arr)
+        validity = None
+
+        if arr.dtype.kind in ("U", "S", "O"):
+            import pandas as pd
+
+            # pd.isna handles None / float nan / pd.NA / NaT uniformly
+            isnull = np.array([bool(pd.isna(v)) for v in arr], dtype=bool)
+            filled = np.where(isnull, "", arr.astype(object))
+            uniq, codes = np.unique(filled.astype(object), return_inverse=True)
+            dtype = dtypes.string
+            data = codes.astype(np.int32)
+            if isnull.any():
+                validity = ~isnull
+            return Column._pad(data, validity, dtype, Dictionary(uniq), capacity)
+
+        if arr.dtype.kind in ("M", "m"):
+            dtype = dtypes.from_numpy_dtype(arr.dtype)
+            isnat = np.isnat(arr)
+            data = arr.view(np.int64)
+            if isnat.any():
+                validity = ~isnat
+                data = np.where(isnat, 0, data)
+            return Column._pad(data, validity, dtype, None, capacity)
+
+        dtype = dtypes.from_numpy_dtype(arr.dtype)
+        if arr.dtype.kind == "f":
+            # float NaN stays NaN (pandas semantics); no validity extraction
+            pass
+        return Column._pad(arr, validity, dtype, None, capacity)
+
+    @staticmethod
+    def _pad(data, validity, dtype, dictionary, capacity):
+        n = len(data)
+        cap = n if capacity is None else capacity
+        if cap < n:
+            raise TypeError_(f"capacity {cap} < data length {n}")
+        if cap > n:
+            pad = cap - n
+            data = np.concatenate(
+                [data, np.zeros((pad,) + data.shape[1:], dtype=data.dtype)])
+            if validity is not None:
+                validity = np.concatenate([validity, np.zeros(pad, dtype=bool)])
+        return Column(jnp.asarray(data, dtype=dtype.physical),
+                      None if validity is None else jnp.asarray(validity),
+                      dtype, dictionary)
+
+    # -- accessors -------------------------------------------------------
+    @property
+    def capacity(self) -> int:
+        return self.data.shape[0]
+
+    def to_numpy(self, nrows: int | None = None) -> np.ndarray:
+        """Device -> host, decoding dictionaries and applying validity."""
+        n = self.capacity if nrows is None else nrows
+        data = np.asarray(self.data[:n])
+        if self.dtype.is_dictionary:
+            if self.dictionary is None:
+                raise TypeError_("dictionary column without dictionary")
+            ncodes = len(self.dictionary)
+            safe = np.clip(data, 0, max(ncodes - 1, 0))
+            out = self.dictionary.values[safe] if ncodes else np.full(n, None, object)
+            out = np.asarray(out, dtype=object)
+        elif self.dtype.kind in (dtypes.Kind.TIMESTAMP, dtypes.Kind.DURATION,
+                                 dtypes.Kind.DATE64):
+            unit = self.dtype.unit or "ns"
+            ch = "M" if self.dtype.kind != dtypes.Kind.DURATION else "m"
+            out = data.view(f"{ch}8[{unit}]")
+        else:
+            out = data
+        if self.validity is not None:
+            mask = ~np.asarray(self.validity[:n])
+            if mask.any():
+                if out.dtype.kind == "f":
+                    out = out.copy()
+                    out[mask] = np.nan
+                else:
+                    out = out.astype(object)
+                    out[mask] = None
+        return out
+
+    def astype(self, dtype: dtypes.DType) -> "Column":
+        """Cast (parity: ``table.pyx:2446`` astype)."""
+        if self.dtype.is_dictionary != dtype.is_dictionary:
+            raise TypeError_(
+                "cast between string and non-string requires host round-trip")
+        return Column(self.data.astype(dtype.physical), self.validity, dtype,
+                      self.dictionary if dtype.is_dictionary else None)
+
+    def __repr__(self):
+        return (f"Column({self.dtype!r}, cap={self.capacity}"
+                f"{', nullable' if self.validity is not None else ''})")

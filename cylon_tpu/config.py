@@ -1,0 +1,89 @@
+"""Typed option structs.
+
+Parity targets: ``cpp/src/cylon/join/join_config.hpp:25-197`` (JoinType,
+JoinAlgorithm, JoinConfig), ``cpp/src/cylon/table.hpp:378-394`` (SortOptions),
+``cpp/src/cylon/io/csv_read_config.hpp:28-152`` / ``csv_write_config.hpp``.
+The reference uses builder-style C++ structs; here they are frozen dataclasses.
+"""
+
+import dataclasses
+import enum
+from typing import Sequence
+
+
+class JoinType(enum.Enum):
+    """Parity: ``join_config.hpp`` JoinType {INNER, LEFT, RIGHT, FULL_OUTER}."""
+
+    INNER = "inner"
+    LEFT = "left"
+    RIGHT = "right"
+    FULL_OUTER = "fullouter"
+
+
+class JoinAlgorithm(enum.Enum):
+    """Parity: ``join_config.hpp`` JoinAlgorithm {SORT, HASH}.
+
+    On TPU both lower to vectorised sorted probes; SORT is the
+    merge-on-sorted path, HASH keeps API parity and routes to the same
+    sorted probe (a Pallas hash-table build/probe is an optimisation slot).
+    """
+
+    SORT = "sort"
+    HASH = "hash"
+
+
+@dataclasses.dataclass(frozen=True)
+class JoinConfig:
+    """Parity: ``join_config.hpp:42-197``."""
+
+    join_type: JoinType = JoinType.INNER
+    algorithm: JoinAlgorithm = JoinAlgorithm.SORT
+    left_on: Sequence[str] = ()
+    right_on: Sequence[str] = ()
+    left_suffix: str = "_x"
+    right_suffix: str = "_y"
+
+    @staticmethod
+    def make(join_type="inner", algorithm="sort", left_on=(), right_on=(),
+             suffixes=("_x", "_y")) -> "JoinConfig":
+        jt = JoinType(join_type) if not isinstance(join_type, JoinType) else join_type
+        alg = (JoinAlgorithm(algorithm)
+               if not isinstance(algorithm, JoinAlgorithm) else algorithm)
+        return JoinConfig(jt, alg, tuple(left_on), tuple(right_on),
+                          suffixes[0], suffixes[1])
+
+
+@dataclasses.dataclass(frozen=True)
+class SortOptions:
+    """Parity: ``table.hpp:378-383`` SortOptions{num_bins, num_samples}.
+
+    Controls distributed sample-sort range partitioning: each shard
+    contributes ``num_samples`` samples; split points come from a
+    ``num_bins``-bucket global histogram (psum-reduced).
+    """
+
+    num_bins: int = 0        # 0 -> world_size * 128
+    num_samples: int = 0     # 0 -> min(local_rows, 1024)
+    ascending: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class CSVReadOptions:
+    """Parity: ``io/csv_read_config.hpp:28-152`` (builder methods become fields)."""
+
+    use_threads: bool = True
+    delimiter: str = ","
+    ignore_emptylines: bool = True
+    block_size: int = 1 << 22
+    use_cols: Sequence[str] | None = None
+    skip_rows: int = 0
+    column_names: Sequence[str] | None = None
+    slice: bool = False  # distributed read: shard rows across the mesh
+
+
+@dataclasses.dataclass(frozen=True)
+class CSVWriteOptions:
+    """Parity: ``io/csv_write_config.hpp``."""
+
+    delimiter: str = ","
+    include_header: bool = True

@@ -1,0 +1,153 @@
+"""Execution context: device mesh instead of MPI ranks.
+
+Parity target: ``cpp/src/cylon/ctx/cylon_context.hpp:30-147`` (Init /
+InitDistributed, rank/world/neighbours/barrier/sequence ids) and the comm
+config selection in ``ctx/cylon_context.cpp:36-57`` (MPIConfig/UCXConfig ->
+communicator). PyCylon surface: ``python/pycylon/frame.py:88-117`` CylonEnv.
+
+TPU-first redesign: there is no mpirun and no per-process rank. JAX is a
+single-controller SPMD system — ``CylonEnv`` owns a 1-D
+``jax.sharding.Mesh`` over the TPU slice (axis ``"w"`` = the reference's
+"world"), and every distributed operator is a ``shard_map`` over that
+mesh in which ``jax.lax.axis_index("w")`` plays the role of
+``GetRank()``. Collectives ride ICI (``psum``/``all_gather``/
+``all_to_all``) instead of the reference's MPI channel protocol
+(``net/mpi/mpi_channel.cpp:42-158``). Multi-host (DCN) uses the same mesh
+spanning processes after ``jax.distributed.initialize``.
+"""
+
+import dataclasses
+import itertools
+import threading
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+# The mesh axis along which table rows are partitioned — the reference's
+# "world" of MPI ranks (ctx/cylon_context.hpp:101 GetWorldSize).
+WORKER_AXIS = "w"
+
+
+class CommConfig:
+    """Parity: ``net/comm_config.hpp`` base; subclasses select the backend
+    the way MPIConfig/UCXConfig select communicators (cylon_context.cpp:36-57)."""
+
+
+@dataclasses.dataclass
+class LocalConfig(CommConfig):
+    """Single-device execution (reference CommType::LOCAL)."""
+
+
+@dataclasses.dataclass
+class TPUConfig(CommConfig):
+    """Use the TPU slice (or any set of JAX devices) as the world.
+
+    devices: explicit device list; None = all of ``jax.devices()``.
+    n_devices: take the first n of ``jax.devices()``.
+    multihost: call ``jax.distributed.initialize`` first (DCN-spanning mesh,
+        replaces the reference's UCX-over-MPI bootstrap,
+        net/ucx/ucx_communicator.cpp:50-97).
+    """
+
+    devices: Optional[Sequence] = None
+    n_devices: Optional[int] = None
+    multihost: bool = False
+
+
+# MPIConfig name kept as an alias so PyCylon scripts port mechanically.
+MPIConfig = TPUConfig
+
+
+class CylonEnv:
+    """The per-program context (parity: CylonContext + pycylon CylonEnv)."""
+
+    _seq = itertools.count()  # parity: ctx GetNextSequence (edge ids)
+    _lock = threading.Lock()
+
+    def __init__(self, config: CommConfig | None = None, distributed: bool = True):
+        config = config if config is not None else TPUConfig()
+        self._config = config
+        if isinstance(config, TPUConfig) and config.multihost:
+            jax.distributed.initialize()
+
+        if isinstance(config, LocalConfig) or not distributed:
+            devices = [jax.devices()[0]]
+        else:
+            devices = list(config.devices) if getattr(config, "devices", None) \
+                else jax.devices()
+            if getattr(config, "n_devices", None):
+                devices = devices[: config.n_devices]
+        self._mesh = Mesh(np.array(devices), (WORKER_AXIS,))
+        self._finalized = False
+
+    # -- world topology (parity: ctx/cylon_context.hpp:101) ---------------
+    @property
+    def mesh(self) -> Mesh:
+        return self._mesh
+
+    @property
+    def world_size(self) -> int:
+        return self._mesh.devices.size
+
+    @property
+    def rank(self) -> int:
+        """Host process index (0 on single-controller). Inside shard_map the
+        per-shard rank is ``jax.lax.axis_index(WORKER_AXIS)``."""
+        return jax.process_index()
+
+    @property
+    def is_distributed(self) -> bool:
+        return self.world_size > 1
+
+    def get_neighbours(self, rank: int | None = None,
+                       include_self: bool = False):
+        """Worker (device) indices, parity with ctx GetNeighbours.
+
+        On a single controller there is no ambient "self" worker — pass
+        ``rank`` (a device index, e.g. ``axis_index`` captured in a shard)
+        to exclude it; with ``rank=None`` all worker indices are returned.
+        """
+        ws = self.world_size
+        return [r for r in range(ws)
+                if include_self or rank is None or r != rank]
+
+    # -- sharding helpers -------------------------------------------------
+    @property
+    def row_spec(self) -> PartitionSpec:
+        """Rows partitioned over the world axis."""
+        return PartitionSpec(WORKER_AXIS)
+
+    @property
+    def row_sharding(self) -> NamedSharding:
+        return NamedSharding(self._mesh, self.row_spec)
+
+    @property
+    def replicated_sharding(self) -> NamedSharding:
+        return NamedSharding(self._mesh, PartitionSpec())
+
+    # -- lifecycle (parity: Barrier/Finalize) -----------------------------
+    def barrier(self):
+        """Block host until all devices drained (parity: ctx Barrier)."""
+        import jax.numpy as jnp
+
+        x = jax.device_put(jnp.zeros(self.world_size, jnp.int32),
+                           self.row_sharding)
+        jax.block_until_ready(jax.jit(lambda v: v.sum())(x))
+
+    def finalize(self):
+        self._finalized = True
+
+    @property
+    def is_finalized(self) -> bool:
+        return self._finalized
+
+    @classmethod
+    def get_next_sequence(cls) -> int:
+        with cls._lock:
+            return next(cls._seq)
+
+    def __repr__(self):
+        kind = type(self._config).__name__
+        return f"CylonEnv({kind}, world={self.world_size})"

@@ -1,0 +1,203 @@
+"""Logical type system mapped onto TPU-friendly physical dtypes.
+
+Parity target: the reference type system in ``cpp/src/cylon/data_types.hpp``
+(``Type::type`` enum lines 25-90, ``Layout`` fixed/variable-width, factory
+functions lines 141-166) and the Arrow bridge ``cpp/src/cylon/arrow/arrow_types.hpp``.
+
+TPU-first deviations:
+
+- Every device column is a fixed-width ``jnp`` array. Variable-width data
+  (STRING/BINARY) is **dictionary-encoded at ingest** on the host: the device
+  sees ``int32`` codes, the dictionary (unique values) stays host-side as a
+  numpy object array. Relational ops (join/groupby/sort on hash order/unique)
+  operate on codes; order-sensitive string ops re-encode with a sorted
+  dictionary so code order == lexicographic order.
+- Temporal types are int64 on device with unit metadata here.
+- float64/int64 are fully supported (jax x64 is enabled by the package);
+  bf16/f32 are preferred for compute-heavy aggregation paths.
+"""
+
+import dataclasses
+import enum
+
+import jax.numpy as jnp
+import numpy as np
+
+
+class Kind(enum.IntEnum):
+    """Logical kind. Parity: ``data_types.hpp:25-90`` ``Type::type``."""
+
+    BOOL = 0
+    UINT8 = 1
+    INT8 = 2
+    UINT16 = 3
+    INT16 = 4
+    UINT32 = 5
+    INT32 = 6
+    UINT64 = 7
+    INT64 = 8
+    HALF_FLOAT = 9
+    FLOAT = 10
+    DOUBLE = 11
+    STRING = 12
+    BINARY = 13
+    FIXED_SIZE_BINARY = 14
+    DATE32 = 15
+    DATE64 = 16
+    TIMESTAMP = 17
+    TIME32 = 18
+    TIME64 = 19
+    DURATION = 21
+
+
+class Layout(enum.IntEnum):
+    """Parity: ``data_types.hpp`` Layout (fixed vs variable width)."""
+
+    FIXED_WIDTH = 1
+    VARIABLE_WIDTH = 2  # dictionary-encoded on device
+
+
+_PHYSICAL = {
+    Kind.BOOL: jnp.bool_,
+    Kind.UINT8: jnp.uint8,
+    Kind.INT8: jnp.int8,
+    Kind.UINT16: jnp.uint16,
+    Kind.INT16: jnp.int16,
+    Kind.UINT32: jnp.uint32,
+    Kind.INT32: jnp.int32,
+    Kind.UINT64: jnp.uint64,
+    Kind.INT64: jnp.int64,
+    Kind.HALF_FLOAT: jnp.float16,
+    Kind.FLOAT: jnp.float32,
+    Kind.DOUBLE: jnp.float64,
+    Kind.STRING: jnp.int32,  # dictionary codes
+    Kind.BINARY: jnp.int32,  # dictionary codes
+    Kind.FIXED_SIZE_BINARY: jnp.int32,
+    Kind.DATE32: jnp.int32,
+    Kind.DATE64: jnp.int64,
+    Kind.TIMESTAMP: jnp.int64,
+    Kind.TIME32: jnp.int32,
+    Kind.TIME64: jnp.int64,
+    Kind.DURATION: jnp.int64,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class DType:
+    """Logical dtype. Parity: ``cylon::DataType`` (``data_types.hpp:94-139``)."""
+
+    kind: Kind
+    unit: str | None = None  # temporal unit ("s"/"ms"/"us"/"ns") when applicable
+
+    @property
+    def physical(self) -> jnp.dtype:
+        """Device representation dtype."""
+        return jnp.dtype(_PHYSICAL[self.kind])
+
+    @property
+    def layout(self) -> Layout:
+        if self.kind in (Kind.STRING, Kind.BINARY):
+            return Layout.VARIABLE_WIDTH
+        return Layout.FIXED_WIDTH
+
+    @property
+    def is_dictionary(self) -> bool:
+        """True if the device array holds dictionary codes."""
+        return self.kind in (Kind.STRING, Kind.BINARY)
+
+    @property
+    def is_numeric(self) -> bool:
+        return self.kind in (
+            Kind.UINT8, Kind.INT8, Kind.UINT16, Kind.INT16, Kind.UINT32,
+            Kind.INT32, Kind.UINT64, Kind.INT64, Kind.HALF_FLOAT, Kind.FLOAT,
+            Kind.DOUBLE,
+        )
+
+    @property
+    def is_floating(self) -> bool:
+        return self.kind in (Kind.HALF_FLOAT, Kind.FLOAT, Kind.DOUBLE)
+
+    def __repr__(self):
+        u = f"[{self.unit}]" if self.unit else ""
+        return f"{self.kind.name.lower()}{u}"
+
+
+# Factory singletons, mirroring data_types.hpp:141-166 factory functions.
+bool_ = DType(Kind.BOOL)
+uint8 = DType(Kind.UINT8)
+int8 = DType(Kind.INT8)
+uint16 = DType(Kind.UINT16)
+int16 = DType(Kind.INT16)
+uint32 = DType(Kind.UINT32)
+int32 = DType(Kind.INT32)
+uint64 = DType(Kind.UINT64)
+int64 = DType(Kind.INT64)
+float16 = DType(Kind.HALF_FLOAT)
+float32 = DType(Kind.FLOAT)
+float64 = DType(Kind.DOUBLE)
+string = DType(Kind.STRING)
+binary = DType(Kind.BINARY)
+date32 = DType(Kind.DATE32)
+date64 = DType(Kind.DATE64)
+
+
+def timestamp(unit: str = "ns") -> DType:
+    return DType(Kind.TIMESTAMP, unit)
+
+
+def duration(unit: str = "ns") -> DType:
+    return DType(Kind.DURATION, unit)
+
+
+_NUMPY_TO_KIND = {
+    np.dtype(np.bool_): Kind.BOOL,
+    np.dtype(np.uint8): Kind.UINT8,
+    np.dtype(np.int8): Kind.INT8,
+    np.dtype(np.uint16): Kind.UINT16,
+    np.dtype(np.int16): Kind.INT16,
+    np.dtype(np.uint32): Kind.UINT32,
+    np.dtype(np.int32): Kind.INT32,
+    np.dtype(np.uint64): Kind.UINT64,
+    np.dtype(np.int64): Kind.INT64,
+    np.dtype(np.float16): Kind.HALF_FLOAT,
+    np.dtype(np.float32): Kind.FLOAT,
+    np.dtype(np.float64): Kind.DOUBLE,
+}
+
+
+def from_numpy_dtype(dt) -> DType:
+    """numpy dtype -> logical DType (parity: ``arrow_types.cpp`` bridge)."""
+    dt = np.dtype(dt)
+    if dt.kind in ("U", "S", "O"):
+        return string
+    if dt.kind == "M":  # datetime64
+        unit = np.datetime_data(dt)[0]
+        return timestamp(unit)
+    if dt.kind == "m":
+        unit = np.datetime_data(dt)[0]
+        return duration(unit)
+    kind = _NUMPY_TO_KIND.get(dt)
+    if kind is None:
+        raise TypeError(f"unsupported numpy dtype {dt}")
+    return DType(kind)
+
+
+def sentinel_high(phys: jnp.dtype):
+    """Largest value of a physical dtype — used to pad invalid rows so they
+    sort to the end (replaces the reference's exact-length buffers; XLA needs
+    static shapes so padded rows must be order-inert)."""
+    phys = jnp.dtype(phys)
+    if phys == jnp.bool_:
+        return True
+    if jnp.issubdtype(phys, jnp.floating):
+        return jnp.inf
+    return jnp.iinfo(phys).max
+
+
+def sentinel_low(phys: jnp.dtype):
+    phys = jnp.dtype(phys)
+    if phys == jnp.bool_:
+        return False
+    if jnp.issubdtype(phys, jnp.floating):
+        return -jnp.inf
+    return jnp.iinfo(phys).min

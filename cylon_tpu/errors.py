@@ -1,0 +1,78 @@
+"""Error model.
+
+The reference threads a ``cylon::Status{code, msg}`` value through every
+call (``cpp/src/cylon/status.hpp:1-66``, codes in ``cpp/src/cylon/code.hpp:20-40``).
+A TPU/JAX rebuild is Python-first, so statuses become exceptions; the
+:class:`Code` enum is preserved for parity so callers can still switch on
+machine-readable codes (``exc.code``).
+"""
+
+import enum
+
+
+class Code(enum.IntEnum):
+    """Parity with ``cpp/src/cylon/code.hpp:20-40``."""
+
+    OK = 0
+    OutOfMemory = 1
+    KeyError = 2
+    TypeError = 3
+    Invalid = 4
+    IOError = 5
+    CapacityError = 6
+    IndexError = 7
+    UnknownError = 9
+    NotImplemented = 10
+    SerializationError = 11
+    GpuMemoryError = 12  # kept for numeric parity; unused on TPU
+    RError = 13
+    CodeGenError = 40
+    ExpressionValidationError = 41
+    ExecutionError = 42
+    AlreadyExists = 45
+
+
+class CylonError(Exception):
+    """Base class; carries a :class:`Code` like ``cylon::Status``."""
+
+    code: Code = Code.UnknownError
+
+    def __init__(self, msg: str = "", code: "Code | None" = None):
+        super().__init__(msg)
+        if code is not None:
+            self.code = code
+
+
+class InvalidArgument(CylonError):
+    code = Code.Invalid
+
+
+class KeyError_(CylonError):
+    code = Code.KeyError
+
+
+class TypeError_(CylonError):
+    code = Code.TypeError
+
+
+class IndexError_(CylonError):
+    code = Code.IndexError
+
+
+class IOError_(CylonError):
+    code = Code.IOError
+
+
+class NotImplemented_(CylonError):
+    code = Code.NotImplemented
+
+
+class OutOfCapacity(CylonError):
+    """A capacity-bounded kernel produced more rows than its static bound.
+
+    No reference analog: XLA requires static shapes, so data-dependent
+    result sizes (joins, filters) are materialised into caller-bounded
+    buffers; overflowing the bound raises this (host-side check).
+    """
+
+    code = Code.CapacityError

@@ -1,0 +1,238 @@
+"""HBM-resident columnar Table.
+
+Parity target: ``cpp/src/cylon/table.hpp:46-200`` (Table wraps
+``shared_ptr<arrow::Table>`` + ctx) and the conversion surface of
+``python/pycylon/data/table.pyx:767-1004`` (from/to arrow, pandas, numpy,
+pydict).
+
+TPU-first redesign — the load-bearing difference from the reference:
+XLA compiles static shapes, but relational ops produce data-dependent row
+counts. A Table therefore carries
+
+- ``capacity``: the static padded row count (the arrays' leading dim), and
+- ``nrows``:    a traced int32 scalar — how many leading rows are real.
+
+Rows in ``[nrows, capacity)`` are padding; every kernel either masks them
+with order-inert sentinels or filters them on output. This replaces the
+reference's exact-length Arrow buffers and is what lets an entire
+multi-op pipeline (partition -> shuffle -> join -> groupby) stay inside one
+``jit`` without host round-trips.
+"""
+
+import collections
+from typing import Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cylon_tpu import dtypes
+from cylon_tpu.column import Column, Dictionary
+from cylon_tpu.errors import InvalidArgument, KeyError_
+
+
+@jax.tree_util.register_pytree_node_class
+class Table:
+    """Named device columns + a traced valid-row count."""
+
+    def __init__(self, columns: Mapping[str, Column], nrows):
+        self._columns = collections.OrderedDict(columns)
+        caps = {c.capacity for c in self._columns.values()}
+        if len(caps) > 1:
+            raise InvalidArgument(f"column capacities differ: {caps}")
+        if isinstance(nrows, (int, np.integer)):
+            nrows = jnp.asarray(nrows, dtype=jnp.int32)
+        self.nrows = nrows
+
+    # -- pytree ----------------------------------------------------------
+    def tree_flatten(self):
+        return (tuple(self._columns.values()), self.nrows), tuple(self._columns)
+
+    @classmethod
+    def tree_unflatten(cls, names, children):
+        cols, nrows = children
+        t = object.__new__(cls)
+        t._columns = collections.OrderedDict(zip(names, cols))
+        t.nrows = nrows
+        return t
+
+    # -- shape / schema --------------------------------------------------
+    @property
+    def capacity(self) -> int:
+        if not self._columns:
+            return 0
+        return next(iter(self._columns.values())).capacity
+
+    @property
+    def num_rows(self) -> int:
+        """Concrete row count (syncs device->host; not usable under trace).
+        Parity: ``table.hpp`` Rows()."""
+        return int(self.nrows)
+
+    @property
+    def num_columns(self) -> int:
+        return len(self._columns)
+
+    @property
+    def column_names(self) -> list[str]:
+        return list(self._columns)
+
+    @property
+    def columns(self) -> "collections.OrderedDict[str, Column]":
+        return self._columns
+
+    def column(self, name: str) -> Column:
+        if name not in self._columns:
+            raise KeyError_(f"no column {name!r}; have {self.column_names}")
+        return self._columns[name]
+
+    def __getitem__(self, key):
+        if isinstance(key, str):
+            return self.column(key)
+        if isinstance(key, (list, tuple)):
+            return self.select(key)
+        raise KeyError_(f"bad key {key!r}")
+
+    def __contains__(self, name):
+        return name in self._columns
+
+    def row_mask(self) -> jax.Array:
+        """[capacity] bool — True for real rows."""
+        return jnp.arange(self.capacity, dtype=jnp.int32) < self.nrows
+
+    # -- schema ops (parity: table.pyx project/rename/drop) --------------
+    def select(self, names: Sequence[str]) -> "Table":
+        """Project columns (parity: ``table.hpp`` Project / table.pyx ``__getitem__``)."""
+        return Table({n: self.column(n) for n in names}, self.nrows)
+
+    def rename(self, mapping: Mapping[str, str]) -> "Table":
+        return Table({mapping.get(n, n): c for n, c in self._columns.items()},
+                     self.nrows)
+
+    def drop(self, names: Sequence[str]) -> "Table":
+        names = set(names)
+        return Table({n: c for n, c in self._columns.items() if n not in names},
+                     self.nrows)
+
+    def add_column(self, name: str, col: Column) -> "Table":
+        out = collections.OrderedDict(self._columns)
+        out[name] = col
+        return Table(out, self.nrows)
+
+    def with_nrows(self, nrows) -> "Table":
+        return Table(self._columns, nrows)
+
+    def with_capacity(self, capacity: int) -> "Table":
+        """Pad (zeros) or trim the static capacity. Trimming below nrows is
+        caller's responsibility to avoid (checked on host when concrete)."""
+        cur = self.capacity
+        if capacity == cur:
+            return self
+        cols = {}
+        for n, c in self._columns.items():
+            if capacity > cur:
+                data = jnp.concatenate(
+                    [c.data, jnp.zeros((capacity - cur,) + c.data.shape[1:],
+                                       dtype=c.data.dtype)])
+                validity = (None if c.validity is None else
+                            jnp.concatenate([c.validity,
+                                             jnp.zeros(capacity - cur, bool)]))
+            else:
+                data = c.data[:capacity]
+                validity = None if c.validity is None else c.validity[:capacity]
+            cols[n] = Column(data, validity, c.dtype, c.dictionary)
+        return Table(cols, jnp.minimum(self.nrows, capacity))
+
+    # -- host bridges ----------------------------------------------------
+    @staticmethod
+    def from_pydict(data: Mapping[str, object], capacity: int | None = None) -> "Table":
+        """Parity: ``table.pyx`` from_pydict."""
+        arrays = {n: np.asarray(v) for n, v in data.items()}
+        n = len(next(iter(arrays.values()))) if arrays else 0
+        for name, a in arrays.items():
+            if len(a) != n:
+                raise InvalidArgument(f"column {name} length {len(a)} != {n}")
+        cols = {name: Column.from_numpy(a, capacity) for name, a in arrays.items()}
+        return Table(cols, n)
+
+    @staticmethod
+    def from_pandas(df, capacity: int | None = None) -> "Table":
+        """Parity: ``table.pyx`` from_pandas."""
+        data = {}
+        for name in df.columns:
+            s = df[name]
+            if str(s.dtype).startswith(("Int", "UInt", "Float", "boolean")):
+                # pandas nullable extension arrays
+                mask = s.isna().to_numpy()
+                fill = False if str(s.dtype) == "boolean" else 0
+                vals = s.fillna(fill).to_numpy()
+                col = Column.from_numpy(vals, capacity)
+                if mask.any():
+                    v = np.concatenate([~mask, np.zeros(col.capacity - len(mask), bool)])
+                    col = Column(col.data, jnp.asarray(v), col.dtype, col.dictionary)
+                data[str(name)] = col
+                continue
+            data[str(name)] = Column.from_numpy(s.to_numpy(), capacity)
+        return Table(data, len(df))
+
+    @staticmethod
+    def from_arrow(atable, capacity: int | None = None) -> "Table":
+        """Parity: ``table.pyx`` from_arrow."""
+        import pyarrow as pa
+        import pyarrow.compute as pc
+
+        cols = {}
+        for name in atable.column_names:
+            arr = atable.column(name).combine_chunks()
+            # Nullable int/bool: keep the logical type, carry Arrow's null
+            # mask as validity (to_numpy alone would coerce to float64+NaN).
+            if arr.null_count and (pa.types.is_integer(arr.type)
+                                   or pa.types.is_boolean(arr.type)):
+                isnull = arr.is_null().to_numpy(zero_copy_only=False)
+                fill = False if pa.types.is_boolean(arr.type) else 0
+                filled = pc.fill_null(arr, fill).to_numpy(zero_copy_only=False)
+                col = Column.from_numpy(filled, capacity)
+                validity = np.concatenate(
+                    [~isnull, np.zeros(col.capacity - len(isnull), bool)])
+                col = Column(col.data, jnp.asarray(validity), col.dtype,
+                             col.dictionary)
+            else:
+                col = Column.from_numpy(
+                    arr.to_numpy(zero_copy_only=False), capacity)
+            cols[str(name)] = col
+        return Table(cols, atable.num_rows)
+
+    @staticmethod
+    def from_numpy(names: Sequence[str], arrays: Sequence[np.ndarray],
+                   capacity: int | None = None) -> "Table":
+        return Table.from_pydict(dict(zip(names, arrays)), capacity)
+
+    def to_pydict(self) -> dict:
+        n = self.num_rows
+        return {name: c.to_numpy(n).tolist() for name, c in self._columns.items()}
+
+    def to_pandas(self):
+        import pandas as pd
+
+        n = self.num_rows
+        return pd.DataFrame({name: c.to_numpy(n)
+                             for name, c in self._columns.items()})
+
+    def to_arrow(self):
+        import pyarrow as pa
+
+        n = self.num_rows
+        return pa.table({name: c.to_numpy(n) for name, c in self._columns.items()})
+
+    def to_numpy(self) -> np.ndarray:
+        """[nrows, ncols] host matrix (parity: table.pyx to_numpy)."""
+        n = self.num_rows
+        return np.stack([c.to_numpy(n) for c in self._columns.values()], axis=1)
+
+    def __repr__(self):
+        try:
+            n = str(self.num_rows)
+        except Exception:
+            n = "<traced>"
+        schema = ", ".join(f"{name}: {c.dtype!r}" for name, c in self._columns.items())
+        return f"Table[{n}/{self.capacity} rows]({schema})"

@@ -1,0 +1,50 @@
+"""Test harness: an 8-device virtual CPU mesh.
+
+Mirrors the reference's test model (``cpp/test/CMakeLists.txt:44-50``):
+there, every Catch2 test binary runs under ``mpirun --oversubscribe -np
+{1,2,4}`` on one box — multi-node is *simulated*. The TPU analog is
+``--xla_force_host_platform_device_count=8`` on the CPU backend; the same
+distributed-op code paths (shard_map + collectives) execute, just on host
+devices. Real-TPU execution is exercised by ``bench.py`` and
+``__graft_entry__.py``.
+"""
+
+import os
+
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def env8():
+    """A distributed CylonEnv over all 8 virtual devices."""
+    from cylon_tpu import CylonEnv, TPUConfig
+
+    return CylonEnv(TPUConfig())
+
+
+@pytest.fixture(scope="session")
+def env4():
+    from cylon_tpu import CylonEnv, TPUConfig
+
+    return CylonEnv(TPUConfig(n_devices=4))
+
+
+@pytest.fixture(scope="session")
+def env1():
+    from cylon_tpu import CylonEnv, LocalConfig
+
+    return CylonEnv(LocalConfig(), distributed=False)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)
