@@ -1,0 +1,50 @@
+"""Local relational kernels (the reference's L5 layer, rebuilt for XLA).
+
+Reference analogs: ``cpp/src/cylon/arrow/arrow_kernels.cpp`` (split/sort),
+``arrow_comparator.cpp`` (row compare/hash), ``join/`` (hash+sort join),
+``groupby/`` (hash/pipeline groupby), ``compute/`` (aggregates),
+``partition/`` (hash/range partition).
+
+TPU-first stance: no hash tables and no per-row branching. Every op is
+built from sorts (``lax.sort`` multi-operand, MXU/VPU friendly), segment
+reductions, prefix sums and gathers — all static-shape, all fusable by
+XLA. Hash-partitioning still exists (for the shuffle), but *equality*
+logic (join matching, groupby keying, dedup) rides dense group ids
+computed by lexsorting, which is collision-free — unlike the
+reference's murmur3+flat_hash_map pipeline, there is no hash-collision
+path to handle.
+"""
+
+from cylon_tpu.ops import kernels
+from cylon_tpu.ops.hash import hash_columns
+from cylon_tpu.ops.join import join
+from cylon_tpu.ops.groupby import groupby_aggregate
+from cylon_tpu.ops.setops import unique, union, intersect, subtract, equal_tables
+from cylon_tpu.ops.selection import (
+    concat_tables,
+    filter_table,
+    head,
+    sample,
+    sort_table,
+    take,
+)
+from cylon_tpu.ops.aggregates import table_aggregate
+
+__all__ = [
+    "concat_tables",
+    "equal_tables",
+    "filter_table",
+    "groupby_aggregate",
+    "hash_columns",
+    "head",
+    "intersect",
+    "join",
+    "kernels",
+    "sample",
+    "sort_table",
+    "subtract",
+    "table_aggregate",
+    "take",
+    "union",
+    "unique",
+]

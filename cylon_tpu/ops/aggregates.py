@@ -1,0 +1,57 @@
+"""Table-level scalar aggregates.
+
+Reference analog: ``cpp/src/cylon/compute/aggregates.cpp:26-147`` —
+``compute::Sum/Count/Min/Max/MinMax`` as local Arrow compute followed by
+``DoAllReduce`` (mpi::AllReduce). Here the local part is a masked XLA
+reduction; the distributed part (``cylon_tpu.parallel``) wraps it in
+``psum``/``pmin``/``pmax`` over the mesh axis.
+"""
+
+import jax.numpy as jnp
+
+from cylon_tpu import dtypes
+from cylon_tpu.errors import InvalidArgument
+from cylon_tpu.ops import kernels
+from cylon_tpu.ops.selection import _null_flags
+from cylon_tpu.table import Table
+
+AGGS = ("sum", "count", "min", "max", "mean", "var", "std", "nunique")
+
+
+def table_aggregate(table: Table, col: str, op: str):
+    """Scalar aggregate of one column, skipping nulls/NaN. Returns a
+    0-d jax array (device scalar; jit-safe)."""
+    if op not in AGGS:
+        raise InvalidArgument(f"unknown aggregate {op!r}")
+    c = table.column(col)
+    cap = table.capacity
+    vmask = kernels.valid_mask(cap, table.nrows)
+    nulls = _null_flags(c)
+    ok = vmask if nulls is None else vmask & (nulls == 0)
+
+    data = c.data
+    if op == "count":
+        return ok.sum(dtype=jnp.int64)
+    if op == "nunique":
+        gid, num_groups, _ = kernels.dense_group_ids(
+            [data], ok, [None])
+        return num_groups.astype(jnp.int64)
+    if op == "sum":
+        acc = kernels._acc_dtype(data.dtype)
+        return jnp.where(ok, data, jnp.zeros((), data.dtype)).astype(acc).sum()
+    if op == "min":
+        sent = dtypes.sentinel_high(data.dtype)
+        return jnp.where(ok, data, jnp.asarray(sent, data.dtype)).min()
+    if op == "max":
+        sent = dtypes.sentinel_low(data.dtype)
+        return jnp.where(ok, data, jnp.asarray(sent, data.dtype)).max()
+    f = jnp.float64 if data.dtype.itemsize >= 4 else jnp.float32
+    vals = jnp.where(ok, data.astype(f), 0.0)
+    n = ok.sum(dtype=f)
+    s = vals.sum()
+    if op == "mean":
+        return s / jnp.maximum(n, 1.0)
+    sq = (vals * vals).sum()
+    var = (sq - s * s / jnp.maximum(n, 1.0)) / jnp.maximum(n - 1.0, 1.0)
+    var = jnp.maximum(var, 0.0)
+    return jnp.sqrt(var) if op == "std" else var

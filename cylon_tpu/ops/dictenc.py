@@ -1,0 +1,58 @@
+"""Dictionary (string) column alignment.
+
+Device tables hold int32 codes; the values live host-side
+(:class:`cylon_tpu.column.Dictionary`). Any op that compares string
+columns *across* tables (concat, join keys, set ops) first re-encodes
+them onto one shared sorted dictionary — a host-side metadata step whose
+device part is a single gather (``new_code = remap[old_code]``).
+
+This replaces the reference's byte-level binary comparators
+(``arrow/arrow_comparator.cpp`` binary specialisations): on TPU we never
+compare strings on device, only their order-preserving codes.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from cylon_tpu.column import Column, Dictionary
+from cylon_tpu.table import Table
+
+
+def unify_dictionaries(cols: list[Column]) -> list[Column]:
+    """Re-encode dictionary columns onto one merged sorted dictionary.
+    Non-dictionary columns pass through unchanged (all must agree)."""
+    dict_cols = [c for c in cols if c.dtype.is_dictionary]
+    if not dict_cols:
+        return cols
+    dicts = [c.dictionary for c in dict_cols]
+    first = dicts[0]
+    if all(d is first for d in dicts):
+        return cols
+    merged = np.unique(np.concatenate([d.values for d in dicts]))
+    shared = Dictionary(merged)
+    out = []
+    for c in cols:
+        if not c.dtype.is_dictionary:
+            out.append(c)
+            continue
+        remap = np.searchsorted(merged, c.dictionary.values).astype(np.int32)
+        if len(remap):
+            codes = jnp.asarray(remap)[jnp.clip(c.data, 0, len(remap) - 1)]
+        else:
+            codes = c.data
+        out.append(Column(codes, c.validity, c.dtype, shared))
+    return out
+
+
+def unify_table_dictionaries(tables: list[Table]) -> list[Table]:
+    """Column-name-wise dictionary unification across tables."""
+    if len(tables) < 2:
+        return list(tables)
+    names = tables[0].column_names
+    new_cols = {t_i: {} for t_i in range(len(tables))}
+    for name in names:
+        cols = [t.column(name) for t in tables]
+        unified = unify_dictionaries(cols)
+        for i, c in enumerate(unified):
+            new_cols[i][name] = c
+    return [Table(new_cols[i], t.nrows) for i, t in enumerate(tables)]

@@ -1,0 +1,187 @@
+"""Group-by aggregation via dense group ids + segment reductions.
+
+Reference analog: ``cpp/src/cylon/groupby/hash_groupby.cpp`` —
+``make_groups`` builds a composite-row-hash map to dense ids (line 90)
+then templated ``aggregate<op>`` walks rows updating per-group state
+(lines 143, 221-226); op set in ``compute/aggregate_kernels.hpp:40-52``
+(SUM..STDDEV, NUNIQUE, QUANTILE). The pipeline (pre-sorted) variant is
+``pipeline_groupby.cpp``.
+
+TPU-first: group ids come from one lexsort (collision-free, no hash
+map); every aggregate is an XLA segment reduction over those ids. The
+"pipeline groupby" specialisation is unnecessary — sorted input just
+makes the same lexsort cheap.
+
+Group order in the output is key-sorted (== pandas ``sort=True``).
+"""
+
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from cylon_tpu.column import Column
+from cylon_tpu import dtypes
+from cylon_tpu.errors import InvalidArgument
+from cylon_tpu.ops import kernels
+from cylon_tpu.ops.selection import _null_flags, take_columns
+from cylon_tpu.table import Table
+
+#: ops supported (parity: aggregate_kernels.hpp:40-52 + pandas extras)
+AGG_OPS = ("sum", "count", "size", "min", "max", "mean", "var", "std",
+           "nunique", "first", "last", "median", "quantile")
+
+
+def groupby_aggregate(table: Table, by: Sequence[str],
+                      aggs: Sequence[tuple[str, str]] | Sequence[tuple[str, str, str]],
+                      out_capacity: int | None = None,
+                      quantile: float = 0.5) -> Table:
+    """Aggregate ``table`` grouped by key columns ``by``.
+
+    ``aggs``: (src_column, op[, out_name]) tuples; op from AGG_OPS.
+    Result: one row per distinct key tuple, keys first then aggregates,
+    key-sorted. Null keys form their own group (they equal each other).
+    Nulls/NaNs in value columns are skipped (pandas skipna semantics).
+    """
+    cap = table.capacity
+    out_cap = out_capacity if out_capacity is not None else cap
+    keys = [table.column(n).data for n in by]
+    kvals = [table.column(n).validity for n in by]
+    gid, num_groups, _ = kernels.dense_group_ids(keys, table.nrows, kvals)
+
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    big = jnp.int32(cap)
+    first_idx = jax.ops.segment_min(jnp.where(gid < big, iota, big), gid,
+                                    num_segments=out_cap)
+    first_idx = jnp.clip(first_idx, 0, max(cap - 1, 0))
+
+    out = {}
+    keytab = take_columns(table, first_idx, num_groups, names=list(by))
+    for n in by:
+        out[n] = keytab.column(n)
+
+    for spec in aggs:
+        src, op, name = spec if len(spec) == 3 else (*spec, None)
+        name = name or f"{src}_{op}"
+        if op not in AGG_OPS:
+            raise InvalidArgument(f"unknown aggregation {op!r}")
+        out[name] = _aggregate_column(table, src, op, gid, num_groups,
+                                      out_cap, quantile)
+    return Table(out, num_groups)
+
+
+def _aggregate_column(table: Table, src: str, op: str, gid, num_groups,
+                      out_cap: int, q: float) -> Column:
+    c = table.column(src)
+    cap = table.capacity
+    vmask = kernels.valid_mask(cap, table.nrows)
+    nulls = _null_flags(c)
+    value_ok = vmask if nulls is None else (vmask & (nulls == 0))
+    # rows with missing values drop out of the reduction entirely
+    gid_v = jnp.where(value_ok, gid, out_cap)
+    gslot = jnp.arange(out_cap, dtype=jnp.int32)
+    gvalid = gslot < num_groups
+
+    if op == "size":
+        gid_all = jnp.where(vmask, gid, out_cap)
+        data = jax.ops.segment_sum(jnp.ones(cap, jnp.int64), gid_all,
+                                   num_segments=out_cap)
+        return Column(data, None, dtypes.int64)
+    if op == "count":
+        data = jax.ops.segment_sum(jnp.ones(cap, jnp.int64), gid_v,
+                                   num_segments=out_cap)
+        return Column(data, None, dtypes.int64)
+    if op == "sum":
+        acc = kernels._acc_dtype(c.data.dtype)
+        vals = jnp.where(value_ok, c.data, jnp.zeros((), c.data.dtype))
+        data = jax.ops.segment_sum(vals.astype(acc), gid_v,
+                                   num_segments=out_cap)
+        return Column(data, None, dtypes.from_numpy_dtype(acc))
+    if op in ("min", "max"):
+        if c.dtype.is_dictionary:
+            # codes are order-preserving, so min/max of codes is correct
+            pass
+        sent = (dtypes.sentinel_high(c.data.dtype) if op == "min"
+                else dtypes.sentinel_low(c.data.dtype))
+        vals = jnp.where(value_ok, c.data, jnp.asarray(sent, c.data.dtype))
+        red = jax.ops.segment_min if op == "min" else jax.ops.segment_max
+        data = red(vals, gid_v, num_segments=out_cap)
+        cnt = jax.ops.segment_sum(jnp.ones(cap, jnp.int32), gid_v,
+                                  num_segments=out_cap)
+        validity = gvalid & (cnt > 0)
+        return Column(data, validity, c.dtype, c.dictionary)
+    if op in ("mean", "var", "std"):
+        f = jnp.float64 if c.data.dtype.itemsize >= 4 else jnp.float32
+        vals = jnp.where(value_ok, c.data.astype(f), 0.0)
+        s = jax.ops.segment_sum(vals, gid_v, num_segments=out_cap)
+        n = jax.ops.segment_sum(jnp.ones(cap, f), gid_v, num_segments=out_cap)
+        if op == "mean":
+            data = s / jnp.maximum(n, 1.0)
+            return Column(data, gvalid & (n > 0), dtypes.from_numpy_dtype(f))
+        sq = jax.ops.segment_sum(vals * vals, gid_v, num_segments=out_cap)
+        # ddof=1 (pandas default)
+        var = (sq - s * s / jnp.maximum(n, 1.0)) / jnp.maximum(n - 1.0, 1.0)
+        var = jnp.maximum(var, 0.0)
+        data = jnp.sqrt(var) if op == "std" else var
+        return Column(data, gvalid & (n > 1), dtypes.from_numpy_dtype(f))
+    if op in ("first", "last"):
+        iota = jnp.arange(cap, dtype=jnp.int32)
+        if op == "first":
+            idx = jax.ops.segment_min(jnp.where(value_ok, iota, cap), gid_v,
+                                      num_segments=out_cap)
+        else:
+            idx = jax.ops.segment_max(jnp.where(value_ok, iota, -1), gid_v,
+                                      num_segments=out_cap)
+        has = (idx >= 0) & (idx < cap)
+        idx = jnp.clip(idx, 0, max(cap - 1, 0))
+        data = c.data[idx]
+        return Column(data, gvalid & has, c.dtype, c.dictionary)
+    if op == "nunique":
+        return _nunique(c, gid_v, gvalid, out_cap)
+    if op in ("median", "quantile"):
+        qq = 0.5 if op == "median" else q
+        return _quantile(c, gid_v, gvalid, out_cap, qq)
+    raise InvalidArgument(f"unhandled aggregation {op!r}")
+
+
+def _nunique(c: Column, gid_v, gvalid, out_cap: int) -> Column:
+    """Distinct non-null values per group: sort rows by (gid, value) and
+    count run boundaries per group (parity: NUNIQUE kernel,
+    ``aggregate_kernels.hpp``)."""
+    cap = c.data.shape[0]
+    perm = kernels.sort_perm([gid_v, c.data], gid_v < out_cap)
+    g_s = gid_v[perm]
+    v_s = c.data[perm]
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    new_grp = g_s != jnp.roll(g_s, 1)
+    new_val = v_s != jnp.roll(v_s, 1)
+    boundary = (jnp.where(iota == 0, True, new_grp | new_val)
+                & (g_s < out_cap))
+    data = jax.ops.segment_sum(boundary.astype(jnp.int64),
+                               jnp.where(g_s < out_cap, g_s, out_cap),
+                               num_segments=out_cap)
+    return Column(data, None, dtypes.int64)
+
+
+def _quantile(c: Column, gid_v, gvalid, out_cap: int, q: float) -> Column:
+    """Per-group linear-interpolated quantile over non-null values
+    (parity: QUANTILE kernel). Sort by (gid, value), then index each
+    group's run at q*(n-1)."""
+    cap = c.data.shape[0]
+    f = jnp.float64 if c.data.dtype.itemsize >= 4 else jnp.float32
+    perm = kernels.sort_perm([gid_v, c.data], gid_v < out_cap)
+    g_s = gid_v[perm]
+    v_s = c.data[perm].astype(f)
+    n = jax.ops.segment_sum(jnp.ones(cap, jnp.int32),
+                            jnp.where(g_s < out_cap, g_s, out_cap),
+                            num_segments=out_cap)
+    start = kernels.exclusive_cumsum(n)
+    pos = q * jnp.maximum(n - 1, 0).astype(f)
+    lo = jnp.floor(pos).astype(jnp.int32)
+    hi = jnp.ceil(pos).astype(jnp.int32)
+    w = (pos - lo.astype(f))
+    take_lo = jnp.clip(start + lo, 0, max(cap - 1, 0))
+    take_hi = jnp.clip(start + hi, 0, max(cap - 1, 0))
+    data = v_s[take_lo] * (1 - w) + v_s[take_hi] * w
+    return Column(data, gvalid & (n > 0), dtypes.from_numpy_dtype(f))

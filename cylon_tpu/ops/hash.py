@@ -1,0 +1,99 @@
+"""Vectorised row hashing for partition assignment.
+
+Parity: the reference hashes each row with MurmurHash3_x86_32 per column
+and combines (``arrow/arrow_partition_kernels.cpp:140-297``
+HashPartitionKernel, ``util/murmur3.cpp``). Here the same construction is
+expressed as pure uint32 vector ops over whole columns — one fused XLA
+elementwise program per table instead of a per-row byte loop. Hash values
+differ from the reference's (byte-stream murmur) but have the same role
+and mixing quality; only determinism-within-a-job matters for shuffles.
+
+64-bit columns hash as two 32-bit words, so the hot path is uint32 math
+(TPU-native) even for int64 keys.
+"""
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+_C1 = jnp.uint32(0xCC9E2D51)
+_C2 = jnp.uint32(0x1B873593)
+
+
+def _rotl32(x, r):
+    return (x << r) | (x >> (32 - r))
+
+
+def _mix_word(h, k):
+    """One murmur3 block step: fold word k into running hash h."""
+    k = k * _C1
+    k = _rotl32(k, 15)
+    k = k * _C2
+    h = h ^ k
+    h = _rotl32(h, 13)
+    return h * jnp.uint32(5) + jnp.uint32(0xE6546B64)
+
+
+def _fmix32(h):
+    """murmur3 finaliser (``util/murmur3.cpp`` fmix32)."""
+    h = h ^ (h >> 16)
+    h = h * jnp.uint32(0x85EBCA6B)
+    h = h ^ (h >> 13)
+    h = h * jnp.uint32(0xC2B2AE35)
+    return h ^ (h >> 16)
+
+
+def _words32(data: jax.Array) -> list[jax.Array]:
+    """Column -> list of uint32 word arrays (canonicalised)."""
+    dt = data.dtype
+    if dt == jnp.bool_:
+        return [data.astype(jnp.uint32)]
+    if jnp.issubdtype(dt, jnp.floating):
+        data = jnp.where(data == 0, jnp.zeros((), dt), data)
+        data = jnp.where(jnp.isnan(data), jnp.full((), jnp.nan, dt), data)
+        if dt.itemsize < 4:
+            data = data.astype(jnp.float32)
+        bits = jax.lax.bitcast_convert_type(
+            data, jnp.uint32 if data.dtype.itemsize == 4 else jnp.uint64)
+    else:
+        bits = data
+    if bits.dtype.itemsize <= 4:
+        return [bits.astype(jnp.uint32)]
+    u64 = bits.astype(jnp.uint64)
+    return [(u64 & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32),
+            (u64 >> 32).astype(jnp.uint32)]
+
+
+def hash_columns(arrays: Sequence[jax.Array],
+                 validities: Sequence[jax.Array | None] | None = None,
+                 seed: int = 0x9747B28C) -> jax.Array:
+    """[capacity] uint32 row hash over one or more key columns.
+
+    Nulls hash as a distinct word stream (validity folded in) so that
+    null == null for partitioning, matching ``dense_group_ids``.
+    """
+    n = arrays[0].shape[0]
+    h = jnp.full(n, jnp.uint32(seed))
+    nwords = 0
+    for i, a in enumerate(arrays):
+        v = validities[i] if validities is not None else None
+        for w in _words32(a):
+            if v is not None:
+                # null payload bytes are arbitrary — zero them so all
+                # nulls hash identically
+                w = jnp.where(v, w, jnp.uint32(0))
+            h = _mix_word(h, w)
+            nwords += 1
+        if v is not None:
+            h = _mix_word(h, v.astype(jnp.uint32))
+            nwords += 1
+    h = h ^ jnp.uint32(4 * nwords)
+    return _fmix32(h)
+
+
+def partition_ids(arrays, num_partitions: int, validities=None) -> jax.Array:
+    """hash % world — parity: ``MapToHashPartitions``
+    (``partition/partition.cpp:93-174``)."""
+    return (hash_columns(arrays, validities) % jnp.uint32(num_partitions)
+            ).astype(jnp.int32)

@@ -1,0 +1,193 @@
+"""Shared kernel primitives: order keys, masked lexsort, compaction,
+row expansion, dense group ids, segment reduction.
+
+These replace the reference's comparator/kernel toolbox
+(``cpp/src/cylon/arrow/arrow_comparator.hpp:47-200`` TableRowComparator /
+RowEqualTo / TableRowIndexHash and ``arrow/arrow_kernels.hpp:24-147``
+split & index-sort kernels). The reference builds row-equality on
+composite murmur hashes + hash maps; here row identity comes from
+*lexicographic dense ranks* (sort-based, collision-free) because sorts
+are what XLA/TPU does well and data-dependent hash-probe loops are what
+it does badly.
+
+All functions are shape-static and jit-safe: tables are padded to
+``capacity`` and carry ``nrows``; padded rows are forced to sort last via
+an explicit padding sort-key.
+"""
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+_UINT_OF_WIDTH = {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32, 8: jnp.uint64}
+
+
+def order_key(data: jax.Array, ascending: bool = True) -> jax.Array:
+    """Map values to unsigned ints whose unsigned order == value order.
+
+    Replaces per-dtype comparators (``arrow_comparator.cpp``): signed ints
+    get the sign bit flipped, floats get the IEEE total-order transform
+    (NaN sorts above +inf), bools widen. ``ascending=False`` bit-inverts.
+    """
+    dt = data.dtype
+    if dt == jnp.bool_:
+        key = data.astype(jnp.uint8)
+    elif jnp.issubdtype(dt, jnp.unsignedinteger):
+        key = data
+    elif jnp.issubdtype(dt, jnp.signedinteger):
+        udt = _UINT_OF_WIDTH[dt.itemsize]
+        key = data.astype(udt) ^ udt(1 << (dt.itemsize * 8 - 1))
+    elif jnp.issubdtype(dt, jnp.floating):
+        udt = _UINT_OF_WIDTH[dt.itemsize]
+        # canonicalise so bit-identity == value-identity: -0.0 -> +0.0,
+        # any NaN payload -> the canonical NaN (keeps sort/hash/group
+        # equality consistent with numeric equality)
+        data = jnp.where(data == 0, jnp.zeros((), dt), data)
+        data = jnp.where(jnp.isnan(data), jnp.full((), jnp.nan, dt), data)
+        bits = jax.lax.bitcast_convert_type(data, udt)
+        sign = udt(1 << (dt.itemsize * 8 - 1))
+        # negative floats: flip all bits; positive: set sign bit
+        key = jnp.where(bits & sign != 0, ~bits, bits | sign)
+    else:
+        raise TypeError(f"unsortable dtype {dt}")
+    if not ascending:
+        key = ~key
+    return key
+
+
+def valid_mask(cap: int, nrows) -> jax.Array:
+    """[cap] bool valid-row mask. ``nrows`` is a scalar count ("first n
+    rows are valid") or already a bool mask (pass-through)."""
+    if isinstance(nrows, jax.Array) and nrows.ndim == 1:
+        return nrows
+    return jnp.arange(cap, dtype=jnp.int32) < nrows
+
+
+def sort_perm(keys: Sequence[jax.Array], nrows, *, ascending=True,
+              stable: bool = True) -> jax.Array:
+    """Permutation lexsorting rows by ``keys`` (priority = list order),
+    valid rows first, padding rows last. ``nrows``: scalar count or bool
+    valid-mask.
+
+    Parity: ``SortIndicesMultiColumns`` (``arrow_kernels.hpp:134-140``) and
+    ``util::SortTableMultiColumns`` (``util/arrow_utils.hpp:63-118``).
+    """
+    cap = keys[0].shape[0]
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    padding = (~valid_mask(cap, nrows)).astype(jnp.uint8)
+    if isinstance(ascending, bool):
+        ascending = [ascending] * len(keys)
+    operands = [padding] + [order_key(k, a) for k, a in zip(keys, ascending)]
+    out = jax.lax.sort(tuple(operands) + (iota,), num_keys=len(operands),
+                       is_stable=stable)
+    return out[-1]
+
+
+def inverse_perm(perm: jax.Array) -> jax.Array:
+    cap = perm.shape[0]
+    return jnp.zeros(cap, jnp.int32).at[perm].set(
+        jnp.arange(cap, dtype=jnp.int32), mode="drop")
+
+
+def compact_mask(mask: jax.Array, nrows) -> tuple[jax.Array, jax.Array]:
+    """Stable-partition selected valid rows to the front.
+
+    Returns ``(perm, count)``: ``perm[:count]`` lists the selected row
+    indices in original order. Replaces the reference's per-dtype scatter
+    split kernels (``ArrowArraySplitKernel``, ``arrow_kernels.hpp:24``).
+    """
+    cap = mask.shape[0]
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    valid = mask & (iota < nrows)
+    keep = (~valid).astype(jnp.uint8)  # 0 = keep -> sorts first; stable
+    _, perm = jax.lax.sort((keep, iota), num_keys=1)
+    return perm, valid.sum(dtype=jnp.int32)
+
+
+def exclusive_cumsum(x: jax.Array) -> jax.Array:
+    return jnp.cumsum(x) - x
+
+
+def expand_rows(counts: jax.Array, out_capacity: int
+                ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Run-length expansion: row i repeated counts[i] times, in order.
+
+    Returns ``(parent, within, total)`` where for output slot j < total,
+    ``parent[j]`` is the source row and ``within[j]`` its repeat index.
+    This is the static-shape engine behind join result materialisation
+    (replacing the reference's dynamic index vectors,
+    ``join/join_utils.hpp:34`` build_final_table).
+    """
+    offs = exclusive_cumsum(counts)
+    total = offs[-1] + counts[-1] if counts.shape[0] else jnp.int32(0)
+    j = jnp.arange(out_capacity, dtype=counts.dtype)
+    parent = jnp.searchsorted(offs, j, side="right").astype(jnp.int32) - 1
+    parent = jnp.clip(parent, 0, max(counts.shape[0] - 1, 0))
+    within = j - offs[parent]
+    return parent, within, total.astype(jnp.int32)
+
+
+def dense_group_ids(keys: Sequence[jax.Array], nrows,
+                    validities: Sequence[jax.Array | None] | None = None
+                    ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Assign each valid row a dense id in [0, num_groups) such that two
+    rows share an id iff their key tuples are equal; ids are ordered by
+    key rank (lexicographic). Padding rows get id == capacity (one past
+    any real id, safe to drop in segment ops). ``nrows``: scalar count or
+    bool valid-mask.
+
+    Returns ``(gid [cap], num_groups, perm)`` with ``perm`` the lexsort
+    permutation used (valid rows first).
+
+    Null semantics: a null key equals another null (pandas groupby/merge
+    semantics) — validity participates as an extra key column.
+    Replaces ``TableRowIndexHash`` + flat_hash_map group building
+    (``groupby/hash_groupby.cpp:90`` make_groups).
+    """
+    cap = keys[0].shape[0]
+    # normalise to unsigned order-keys so equality is bitwise (canonical
+    # NaN == NaN, -0.0 == +0.0) — raw float compare would split NaN keys
+    # into singleton groups. Null slots carry arbitrary payload bytes
+    # (e.g. clipped gathers from outer joins), so zero them before
+    # comparing: null identity must not depend on payload.
+    full_keys = []
+    for i, k in enumerate(keys):
+        v = validities[i] if validities is not None else None
+        nk = order_key(k)
+        if v is not None:
+            nk = jnp.where(v, nk, jnp.zeros((), nk.dtype))
+        full_keys.append(nk)
+    if validities is not None:
+        for v in validities:
+            if v is not None:
+                full_keys.append(v.astype(jnp.uint8))
+    vmask = valid_mask(cap, nrows)
+    total_valid = vmask.sum(dtype=jnp.int32)
+    perm = sort_perm(full_keys, vmask)
+    sorted_keys = [k[perm] for k in full_keys]
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    # perm puts valid rows first, so sorted position i is valid iff i < total
+    valid_sorted = iota < total_valid
+    neq_prev = jnp.zeros(cap, dtype=jnp.bool_)
+    for k in sorted_keys:
+        neq_prev = neq_prev | (k != jnp.roll(k, 1))
+    boundary = jnp.where(iota == 0, True, neq_prev) & valid_sorted
+    gid_sorted = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+    # padding positions contribute no boundaries, so the running cumsum at
+    # [-1] equals the count over valid rows even when padding exists
+    num_groups = jnp.where(total_valid > 0, gid_sorted[-1] + 1,
+                           0).astype(jnp.int32)
+    gid_sorted = jnp.where(valid_sorted, gid_sorted, cap)
+    gid = jnp.zeros(cap, jnp.int32).at[perm].set(gid_sorted, mode="drop")
+    return gid, num_groups, perm
+
+
+def _acc_dtype(dt):
+    if jnp.issubdtype(dt, jnp.floating):
+        return dt if dt.itemsize >= 4 else jnp.float32
+    if jnp.issubdtype(dt, jnp.unsignedinteger):
+        return jnp.uint64
+    if dt == jnp.bool_:
+        return jnp.int64
+    return jnp.int64

@@ -1,0 +1,159 @@
+"""Row selection / movement ops: take, filter, sort, concat, head, sample.
+
+Reference analogs: ``Table::Project/Select`` and friends
+(``cpp/src/cylon/table.cpp``), the split/copy kernels
+(``arrow/arrow_kernels.cpp``, ``util/copy_arrray.cpp``) and
+``util::SortTable[MultiColumns]`` (``util/arrow_utils.hpp:63-118``).
+Everything is a gather/scatter over padded arrays; row counts stay traced.
+"""
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from cylon_tpu.column import Column
+from cylon_tpu.errors import InvalidArgument
+from cylon_tpu.ops import kernels
+from cylon_tpu.table import Table
+
+
+def take_columns(table: Table, idx: jax.Array, nrows_out,
+                 null_mask: jax.Array | None = None,
+                 names: Sequence[str] | None = None) -> Table:
+    """Gather rows by index into a new table of capacity ``len(idx)``.
+
+    ``null_mask`` marks output slots whose row should be all-null (used for
+    non-matching sides of outer joins; reference builds these in
+    ``join/join_utils.cpp`` build_final_table with -1 indices).
+    """
+    safe = jnp.clip(idx, 0, max(table.capacity - 1, 0))
+    cols = {}
+    for name in (names if names is not None else table.column_names):
+        c = table.column(name)
+        data = c.data[safe]
+        validity = None if c.validity is None else c.validity[safe]
+        if null_mask is not None:
+            base = jnp.ones_like(null_mask) if validity is None else validity
+            validity = base & ~null_mask
+            # canonicalise injected-null payloads (the clipped gather
+            # leaves arbitrary bytes otherwise)
+            nm = null_mask.reshape(null_mask.shape + (1,) * (data.ndim - 1))
+            data = jnp.where(nm, jnp.zeros((), data.dtype), data)
+        cols[name] = Column(data, validity, c.dtype, c.dictionary)
+    return Table(cols, nrows_out)
+
+
+def filter_table(table: Table, mask: jax.Array) -> Table:
+    """Keep rows where mask is True, preserving order (parity: the
+    filter path of ``python/pycylon/data/compute.pyx:212``)."""
+    perm, count = kernels.compact_mask(mask, table.nrows)
+    return take_columns(table, perm, count)
+
+
+def sort_table(table: Table, by: Sequence[str], ascending=True,
+               na_position: str = "last") -> Table:
+    """Lexicographic multi-column sort (parity: ``Table::Sort`` /
+    ``util::SortTableMultiColumns``; pandas ``sort_values`` semantics:
+    NaN/null keys go last regardless of direction)."""
+    if isinstance(ascending, bool):
+        ascending = [ascending] * len(by)
+    keys = []
+    dirs = []
+    for name, asc in zip(by, ascending):
+        c = table.column(name)
+        nulls = _null_flags(c)
+        if nulls is not None:
+            # flag ascending (0 < 1) puts nulls last
+            keys.append(nulls)
+            dirs.append(na_position == "last")
+        keys.append(c.data)
+        dirs.append(asc)
+    perm = kernels.sort_perm(keys, table.nrows, ascending=dirs)
+    return take_columns(table, perm, table.nrows)
+
+
+def _null_flags(c: Column) -> jax.Array | None:
+    """uint8 1 where the value is missing (validity or float NaN)."""
+    flags = None
+    if c.validity is not None:
+        flags = (~c.validity).astype(jnp.uint8)
+    if jnp.issubdtype(c.data.dtype, jnp.floating):
+        nan = jnp.isnan(c.data).astype(jnp.uint8)
+        flags = nan if flags is None else flags | nan
+    return flags
+
+
+def concat_tables(tables: Sequence[Table], capacity: int | None = None) -> Table:
+    """Row-wise concatenation (parity: ``Table::Merge`` / pycylon
+    ``concat``, ``table.pyx:2368``). Schemas must match by name & dtype;
+    dictionary columns are re-encoded onto a shared dictionary first
+    (host-side metadata op)."""
+    from cylon_tpu.ops.dictenc import unify_table_dictionaries
+
+    if not tables:
+        raise InvalidArgument("concat of no tables")
+    names = tables[0].column_names
+    for t in tables[1:]:
+        if t.column_names != names:
+            raise InvalidArgument(
+                f"schema mismatch: {t.column_names} vs {names}")
+    tables = unify_table_dictionaries(tables)
+    cap_out = capacity if capacity is not None else sum(t.capacity for t in tables)
+
+    nrows_list = [t.nrows for t in tables]
+    total = jnp.int32(0)
+    offsets = []
+    for n in nrows_list:
+        offsets.append(total)
+        total = total + n
+
+    cols = {}
+    for name in names:
+        c0 = tables[0].column(name)
+        any_validity = any(t.column(name).validity is not None for t in tables)
+        data = jnp.zeros((cap_out,) + c0.data.shape[1:], dtype=c0.data.dtype)
+        validity = jnp.zeros(cap_out, bool) if any_validity else None
+        for t, off in zip(tables, offsets):
+            c = t.column(name)
+            if c.data.dtype != c0.data.dtype:
+                raise InvalidArgument(
+                    f"dtype mismatch in column {name}: "
+                    f"{c.data.dtype} vs {c0.data.dtype}")
+            pos = jnp.arange(t.capacity, dtype=jnp.int32)
+            dest = jnp.where(pos < t.nrows, off + pos, cap_out)
+            data = data.at[dest].set(c.data, mode="drop")
+            if validity is not None:
+                v = (jnp.ones(t.capacity, bool) if c.validity is None
+                     else c.validity)
+                validity = validity.at[dest].set(v, mode="drop")
+        cols[name] = Column(data, validity, c0.dtype, c0.dictionary)
+    return Table(cols, total)
+
+
+def head(table: Table, n: int) -> Table:
+    """First n valid rows (valid rows are always the leading rows)."""
+    return table.with_nrows(jnp.minimum(table.nrows, n))
+
+
+def sample(table: Table, n: int) -> Table:
+    """Deterministic systematic sample of up to ``n`` rows — the sampling
+    primitive behind distributed range partitioning (parity:
+    ``util::SampleArray``, ``util/arrow_utils.hpp``; the reference also
+    samples rather than using all rows, ``arrow_partition_kernels.cpp:377``)."""
+    nr = table.nrows
+    take_n = jnp.minimum(nr, n)
+    # stride so samples spread over [0, nrows)
+    pos = jnp.arange(n, dtype=jnp.float32)
+    idx = jnp.where(take_n > 0,
+                    (pos * nr.astype(jnp.float32)
+                     / jnp.maximum(take_n, 1).astype(jnp.float32)),
+                    0).astype(jnp.int32)
+    idx = jnp.clip(idx, 0, jnp.maximum(nr - 1, 0))
+    return take_columns(table, idx, take_n)
+
+
+def take(table: Table, idx: jax.Array) -> Table:
+    """Public gather-by-indices (parity: arrow Take used throughout
+    reference join/sort paths)."""
+    return take_columns(table, idx, idx.shape[0])

@@ -66,8 +66,16 @@ class Table:
     @property
     def num_rows(self) -> int:
         """Concrete row count (syncs device->host; not usable under trace).
-        Parity: ``table.hpp`` Rows()."""
-        return int(self.nrows)
+        Parity: ``table.hpp`` Rows(). Raises OutOfCapacity if a
+        capacity-bounded kernel overflowed its static result bound."""
+        n = int(self.nrows)
+        if n > self.capacity:
+            from cylon_tpu.errors import OutOfCapacity
+
+            raise OutOfCapacity(
+                f"result has {n} rows but static capacity is "
+                f"{self.capacity}; re-run with a larger out_capacity")
+        return n
 
     @property
     def num_columns(self) -> int:
@@ -230,8 +238,12 @@ class Table:
         return np.stack([c.to_numpy(n) for c in self._columns.values()], axis=1)
 
     def __repr__(self):
+        from cylon_tpu.errors import OutOfCapacity
+
         try:
             n = str(self.num_rows)
+        except OutOfCapacity:
+            n = f"OVERFLOW({int(self.nrows)})"
         except Exception:
             n = "<traced>"
         schema = ", ".join(f"{name}: {c.dtype!r}" for name, c in self._columns.items())
