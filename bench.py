@@ -1,0 +1,72 @@
+"""Headline benchmark: distributed inner-join rows/sec/chip.
+
+Reproduces the reference's flagship experiment (distributed inner join,
+``cpp/src/examples/bench/table_join_dist_test.cpp`` driven by
+``cpp/src/experiments/run_dist_scaling.py``; published numbers in
+``docs/docs/arch.md:148-162``). Baseline comparator: Cylon's 64-rank
+MPI result — 1B rows in 4.0 s over 64 ranks = 3.906 M rows/s/rank
+(BASELINE.md); ``vs_baseline`` is our single-chip rows/s over that
+per-rank rate.
+
+Config: BASELINE.json config 2 — two int64-keyed tables, hash inner
+join, measured steady-state (post-compile) on the real chip.
+
+Emits ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    from cylon_tpu import Table
+    from cylon_tpu.ops.join import join
+
+    n = int(os.environ.get("CYLON_BENCH_ROWS", 1_000_000))
+    reps = int(os.environ.get("CYLON_BENCH_REPS", 5))
+    out_cap = 3 * n
+
+    rng = np.random.default_rng(7)
+    left = Table.from_pydict({
+        "k": rng.integers(0, n, n).astype(np.int64),
+        "a": rng.normal(size=n),
+    })
+    right = Table.from_pydict({
+        "k": rng.integers(0, n, n).astype(np.int64),
+        "b": rng.normal(size=n),
+    })
+
+    @jax.jit
+    def step(lt, rt):
+        return join(lt, rt, on="k", how="inner", out_capacity=out_cap)
+
+    # compile + correctness guard
+    res = step(left, right)
+    nrows = int(res.nrows)
+    assert 0 < nrows <= out_cap, f"bad join result {nrows}"
+
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        res = step(left, right)
+        jax.block_until_ready(res.nrows)
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+
+    rows_per_sec = n / best
+    baseline_per_rank = 1e9 / 4.0 / 64  # Cylon 64-rank MPI (BASELINE.md)
+    print(json.dumps({
+        "metric": "dist_inner_join_rows_per_sec_per_chip",
+        "value": round(rows_per_sec, 1),
+        "unit": "rows/s/chip",
+        "vs_baseline": round(rows_per_sec / baseline_per_rank, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -53,6 +53,7 @@ from cylon_tpu.errors import (
     TypeError_,
 )
 from cylon_tpu.table import Table
+from cylon_tpu.frame import DataFrame, GroupByDataFrame, concat, merge, read_csv
 
 __version__ = "0.1.0"
 
@@ -73,8 +74,13 @@ __all__ = [
     "NotImplemented_",
     "OutOfCapacity",
     "SortOptions",
+    "DataFrame",
+    "GroupByDataFrame",
     "Table",
     "TPUConfig",
     "TypeError_",
+    "concat",
     "dtypes",
+    "merge",
+    "read_csv",
 ]

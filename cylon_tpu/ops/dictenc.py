@@ -56,3 +56,22 @@ def unify_table_dictionaries(tables: list[Table]) -> list[Table]:
         for i, c in enumerate(unified):
             new_cols[i][name] = c
     return [Table(new_cols[i], t.nrows) for i, t in enumerate(tables)]
+
+
+def encode_fill_value(col: Column, value):
+    """Resolve ``value`` to a code of ``col``'s dictionary, extending and
+    re-sorting the dictionary (with a device-side code remap) when the
+    value is absent. Used by fillna on string columns."""
+    values = col.dictionary.values if col.dictionary is not None \
+        else np.array([], dtype=object)
+    hit = np.where(values == value)[0]
+    if len(hit):
+        return col, int(hit[0])
+    merged = np.unique(np.concatenate([values, np.array([value], object)]))
+    remap = np.searchsorted(merged, values).astype(np.int32)
+    code = int(np.searchsorted(merged, np.array([value], object))[0])
+    if len(remap):
+        codes = jnp.asarray(remap)[jnp.clip(col.data, 0, len(remap) - 1)]
+    else:
+        codes = jnp.zeros_like(col.data)
+    return Column(codes, col.validity, col.dtype, Dictionary(merged)), code

@@ -28,9 +28,10 @@ from cylon_tpu.ops import kernels
 from cylon_tpu.ops.selection import _null_flags, take_columns
 from cylon_tpu.table import Table
 
-#: ops supported (parity: aggregate_kernels.hpp:40-52 + pandas extras)
+#: ops supported (parity: aggregate_kernels.hpp:40-52 + pandas extras).
+#: "sumsq" is internal — the mergeable partial for distributed var/std.
 AGG_OPS = ("sum", "count", "size", "min", "max", "mean", "var", "std",
-           "nunique", "first", "last", "median", "quantile")
+           "nunique", "first", "last", "median", "quantile", "sumsq")
 
 
 def groupby_aggregate(table: Table, by: Sequence[str],
@@ -98,6 +99,11 @@ def _aggregate_column(table: Table, src: str, op: str, gid, num_groups,
         data = jax.ops.segment_sum(vals.astype(acc), gid_v,
                                    num_segments=out_cap)
         return Column(data, None, dtypes.from_numpy_dtype(acc))
+    if op == "sumsq":
+        f = jnp.float64 if c.data.dtype.itemsize >= 4 else jnp.float32
+        vals = jnp.where(value_ok, c.data.astype(f), 0.0)
+        data = jax.ops.segment_sum(vals * vals, gid_v, num_segments=out_cap)
+        return Column(data, None, dtypes.from_numpy_dtype(f))
     if op in ("min", "max"):
         if c.dtype.is_dictionary:
             # codes are order-preserving, so min/max of codes is correct

@@ -1,0 +1,58 @@
+"""Distributed execution: mesh-sharded tables, shuffle, collectives.
+
+This package replaces the reference's entire ``cpp/src/cylon/net/`` stack
+(L0-L3 of SURVEY.md): MPI/UCX channels (``net/mpi/mpi_channel.cpp``,
+``net/ucx/ucx_channel.cpp``), the async AllToAll state machine
+(``net/ops/all_to_all.cpp``) and the Arrow-aware table exchange
+(``arrow/arrow_all_to_all.cpp``). On TPU none of that machinery exists as
+code you write: the "communicator" is the XLA runtime, a "channel" is an
+ICI link, and the table shuffle is a two-phase
+count-exchange + ``all_to_all`` collective emitted by one ``shard_map``
+program. Progress loops, finish protocols, tag matching, buffer
+allocators — all collapse into the compiler's collective scheduling.
+"""
+
+from cylon_tpu.parallel.collectives import all_reduce, ReduceOp
+from cylon_tpu.parallel.dtable import (
+    dist_num_rows,
+    dist_row_mask,
+    gather_table,
+    is_distributed,
+    local_capacity,
+    scatter_table,
+    dist_to_pandas,
+)
+from cylon_tpu.parallel.dist_ops import (
+    dist_aggregate,
+    dist_groupby,
+    dist_intersect,
+    dist_join,
+    dist_sort,
+    dist_subtract,
+    dist_union,
+    dist_unique,
+    repartition,
+    shuffle,
+)
+
+__all__ = [
+    "ReduceOp",
+    "all_reduce",
+    "dist_aggregate",
+    "dist_groupby",
+    "dist_intersect",
+    "dist_join",
+    "dist_num_rows",
+    "dist_row_mask",
+    "dist_sort",
+    "dist_subtract",
+    "dist_to_pandas",
+    "dist_union",
+    "dist_unique",
+    "gather_table",
+    "is_distributed",
+    "local_capacity",
+    "repartition",
+    "scatter_table",
+    "shuffle",
+]

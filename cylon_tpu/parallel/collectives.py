@@ -1,0 +1,72 @@
+"""Scalar/array collectives over the worker axis.
+
+Parity: ``cpp/src/cylon/net/comm_operations.hpp:27-31`` (ReduceOp) and
+``net/mpi/mpi_operations.{hpp,cpp}`` (``mpi::AllReduce``, GetMPIOp /
+GetMPIDataType dispatch). The MPI datatype/op mapping tables disappear:
+XLA collectives are polymorphic over dtype, and the op dispatch is a
+function table here. All functions must be called inside ``shard_map``
+over the worker axis.
+"""
+
+import enum
+
+import jax
+import jax.numpy as jnp
+
+from cylon_tpu.context import WORKER_AXIS
+
+
+class ReduceOp(enum.Enum):
+    """Parity: ``net/comm_operations.hpp`` ReduceOp."""
+
+    SUM = "sum"
+    MIN = "min"
+    MAX = "max"
+    PROD = "prod"
+    LAND = "land"
+    LOR = "lor"
+    BAND = "band"
+    BOR = "bor"
+
+
+def all_reduce(x, op: ReduceOp | str = ReduceOp.SUM,
+               axis_name: str = WORKER_AXIS):
+    """AllReduce over the mesh axis (parity: ``mpi::AllReduce``,
+    ``net/mpi/mpi_operations.cpp:37``)."""
+    op = ReduceOp(op) if not isinstance(op, ReduceOp) else op
+    if op == ReduceOp.SUM:
+        return jax.lax.psum(x, axis_name)
+    if op == ReduceOp.MIN:
+        return jax.lax.pmin(x, axis_name)
+    if op == ReduceOp.MAX:
+        return jax.lax.pmax(x, axis_name)
+    if op == ReduceOp.PROD:
+        # no pprod primitive: log-sum-exp style via all_gather product
+        return jax.lax.all_gather(x, axis_name).prod(axis=0)
+    if op in (ReduceOp.LAND, ReduceOp.BAND):
+        return jax.lax.all_gather(x, axis_name).all(axis=0) \
+            if op == ReduceOp.LAND \
+            else _fold_gather(x, axis_name, jnp.bitwise_and)
+    if op in (ReduceOp.LOR, ReduceOp.BOR):
+        return jax.lax.all_gather(x, axis_name).any(axis=0) \
+            if op == ReduceOp.LOR \
+            else _fold_gather(x, axis_name, jnp.bitwise_or)
+    raise ValueError(op)
+
+
+def _fold_gather(x, axis_name, fn):
+    g = jax.lax.all_gather(x, axis_name)
+    out = g[0]
+    for i in range(1, g.shape[0]):
+        out = fn(out, g[i])
+    return out
+
+
+def rank(axis_name: str = WORKER_AXIS):
+    """This shard's worker index (parity: ``CylonContext::GetRank``)."""
+    return jax.lax.axis_index(axis_name)
+
+
+def world(axis_name: str = WORKER_AXIS) -> int:
+    """Static world size inside shard_map."""
+    return jax.lax.axis_size(axis_name)

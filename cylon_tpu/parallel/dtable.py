@@ -1,0 +1,121 @@
+"""Distributed table representation and host bridges.
+
+A distributed table IS a :class:`cylon_tpu.table.Table` whose
+
+- column arrays have global shape ``[W * local_capacity, ...]``, sharded
+  over the mesh's worker axis on dim 0 (shard s owns rows
+  ``[s*local_cap, (s+1)*local_cap)``), and
+- ``nrows`` is an int32 vector of shape ``[W]`` — the per-shard valid row
+  counts (shard s's valid rows are the leading ``nrows[s]`` of its block).
+
+This replaces the reference's "one Arrow table per MPI rank" model
+(SPMD ranks, ``docs/docs/arch.md:41-48``) with a single-controller global
+view; ``scatter_table`` is the moral equivalent of the per-rank CSV read
+split, and ``gather_table`` of gathering ranks' outputs.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cylon_tpu.column import Column
+from cylon_tpu.context import CylonEnv
+from cylon_tpu.errors import InvalidArgument
+from cylon_tpu.table import Table
+
+
+def is_distributed(table: Table) -> bool:
+    return getattr(table.nrows, "ndim", 0) == 1
+
+
+def num_shards(table: Table) -> int:
+    return table.nrows.shape[0]
+
+
+def local_capacity(table: Table) -> int:
+    w = num_shards(table)
+    cap = table.capacity
+    if cap % w:
+        raise InvalidArgument(f"capacity {cap} not divisible by world {w}")
+    return cap // w
+
+
+def dist_num_rows(table: Table) -> int:
+    """Total valid rows across shards (host sync). Raises OutOfCapacity
+    if any shard overflowed its local buffer."""
+    counts = np.asarray(table.nrows)
+    cap_l = local_capacity(table)
+    if (counts > cap_l).any():
+        from cylon_tpu.errors import OutOfCapacity
+
+        raise OutOfCapacity(
+            f"shard row counts {counts.tolist()} exceed local capacity "
+            f"{cap_l}; re-run with a larger out_capacity / skew factor")
+    return int(counts.sum())
+
+
+def dist_row_mask(table: Table) -> jax.Array:
+    """[capacity] bool — valid rows in the block-interleaved layout."""
+    cap_l = local_capacity(table)
+    w = num_shards(table)
+    pos = jnp.arange(w * cap_l, dtype=jnp.int32)
+    return (pos % cap_l) < table.nrows[pos // cap_l]
+
+
+def scatter_table(env: CylonEnv, table: Table,
+                  local_cap: int | None = None) -> Table:
+    """Partition a local (scalar-nrows) table into W contiguous row
+    blocks and lay it out on the mesh.
+
+    Because valid rows are already the leading rows, scattering is just
+    zero-padding the capacity to ``W * local_cap`` and computing per-shard
+    counts — no data movement beyond the device_put.
+    """
+    if is_distributed(table):
+        return table
+    w = env.world_size
+    n = table.nrows  # may be traced
+    cap = table.capacity
+    if local_cap is None:
+        local_cap = -(-cap // w)  # ceil
+    padded = table.with_capacity(w * local_cap)
+    shard_ids = jnp.arange(w, dtype=jnp.int32)
+    shard_rows = jnp.clip(n - shard_ids * local_cap, 0, local_cap)
+    out = padded.with_nrows(shard_rows.astype(jnp.int32))
+    return device_put_table(env, out)
+
+
+def device_put_table(env: CylonEnv, table: Table) -> Table:
+    """Apply row-sharding constraints to every column (nrows replicated is
+    wrong — it is [W], sharded one element per worker)."""
+    row = env.row_sharding
+    cols = {}
+    for name, c in table.columns.items():
+        data = jax.device_put(c.data, row)
+        validity = None if c.validity is None else jax.device_put(c.validity, row)
+        cols[name] = Column(data, validity, c.dtype, c.dictionary)
+    nrows = jax.device_put(table.nrows, row)
+    return Table(cols, nrows)
+
+
+def gather_table(env: "CylonEnv | None", table: Table) -> Table:
+    """Distributed -> local: compact every shard's valid rows to the
+    front of one global buffer (single XLA program, no shard_map; env is
+    accepted for API symmetry but not needed)."""
+    if not is_distributed(table):
+        return table
+    from cylon_tpu.ops import kernels
+    from cylon_tpu.ops.selection import take_columns
+
+    mask = dist_row_mask(table)
+    total = table.nrows.sum().astype(jnp.int32)
+    keep = (~mask).astype(jnp.uint8)
+    iota = jnp.arange(table.capacity, dtype=jnp.int32)
+    _, perm = jax.lax.sort((keep, iota), num_keys=1)
+    flat = table.with_nrows(total)  # scalar-nrows view for take
+    return take_columns(flat, perm, total)
+
+
+def dist_to_pandas(env: "CylonEnv | None", table: Table):
+    """Host materialisation of a distributed table (shard order)."""
+    return gather_table(env, table).to_pandas()

@@ -1,0 +1,213 @@
+"""The table shuffle: variable-size all-to-all row exchange on the mesh.
+
+This is the single most load-bearing component (SURVEY.md §3.2 hot path)
+— the replacement for the reference's entire streaming exchange stack:
+``AllToAll`` send-queue state machine (``net/ops/all_to_all.hpp:65-170``),
+the per-column per-buffer wire protocol with 6-int headers
+(``arrow/arrow_all_to_all.cpp:100-108``), and the MPI_Isend/Irecv/MPI_Test
+progress loops (``net/mpi/mpi_channel.cpp:79-158``).
+
+TPU-first two-phase design (no headers, no progress loop, no allocator):
+
+1. **Count exchange** — every shard bucket-counts its rows by destination
+   and ``all_gather``s the [W] count vector, giving all shards the full
+   W×W count matrix (the reference learns sizes incrementally from
+   per-message headers; on TPU one 4·W² byte collective replaces that).
+2. **Payload exchange** — rows are grouped by destination with one sort,
+   then exchanged either by
+   - ``lax.ragged_all_to_all`` (TPU: DMA of exactly the bytes needed), or
+   - padded ``lax.all_to_all`` with a static per-pair bucket (portable:
+     XLA:CPU lacks ragged-all-to-all; also the fallback if skew bounds
+     are known), then compacted.
+
+Everything is inside one ``shard_map`` program: the count exchange, the
+payload collective and the surrounding compute fuse into a single XLA
+executable — there is nothing like the reference's
+``finish(); while(!isComplete());`` host spin (``table.cpp:108-110``).
+
+All functions here are *shard-local*: they must be called inside
+``shard_map`` over the worker axis.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+from cylon_tpu.column import Column
+from cylon_tpu.context import WORKER_AXIS
+from cylon_tpu.ops import kernels
+from cylon_tpu.table import Table
+
+
+def _use_ragged() -> bool:
+    mode = os.environ.get("CYLON_TPU_SHUFFLE", "auto")
+    if mode == "ragged":
+        return True
+    if mode == "padded":
+        return False
+    return jax.default_backend() not in ("cpu",)
+
+
+def exchange_arrays(arrays, pid, n_local, out_cap: int,
+                    bucket_cap: int | None = None,
+                    axis_name: str = WORKER_AXIS):
+    """Send row i of every array to shard pid[i]; receive peers' rows.
+
+    arrays: list of [cap_local(, ...)] arrays sharing the row dim.
+    pid:    [cap_local] int32 destination shard per row.
+    n_local: scalar int32 — valid leading rows.
+    out_cap: static local receive capacity.
+    bucket_cap: static per-(sender,dest) bound for the padded path
+        (default out_cap // W).
+
+    Returns (out_arrays, n_recv) — n_recv is the *true* row count, which
+    may exceed out_cap (or bucket overflow may have dropped rows); both
+    conditions are folded into n_recv so ``dist_num_rows`` raises.
+    Received rows are grouped by sender rank, preserving each sender's
+    local order (deterministic, like the reference's tag-ordered streams).
+    """
+    w = jax.lax.axis_size(axis_name)
+    me = jax.lax.axis_index(axis_name)
+    cap = pid.shape[0]
+    iota = jnp.arange(cap, dtype=jnp.int32)
+    valid = iota < n_local
+    pid = jnp.where(valid, pid, w).astype(jnp.int32)
+
+    # group rows by destination (one stable sort, parity with the
+    # reference's per-target Split kernels, partition/partition.cpp:26)
+    order = kernels.sort_perm([pid], valid)
+    pid_sorted = pid[order]
+    counts = jax.ops.segment_sum(jnp.ones(cap, jnp.int32), pid,
+                                 num_segments=w)
+    cmat = jax.lax.all_gather(counts, axis_name)          # [W sender, W dest]
+    recv_sizes = cmat[:, me]
+    n_recv_true = recv_sizes.sum()
+
+    if _use_ragged():
+        in_offs = kernels.exclusive_cumsum(counts)
+        # offset of MY block inside each destination's receive buffer:
+        # sum of earlier senders' contributions to that destination
+        out_offs = (jnp.cumsum(cmat, axis=0) - cmat)[me, :]
+        outs = []
+        for a in arrays:
+            a_sorted = a[order]
+            transport, restore = _transportable(a_sorted)
+            buf = jnp.zeros((out_cap,) + transport.shape[1:], transport.dtype)
+            res = jax.lax.ragged_all_to_all(
+                transport, buf, in_offs, counts, out_offs, recv_sizes,
+                axis_name=axis_name)
+            outs.append(restore(res))
+        n_recv = jnp.where(n_recv_true > out_cap, out_cap + 1, n_recv_true)
+        return outs, n_recv.astype(jnp.int32)
+
+    # ---- padded path: [W, bucket_cap] blocks, plain all_to_all ----
+    # default bucket = sender capacity: always lossless (a sender can at
+    # most route its whole block to one destination). Transient memory is
+    # W*cap rows; pass a tighter bucket_cap when the key distribution is
+    # known to be balanced (e.g. hash shuffles of high-cardinality keys).
+    b = bucket_cap if bucket_cap is not None else cap
+    start = kernels.exclusive_cumsum(counts)
+    pid_safe = jnp.clip(pid_sorted, 0, w - 1)
+    within = jnp.arange(cap, dtype=jnp.int32) - start[pid_safe]
+    slot = jnp.where((pid_sorted < w) & (within < b),
+                     pid_safe * b + within, w * b)      # w*b = dropped
+    overflow_local = (counts > b).any()
+
+    recv_block_sizes = jnp.minimum(recv_sizes, b)
+    pos = jnp.arange(w * b, dtype=jnp.int32)
+    recv_valid = (pos % b) < recv_block_sizes[pos // b]
+    keep = (~recv_valid).astype(jnp.uint8)
+
+    outs = []
+    compact_perm = None
+    for a in arrays:
+        a_sorted = a[order]
+        transport, restore = _transportable(a_sorted)
+        buf = jnp.zeros((w * b,) + transport.shape[1:], transport.dtype)
+        buf = buf.at[slot].set(transport, mode="drop")
+        swapped = jax.lax.all_to_all(buf.reshape((w, b) + transport.shape[1:]),
+                                     axis_name, split_axis=0, concat_axis=0)
+        flat = swapped.reshape((w * b,) + transport.shape[1:])
+        if compact_perm is None:
+            _, compact_perm = jax.lax.sort(
+                (keep, jnp.arange(w * b, dtype=jnp.int32)), num_keys=1)
+        compacted = flat[compact_perm]
+        if w * b >= out_cap:
+            compacted = compacted[:out_cap]
+        else:
+            pad = jnp.zeros((out_cap - w * b,) + transport.shape[1:],
+                            transport.dtype)
+            compacted = jnp.concatenate([compacted, pad])
+        outs.append(restore(compacted))
+
+    # fold all failure modes into an impossible row count:
+    # - a (sender,dest) bucket overflowed somewhere (psum of flags)
+    # - total received exceeds the output buffer
+    any_overflow = jax.lax.psum(overflow_local.astype(jnp.int32),
+                                axis_name) > 0
+    n_recv = jnp.where(any_overflow | (n_recv_true > out_cap),
+                       out_cap + 1, n_recv_true)
+    return outs, n_recv.astype(jnp.int32)
+
+
+def checked_recv(table: Table, out_cap: int):
+    """Split a shuffled table into (usable table, overflow flag).
+
+    ``shuffle_local`` encodes overflow as ``nrows == out_cap + 1``; any
+    op consuming the table inside the same fused program must clamp the
+    count (the data is truncated anyway) and carry the flag forward with
+    :func:`poison` so the host-side ``dist_num_rows`` check still fires.
+    """
+    of = table.nrows > out_cap
+    return table.with_nrows(jnp.minimum(table.nrows, out_cap)), of
+
+
+def poison(table: Table, *flags):
+    """Mark a result table invalid (nrows > capacity) if any upstream
+    shuffle on this shard overflowed."""
+    bad = flags[0]
+    for f in flags[1:]:
+        bad = bad | f
+    return table.with_nrows(
+        jnp.where(bad, jnp.int32(table.capacity + 1),
+                  jnp.minimum(table.nrows, jnp.int32(table.capacity + 1))))
+
+
+def _transportable(a):
+    """bool arrays ride collectives as uint8."""
+    if a.dtype == jnp.bool_:
+        return a.astype(jnp.uint8), lambda x: x.astype(jnp.bool_)
+    return a, lambda x: x
+
+
+def shuffle_local(table: Table, pid, out_cap: int,
+                  bucket_cap: int | None = None,
+                  axis_name: str = WORKER_AXIS) -> Table:
+    """Shard-local table shuffle: every valid row moves to shard pid[row].
+
+    The replacement for ``shuffle_table_by_hashing`` (``table.cpp:134``):
+    partition + split + exchange + concatenate collapse into one call.
+    ``table`` is the *local* view (scalar nrows) inside shard_map.
+    """
+    arrays = []
+    layout = []  # (name, has_validity)
+    for name, c in table.columns.items():
+        arrays.append(c.data)
+        if c.validity is not None:
+            arrays.append(c.validity)
+        layout.append((name, c.validity is not None))
+    outs, n_recv = exchange_arrays(arrays, pid, table.nrows, out_cap,
+                                   bucket_cap, axis_name)
+    cols = {}
+    i = 0
+    for name, has_v in layout:
+        c = table.columns[name]
+        data = outs[i]
+        i += 1
+        validity = None
+        if has_v:
+            validity = outs[i]
+            i += 1
+        cols[name] = Column(data, validity, c.dtype, c.dictionary)
+    return Table(cols, n_recv)

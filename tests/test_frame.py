@@ -1,0 +1,170 @@
+"""DataFrame facade tests (parity model: ``python/test/test_frame.py``,
+``test_df_dist_sorting.py`` — pandas as the oracle, env= dispatch)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from cylon_tpu import DataFrame, concat
+
+
+def _eq_unordered(got, want, cols=None):
+    cols = cols or list(want.columns)
+    got = got[cols].sort_values(cols).reset_index(drop=True)
+    want = want[cols].sort_values(cols).reset_index(drop=True)
+    pd.testing.assert_frame_equal(got, want, check_dtype=False)
+
+
+def test_construct_and_introspect():
+    df = DataFrame({"a": [1, 2, 3], "s": ["x", "y", "x"]})
+    assert df.columns == ["a", "s"]
+    assert df.shape == (3, 2)
+    assert len(df) == 3
+    pd.testing.assert_frame_equal(
+        df.to_pandas(), pd.DataFrame({"a": [1, 2, 3], "s": ["x", "y", "x"]}))
+
+
+def test_merge_local_vs_pandas(rng):
+    ldf = pd.DataFrame({"k": rng.integers(0, 10, 50), "a": rng.normal(size=50)})
+    rdf = pd.DataFrame({"k": rng.integers(0, 10, 40), "b": rng.normal(size=40)})
+    got = DataFrame(ldf).merge(DataFrame(rdf), on="k", how="inner",
+                               out_capacity=4000).to_pandas()
+    want = ldf.merge(rdf, on="k")
+    assert len(got) == len(want)
+    _eq_unordered(got, want)
+
+
+def test_merge_distributed(env8, rng):
+    ldf = pd.DataFrame({"k": rng.integers(0, 20, 100), "a": rng.normal(size=100)})
+    rdf = pd.DataFrame({"k": rng.integers(0, 20, 80), "b": rng.normal(size=80)})
+    got = DataFrame(ldf).merge(DataFrame(rdf), on="k", how="inner",
+                               env=env8, out_capacity=20_000)
+    want = ldf.merge(rdf, on="k")
+    assert len(got) == len(want)
+    assert got.is_distributed
+    _eq_unordered(got.to_pandas(), want)
+
+
+def test_groupby_agg_dict_and_shortcuts(rng):
+    df = pd.DataFrame({"k": rng.integers(0, 5, 40), "v": rng.normal(size=40)})
+    cdf = DataFrame(df)
+    got = cdf.groupby("k").agg({"v": ["sum", "mean"]}).to_pandas()
+    want = df.groupby("k").agg(v_sum=("v", "sum"), v_mean=("v", "mean")) \
+        .reset_index()
+    pd.testing.assert_frame_equal(got, want, check_dtype=False)
+
+    got2 = cdf.groupby("k").sum().to_pandas()
+    want2 = df.groupby("k").sum().reset_index()
+    pd.testing.assert_frame_equal(got2, want2, check_dtype=False)
+
+
+def test_groupby_distributed(env8, rng):
+    df = pd.DataFrame({"k": rng.integers(0, 6, 60), "v": rng.normal(size=60)})
+    got = DataFrame(df).groupby("k", env=env8).agg({"v": "sum"}).to_pandas()
+    want = df.groupby("k").agg(v_sum=("v", "sum")).reset_index()
+    got = got.sort_values("k").reset_index(drop=True)
+    pd.testing.assert_frame_equal(got, want, check_dtype=False)
+
+
+def test_sort_values_local_and_dist(env8, rng):
+    df = pd.DataFrame({"a": rng.integers(0, 50, 80), "b": rng.normal(size=80)})
+    got = DataFrame(df).sort_values(["a", "b"]).to_pandas()
+    want = df.sort_values(["a", "b"]).reset_index(drop=True)
+    pd.testing.assert_frame_equal(got, want, check_dtype=False)
+
+    got = DataFrame(df).sort_values(["a", "b"], env=env8).to_pandas()
+    pd.testing.assert_frame_equal(got.reset_index(drop=True), want,
+                                  check_dtype=False)
+
+
+def test_drop_duplicates(rng):
+    df = pd.DataFrame({"a": rng.integers(0, 4, 30)})
+    got = DataFrame(df).drop_duplicates().to_pandas()
+    want = df.drop_duplicates().reset_index(drop=True)
+    pd.testing.assert_frame_equal(got, want, check_dtype=False)
+
+
+def test_filter_and_dunders():
+    df = DataFrame({"a": [1, 2, 3, 4], "b": [10.0, 20.0, 30.0, 40.0]})
+    mask = (df["a"] > 2).to_dict()["a"]
+    assert mask == [False, False, True, True]
+    got = df[df["a"] > 2].to_pandas()
+    assert got["a"].tolist() == [3, 4]
+    added = (df["a"] + 10).to_dict()["a"]
+    assert added == [11, 12, 13, 14]
+
+
+def test_setitem_and_reductions():
+    df = DataFrame({"a": [1.0, 2.0, 3.0]})
+    df["b"] = np.array([4.0, 5.0, 6.0])
+    assert df.columns == ["a", "b"]
+    s = df.sum()
+    assert s["a"] == 6.0 and s["b"] == 15.0
+    assert df.mean()["b"] == 5.0
+    assert df.count()["a"] == 3
+
+
+def test_reductions_distributed(env8, rng):
+    df = pd.DataFrame({"v": rng.normal(size=100)})
+    cdf = DataFrame(df, env=env8)
+    assert np.isclose(cdf.sum(env=env8)["v"], df["v"].sum())
+    assert cdf.count(env=env8)["v"] == 100
+
+
+def test_fillna_isnull():
+    df = DataFrame({"a": [1.0, np.nan, 3.0]})
+    assert df.isnull().to_dict()["a"] == [False, True, False]
+    assert df.fillna(0.0).to_dict()["a"] == [1.0, 0.0, 3.0]
+
+
+def test_isin():
+    df = DataFrame({"a": [1, 2, 3], "s": ["x", "y", "z"]})
+    got = df.isin([1, 3]).to_dict()["a"]
+    assert got == [True, False, True]
+    got = df[["s"]].isin(["y"]).to_dict()["s"]
+    assert got == [False, True, False]
+
+
+def test_concat(rng):
+    d1 = pd.DataFrame({"a": [1, 2]})
+    d2 = pd.DataFrame({"a": [3]})
+    got = concat([DataFrame(d1), DataFrame(d2)]).to_pandas()
+    pd.testing.assert_frame_equal(got, pd.concat([d1, d2]).reset_index(drop=True))
+
+
+def test_rename_drop_astype():
+    df = DataFrame({"a": [1, 2], "b": [3, 4]})
+    assert df.rename({"a": "z"}).columns == ["z", "b"]
+    assert df.drop(["b"]).columns == ["a"]
+    from cylon_tpu import dtypes
+
+    out = df.astype({"a": dtypes.float64})
+    assert out.dtypes["a"] == dtypes.float64
+
+
+# ----------------------------------------- review-finding regressions
+def test_distributed_mask_filter(env8, rng):
+    df = DataFrame(pd.DataFrame({"a": np.arange(40)}), env=env8)
+    got = df[np.asarray(df["a"].to_dict()["a"]) % 2 == 0]
+    assert len(got) == 20
+
+
+def test_setitem_on_distributed(env8):
+    df = DataFrame({"a": [1.0, 2.0, 3.0]}, env=env8)
+    df["b"] = np.array([9.0, 8.0, 7.0])
+    out = df.to_pandas()
+    assert out["b"].tolist() == [9.0, 8.0, 7.0]
+
+
+def test_fillna_string_column():
+    df = DataFrame(pd.DataFrame({"s": ["x", None, "z"]}))
+    got = df.fillna("missing").to_dict()["s"]
+    assert got == ["x", "missing", "z"]
+
+
+def test_drop_duplicates_keep_last_distributed(env8):
+    df = DataFrame({"k": [1, 1, 2], "v": [10, 20, 30]}, env=env8)
+    got = df.drop_duplicates(subset=["k"], keep="last", env=env8,
+                             out_capacity=24).to_pandas()
+    got = got.sort_values("k").reset_index(drop=True)
+    assert got["v"].tolist() == [20, 30]

@@ -1,0 +1,94 @@
+"""IO tests (parity model: reference golden-file CSVs in data/input,
+``cpp/test/create_table_test.cpp``; multi-file threaded reads
+table.cpp:788)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from cylon_tpu.config import CSVReadOptions
+from cylon_tpu.errors import IOError_
+from cylon_tpu.io import (
+    read_csv, read_json, read_parquet, write_csv, write_parquet,
+)
+
+
+@pytest.fixture
+def sample_df(rng):
+    return pd.DataFrame({
+        "k": rng.integers(0, 100, 50),
+        "v": rng.normal(size=50).round(6),
+        "s": rng.choice(["red", "green", "blue"], 50),
+    })
+
+
+def test_csv_roundtrip(tmp_path, sample_df):
+    p = tmp_path / "t.csv"
+    sample_df.to_csv(p, index=False)
+    df = read_csv(str(p))
+    pd.testing.assert_frame_equal(df.to_pandas(), sample_df,
+                                  check_dtype=False)
+    out = tmp_path / "out.csv"
+    write_csv(df, str(out))
+    pd.testing.assert_frame_equal(pd.read_csv(out), sample_df,
+                                  check_dtype=False)
+
+
+def test_csv_multifile_threaded(tmp_path, sample_df):
+    parts = [sample_df.iloc[0:20], sample_df.iloc[20:35],
+             sample_df.iloc[35:]]
+    paths = []
+    for i, part in enumerate(parts):
+        p = tmp_path / f"part{i}.csv"
+        part.to_csv(p, index=False)
+        paths.append(str(p))
+    df = read_csv(paths)
+    pd.testing.assert_frame_equal(df.to_pandas().reset_index(drop=True),
+                                  sample_df.reset_index(drop=True),
+                                  check_dtype=False)
+
+
+def test_csv_options(tmp_path):
+    p = tmp_path / "t.tsv"
+    p.write_text("a\t b\n1\t2\n3\t4\n")
+    df = read_csv(str(p), CSVReadOptions(delimiter="\t"))
+    assert len(df) == 2
+
+
+def test_csv_distributed(tmp_path, sample_df, env8):
+    p = tmp_path / "t.csv"
+    sample_df.to_csv(p, index=False)
+    df = read_csv(str(p), env=env8)
+    assert df.is_distributed
+    assert len(df) == 50
+
+
+def test_csv_missing_file():
+    with pytest.raises(IOError_):
+        read_csv("/nonexistent/file.csv")
+
+
+def test_parquet_roundtrip(tmp_path, sample_df):
+    p = tmp_path / "t.parquet"
+    sample_df.to_parquet(p)
+    df = read_parquet(str(p))
+    pd.testing.assert_frame_equal(df.to_pandas(), sample_df,
+                                  check_dtype=False)
+    out = tmp_path / "o.parquet"
+    write_parquet(df, str(out))
+    pd.testing.assert_frame_equal(pd.read_parquet(out), sample_df,
+                                  check_dtype=False)
+
+
+def test_parquet_columns(tmp_path, sample_df):
+    p = tmp_path / "t.parquet"
+    sample_df.to_parquet(p)
+    df = read_parquet(str(p), columns=["k", "s"])
+    assert df.columns == ["k", "s"]
+
+
+def test_json_lines(tmp_path):
+    p = tmp_path / "t.jsonl"
+    p.write_text('{"a": 1, "b": "x"}\n{"a": 2, "b": "y"}\n')
+    df = read_json(str(p))
+    assert df.to_dict() == {"a": [1, 2], "b": ["x", "y"]}
