@@ -79,12 +79,14 @@ class DataFrame:
                         f"cannot build DataFrame from {type(data)}")
         if env is not None:
             self._table = scatter_table(env, self._table)
+        self._index = None
 
     # -- construction helpers -------------------------------------------
     @staticmethod
-    def _wrap(table: Table) -> "DataFrame":
+    def _wrap(table: Table, index=None) -> "DataFrame":
         df = object.__new__(DataFrame)
         df._table = table
+        df._index = index
         return df
 
     # -- schema / introspection -----------------------------------------
@@ -113,6 +115,74 @@ class DataFrame:
             return dist_num_rows(self._table)
         return self._table.num_rows
 
+    # -- indexing (parity: indexing/ + table.hpp:183 SetArrowIndex) ------
+    def _materialized(self) -> "DataFrame":
+        """Local (gathered) view; the index — always built on the local
+        layout, see set_index — rides along."""
+        if self.is_distributed:
+            return DataFrame._wrap(gather_table(None, self._table),
+                                   self._index)
+        return self
+
+    @property
+    def index(self):
+        from cylon_tpu.indexing import RangeIndex
+
+        if self._index is None:
+            return RangeIndex(len(self))
+        return self._index
+
+    def set_index(self, key: str, indexing_type=None, drop: bool = True,
+                  ) -> "DataFrame":
+        """Build a value index on ``key`` (parity: pycylon
+        ``DataFrame.set_index`` / ``Table::SetArrowIndex``, table.hpp:183;
+        ``indexing_type`` mirrors ``IndexingType``, default HASH)."""
+        from cylon_tpu.indexing import IndexingType, build_index
+
+        if indexing_type is None:
+            indexing_type = IndexingType.HASH
+        df = self._materialized()
+        t = df.table
+        idx = build_index(t.column(key), t.nrows, indexing_type, name=key)
+        if drop:
+            t = t.drop([key])
+        return DataFrame._wrap(t, index=idx)
+
+    def reset_index(self, drop: bool = False) -> "DataFrame":
+        """Drop the value index, materialising it back as a leading column
+        unless ``drop`` (pandas semantics: a default RangeIndex becomes an
+        ``index`` column of positions; a name collision raises)."""
+        df = self._materialized()
+        t = df.table
+        idx = df._index
+        if not drop:
+            vc = idx.values_column() if idx is not None else None
+            if vc is None:
+                name = "index"
+                pos = jnp.arange(t.capacity, dtype=jnp.int64)
+                vc = Column(pos, None, dtypes.int64)
+            else:
+                name = idx.name or "index"
+            if name in t:
+                raise InvalidArgument(
+                    f"cannot insert {name}, already exists")
+            cols = {name: vc}
+            cols.update(t.columns)
+            t = Table(cols, t.nrows)
+        return DataFrame._wrap(t)
+
+    @property
+    def loc(self):
+        from cylon_tpu.indexing import LocIndexer
+
+        return LocIndexer(self)
+
+    @property
+    def iloc(self):
+        from cylon_tpu.indexing import ILocIndexer
+
+        return ILocIndexer(self)
+
     def __repr__(self):
         try:
             return f"DataFrame({self.to_pandas().__repr__()})"
@@ -121,10 +191,11 @@ class DataFrame:
 
     # -- selection -------------------------------------------------------
     def __getitem__(self, key):
+        # pure column selection keeps rows, so the value index rides along
         if isinstance(key, str):
-            return DataFrame._wrap(self._table.select([key]))
+            return DataFrame._wrap(self._table.select([key]), self._index)
         if isinstance(key, (list, tuple)):
-            return DataFrame._wrap(self._table.select(list(key)))
+            return DataFrame._wrap(self._table.select(list(key)), self._index)
         if isinstance(key, DataFrame):
             key = key._single_column().data
         if isinstance(key, (jnp.ndarray, np.ndarray)):
@@ -216,17 +287,17 @@ class DataFrame:
         return DataFrame._wrap(_selection.sample(self._gathered(), n))
 
     def rename(self, columns: Mapping[str, str]) -> "DataFrame":
-        return DataFrame._wrap(self._table.rename(columns))
+        return DataFrame._wrap(self._table.rename(columns), self._index)
 
     def drop(self, columns: Sequence[str]) -> "DataFrame":
         columns = [columns] if isinstance(columns, str) else list(columns)
-        return DataFrame._wrap(self._table.drop(columns))
+        return DataFrame._wrap(self._table.drop(columns), self._index)
 
     def astype(self, mapping: Mapping[str, dtypes.DType]) -> "DataFrame":
         t = self._table
         for name, dt in mapping.items():
             t = t.add_column(name, t.column(name).astype(dt))
-        return DataFrame._wrap(t)
+        return DataFrame._wrap(t, self._index)
 
     # -- elementwise / predicates ----------------------------------------
     def _binop(self, other, fn) -> "DataFrame":

@@ -1,0 +1,42 @@
+"""Pandas-style indexing over device tables.
+
+Parity target: ``cpp/src/cylon/indexing/`` — ``IndexingType`` and the
+``BaseArrowIndex`` family (``indexing/index.hpp:36-42,108-425``), the
+loc/iloc indexers (``indexing/indexer.hpp:76,123``), and the PyCylon
+facade (``python/pycylon/indexing/index.pyx:71-371``).
+
+TPU redesign: the reference's hash-map indices (flat_hash_map from value
+to row positions) don't map to XLA; the equivalents here are
+
+- :class:`RangeIndex` — positional, zero-storage (parity
+  ``ArrowRangeIndex``),
+- :class:`LinearIndex` — vectorized full-column comparison, O(n) per
+  probe batch but embarrassingly parallel on the VPU (parity
+  ``ArrowLinearIndex``),
+- :class:`HashIndex` — a *sorted* permutation of the key column probed
+  with ``searchsorted`` (O(log n) per probe). It answers exactly the
+  queries the reference's ``ArrowNumericHashIndex``/``ArrowBinaryHashIndex``
+  answer, with a sort in place of a hash table — the standing TPU
+  substitution used across this codebase.
+"""
+
+from cylon_tpu.indexing.index import (
+    BaseIndex,
+    HashIndex,
+    IndexingType,
+    LinearIndex,
+    RangeIndex,
+    build_index,
+)
+from cylon_tpu.indexing.indexer import ILocIndexer, LocIndexer
+
+__all__ = [
+    "BaseIndex",
+    "HashIndex",
+    "ILocIndexer",
+    "IndexingType",
+    "LinearIndex",
+    "LocIndexer",
+    "RangeIndex",
+    "build_index",
+]

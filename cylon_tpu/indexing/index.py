@@ -1,0 +1,212 @@
+"""Index structures: value -> row-position resolution on device.
+
+Parity: ``indexing/index.hpp`` (``IndexingType`` :36-42; ``BaseArrowIndex``
+:108; ``ArrowNumericHashIndex``/``ArrowBinaryHashIndex`` :246;
+``ArrowRangeIndex`` :393; ``ArrowLinearIndex`` :425; builder kernels
+:455-521).
+"""
+
+import enum
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cylon_tpu import dtypes
+from cylon_tpu.column import Column
+from cylon_tpu.errors import InvalidArgument, KeyError_
+
+
+class IndexingType(enum.Enum):
+    """Parity: ``indexing/index.hpp:36-42``. BINARY_TREE/BTREE are accepted
+    and resolve to the sorted (HASH) implementation — on TPU a sorted
+    permutation IS the search tree."""
+
+    RANGE = 0
+    LINEAR = 1
+    HASH = 2
+    BINARY_TREE = 3
+    BTREE = 4
+
+
+class BaseIndex:
+    """Parity: ``BaseArrowIndex`` (indexing/index.hpp:108). Resolves index
+    values to row positions; all probes are vectorized device programs."""
+
+    indexing_type: IndexingType
+    name: str | None
+
+    def __len__(self):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def locate(self, values) -> tuple[jax.Array, jax.Array]:
+        """values -> (positions[int32], found[bool]) — first matching row
+        per probe (parity: LocationByValue)."""
+        raise NotImplementedError
+
+    def mask_range(self, capacity: int, start, stop) -> jax.Array:
+        """Boolean row mask for index values in [start, stop] (closed on
+        both ends — pandas .loc slice semantics)."""
+        raise NotImplementedError
+
+    def to_numpy(self) -> np.ndarray:
+        raise NotImplementedError
+
+    def values_column(self) -> Column | None:
+        """The backing column (None for RangeIndex)."""
+        return None
+
+    def take(self, idx: jax.Array, nrows) -> "BaseIndex":
+        """Gather index entries by row position (keeps the index aligned
+        through filters/sorts)."""
+        raise NotImplementedError
+
+
+class RangeIndex(BaseIndex):
+    """Positional index 0..n-1 (parity: ``ArrowRangeIndex``,
+    indexing/index.hpp:393)."""
+
+    indexing_type = IndexingType.RANGE
+
+    def __init__(self, nrows, name: str | None = None):
+        self._nrows = nrows
+        self.name = name
+
+    def __len__(self):
+        return int(self._nrows)
+
+    def locate(self, values):
+        vals = jnp.atleast_1d(jnp.asarray(values, jnp.int32))
+        found = (vals >= 0) & (vals < jnp.asarray(self._nrows, jnp.int32))
+        return vals, found
+
+    def mask_range(self, capacity: int, start, stop):
+        pos = jnp.arange(capacity, dtype=jnp.int32)
+        return (pos >= start) & (pos <= stop) & (pos < self._nrows)
+
+    def to_numpy(self):
+        return np.arange(int(self._nrows))
+
+    def take(self, idx, nrows):
+        # positions are regenerated; a taken range index degrades to the
+        # gathered positions as a linear index (pandas keeps old labels)
+        col = Column(jnp.asarray(idx, jnp.int64), None, dtypes.int64)
+        return LinearIndex(col, nrows, self.name)
+
+
+class LinearIndex(BaseIndex):
+    """Full-scan index (parity: ``ArrowLinearIndex``, indexing/index.hpp:425).
+    Probe cost O(n) per batch but fully vectorized."""
+
+    indexing_type = IndexingType.LINEAR
+
+    def __init__(self, column: Column, nrows, name: str | None = None):
+        self.column = column
+        self._nrows = nrows
+        self.name = name
+
+    def __len__(self):
+        return int(self._nrows)
+
+    def _encode_probe(self, values):
+        vals = np.atleast_1d(np.asarray(values, dtype=object))
+        if self.column.dtype.is_dictionary:
+            lut = {v: i for i, v in enumerate(self.column.dictionary.values)}
+            codes = np.array([lut.get(v, -1) for v in vals], np.int32)
+            return jnp.asarray(codes)
+        return jnp.asarray(vals.astype(np.dtype(self.column.data.dtype)))
+
+    def locate(self, values):
+        probe = self._encode_probe(values)
+        data = self.column.data
+        cap = data.shape[0]
+        valid = jnp.arange(cap, dtype=jnp.int32) < self._nrows
+        if self.column.validity is not None:
+            valid = valid & self.column.validity
+        eq = (data[None, :] == probe[:, None]) & valid[None, :]
+        found = eq.any(axis=1)
+        pos = jnp.argmax(eq, axis=1).astype(jnp.int32)
+        return pos, found
+
+    def mask_range(self, capacity: int, start, stop):
+        lo = self._encode_probe([start])[0]
+        hi = self._encode_probe([stop])[0]
+        data = self.column.data
+        valid = jnp.arange(capacity, dtype=jnp.int32) < self._nrows
+        if self.column.validity is not None:
+            valid = valid & self.column.validity
+        return (data >= lo) & (data <= hi) & valid
+
+    def mask_isin(self, capacity: int, values):
+        probe = self._encode_probe(values)
+        data = self.column.data
+        valid = jnp.arange(capacity, dtype=jnp.int32) < self._nrows
+        if self.column.validity is not None:
+            valid = valid & self.column.validity
+        return (data[:, None] == probe[None, :]).any(axis=1) & valid
+
+    def to_numpy(self):
+        return self.column.to_numpy(int(self._nrows))
+
+    def values_column(self):
+        return self.column
+
+    def take(self, idx, nrows):
+        safe = jnp.clip(idx, 0, max(self.column.capacity - 1, 0))
+        c = self.column
+        col = Column(c.data[safe],
+                     None if c.validity is None else c.validity[safe],
+                     c.dtype, c.dictionary)
+        return type(self)(col, nrows, self.name)
+
+
+class HashIndex(LinearIndex):
+    """Sorted-permutation index probed by ``searchsorted`` (parity:
+    ``ArrowNumericHashIndex``/``ArrowBinaryHashIndex``,
+    indexing/index.hpp:246 — same query surface, sort instead of
+    flat_hash_map; see module docstring)."""
+
+    indexing_type = IndexingType.HASH
+
+    def __init__(self, column: Column, nrows, name: str | None = None):
+        super().__init__(column, nrows, name)
+        cap = column.capacity
+        key = column.data
+        # pad & nulls get a high sentinel; a real row carrying the sentinel
+        # value itself is disambiguated by sorting the invalid flag as a
+        # secondary key (valid rows first among equal keys) and checking it
+        # at probe time
+        sent = dtypes.sentinel_high(key.dtype)
+        valid = jnp.arange(cap, dtype=jnp.int32) < jnp.asarray(nrows, jnp.int32)
+        if column.validity is not None:
+            valid = valid & column.validity
+        masked = jnp.where(valid, key, jnp.asarray(sent, key.dtype))
+        invalid = (~valid).astype(jnp.uint8)
+        iota = jnp.arange(cap, dtype=jnp.int32)
+        self._sorted, sv, self._perm = jax.lax.sort(
+            (masked, invalid, iota), num_keys=2, is_stable=True)
+        self._sorted_valid = sv == 0
+
+    def locate(self, values):
+        probe = self._encode_probe(values)
+        slot = jnp.searchsorted(self._sorted, probe.astype(self._sorted.dtype))
+        slot = jnp.clip(slot, 0, self._sorted.shape[0] - 1)
+        found = (self._sorted[slot] == probe.astype(self._sorted.dtype)) \
+            & self._sorted_valid[slot]
+        return self._perm[slot], found
+
+
+def build_index(column: Column, nrows,
+                indexing_type: IndexingType = IndexingType.HASH,
+                name: str | None = None) -> BaseIndex:
+    """Parity: the index-builder kernels of ``indexing/index.hpp:455-521``
+    + ``IndexUtil``. BINARY_TREE/BTREE collapse to HASH (sorted)."""
+    if indexing_type == IndexingType.RANGE:
+        return RangeIndex(nrows, name)
+    if indexing_type == IndexingType.LINEAR:
+        return LinearIndex(column, nrows, name)
+    if indexing_type in (IndexingType.HASH, IndexingType.BINARY_TREE,
+                         IndexingType.BTREE):
+        return HashIndex(column, nrows, name)
+    raise InvalidArgument(f"unknown indexing type {indexing_type}")

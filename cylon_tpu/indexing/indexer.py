@@ -1,0 +1,132 @@
+"""loc / iloc indexers over DataFrame.
+
+Parity: ``ArrowLocIndexer``/``ArrowILocIndexer``
+(``indexing/indexer.hpp:76,123``, impl ``indexing/indexer.cpp``) and the
+``PyLocIndexer`` facade (``python/pycylon/indexing/index.pyx:71-371``).
+Supported key shapes mirror the reference: scalar value, list of values,
+closed value range (slice), each optionally with a column or list of
+columns as the second tuple element.
+"""
+
+from typing import Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from cylon_tpu.errors import IndexError_, KeyError_
+from cylon_tpu.indexing.index import BaseIndex, RangeIndex
+from cylon_tpu.ops import kernels
+from cylon_tpu.ops.selection import take_columns
+
+
+def _split_key(key):
+    if isinstance(key, tuple) and len(key) == 2:
+        return key[0], key[1]
+    return key, None
+
+
+def _col_subset(df, cols):
+    if cols is None:
+        return df.columns
+    if isinstance(cols, str):
+        return [cols]
+    if isinstance(cols, slice):
+        names = df.columns
+        lo = 0 if cols.start is None else names.index(cols.start)
+        hi = len(names) - 1 if cols.stop is None else names.index(cols.stop)
+        return names[lo:hi + 1]
+    return list(cols)
+
+
+def _take_with_index(df, idx, nrows, cols):
+    from cylon_tpu.frame import DataFrame
+
+    t = take_columns(df.table, jnp.asarray(idx, jnp.int32), nrows,
+                     names=cols)
+    new_index = df.index.take(jnp.asarray(idx, jnp.int32), nrows)
+    return DataFrame._wrap(t, index=new_index)
+
+
+class LocIndexer:
+    """Value-based row selection (parity: ``ArrowLocIndexer``,
+    indexing/indexer.hpp:76)."""
+
+    def __init__(self, df):
+        self._df = df
+
+    def __getitem__(self, key):
+        rows, cols = _split_key(key)
+        df = self._df._materialized()
+        names = _col_subset(df, cols)
+        index: BaseIndex = df.index
+
+        if isinstance(rows, slice):
+            if rows.step is not None:
+                raise IndexError_("loc slices do not support a step")
+            cap = df.table.capacity
+            if rows.start is None and rows.stop is None:
+                mask = df.table.row_mask()
+            else:
+                vals = index.to_numpy()
+                start = rows.start
+                stop = rows.stop
+                if start is None:
+                    start = vals.min() if len(vals) else 0
+                if stop is None:
+                    stop = vals.max() if len(vals) else 0
+                mask = index.mask_range(cap, start, stop)
+            perm, count = kernels.compact_mask(mask, df.table.nrows)
+            return _take_with_index(df, perm, count, names)
+
+        single = np.isscalar(rows) or isinstance(rows, (str, bytes))
+        probe = [rows] if single else list(rows)
+        # boolean mask passthrough (pandas-compatible convenience)
+        arr = np.asarray(probe)
+        if arr.dtype == bool:
+            mask = jnp.asarray(arr)
+            if mask.shape[0] != df.table.capacity:
+                pad = jnp.zeros(df.table.capacity - mask.shape[0], bool)
+                mask = jnp.concatenate([mask, pad])
+            mask = mask & df.table.row_mask()
+            perm, count = kernels.compact_mask(mask, df.table.nrows)
+            return _take_with_index(df, perm, count, names)
+
+        pos, found = index.locate(probe)
+        ok = np.asarray(found)
+        if not ok.all():
+            missing = [p for p, f in zip(probe, ok) if not f]
+            raise KeyError_(f"labels not found in index: {missing}")
+        return _take_with_index(df, pos, len(probe), names)
+
+
+class ILocIndexer:
+    """Position-based row selection (parity: ``ArrowILocIndexer``,
+    indexing/indexer.hpp:123)."""
+
+    def __init__(self, df):
+        self._df = df
+
+    def __getitem__(self, key):
+        rows, cols = _split_key(key)
+        df = self._df._materialized()
+        names = _col_subset(df, cols)
+        n = df.table.num_rows
+
+        if isinstance(rows, slice):
+            idx = np.arange(n)[rows]
+        elif np.isscalar(rows):
+            r = int(rows)
+            if r < 0:
+                r += n
+            if not 0 <= r < n:
+                raise IndexError_(f"position {rows} out of range [0, {n})")
+            idx = np.array([r])
+        else:
+            idx = np.asarray(rows)
+            if idx.dtype == bool:
+                idx = np.nonzero(idx[:n])[0]
+            else:
+                idx = np.where(idx < 0, idx + n, idx)
+                if ((idx < 0) | (idx >= n)).any():
+                    raise IndexError_(f"positions out of range [0, {n})")
+        return _take_with_index(df, idx, len(idx), names)

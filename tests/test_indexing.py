@@ -1,0 +1,198 @@
+"""Indexing subsystem tests.
+
+Mirrors the reference's ``cpp/test/indexing_test`` +
+``python/test/test_index.py`` coverage: build each index type, resolve
+single values / value lists / value ranges via loc, positions via iloc,
+with pandas as the correctness oracle.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from cylon_tpu import DataFrame
+from cylon_tpu.indexing import (
+    HashIndex,
+    IndexingType,
+    LinearIndex,
+    RangeIndex,
+    build_index,
+)
+
+
+@pytest.fixture
+def df():
+    return DataFrame({
+        "id": np.array([10, 7, 42, 3, 42, 19], np.int64),
+        "v": np.array([0.5, 1.5, 2.5, 3.5, 4.5, 5.5]),
+        "s": np.array(["a", "b", "c", "d", "e", "f"]),
+    })
+
+
+@pytest.fixture
+def pdf():
+    return pd.DataFrame({
+        "id": np.array([10, 7, 42, 3, 42, 19], np.int64),
+        "v": np.array([0.5, 1.5, 2.5, 3.5, 4.5, 5.5]),
+        "s": np.array(["a", "b", "c", "d", "e", "f"]),
+    })
+
+
+@pytest.mark.parametrize("ityp", [IndexingType.LINEAR, IndexingType.HASH,
+                                  IndexingType.BINARY_TREE])
+def test_loc_scalar_and_list(df, pdf, ityp):
+    d = df.set_index("id", indexing_type=ityp)
+    p = pdf.set_index("id", drop=False)
+    got = d.loc[42].to_pandas()
+    # first occurrence
+    assert got["v"].tolist() == [2.5]
+    got = d.loc[[3, 10]].to_pandas()
+    assert got["v"].tolist() == [3.5, 0.5]  # request order preserved
+    assert got["s"].tolist() == ["d", "a"]
+
+
+def test_loc_missing_raises(df):
+    d = df.set_index("id")
+    with pytest.raises(Exception, match="not found"):
+        d.loc[999]
+
+
+def test_loc_range_inclusive(df):
+    d = df.set_index("id", indexing_type=IndexingType.LINEAR, drop=False)
+    got = d.loc[7:19].to_pandas()  # values in [7, 19]
+    assert sorted(got["id"].tolist()) == [7, 10, 19]
+
+
+def test_loc_column_subset(df):
+    d = df.set_index("id")
+    got = d.loc[[42], "v"].to_pandas()
+    assert list(got.columns) == ["v"]
+    got = d.loc[[42], ["v", "s"]].to_pandas()
+    assert list(got.columns) == ["v", "s"]
+
+
+def test_loc_bool_mask(df, pdf):
+    d = df.set_index("id")
+    mask = np.array([True, False, True, False, False, True])
+    got = d.loc[mask].to_pandas()
+    exp = pdf[mask]
+    assert got["v"].tolist() == exp["v"].tolist()
+
+
+def test_loc_string_index(df):
+    d = df.set_index("s")
+    got = d.loc[["d", "b"]].to_pandas()
+    assert got["id"].tolist() == [3, 7]
+
+
+def test_iloc(df, pdf):
+    d = df  # range index
+    assert d.iloc[2].to_pandas()["v"].tolist() == [2.5]
+    assert d.iloc[-1].to_pandas()["v"].tolist() == [5.5]
+    assert d.iloc[1:4].to_pandas()["v"].tolist() == [1.5, 2.5, 3.5]
+    assert d.iloc[::2].to_pandas()["v"].tolist() == [0.5, 2.5, 4.5]
+    assert d.iloc[[4, 0]].to_pandas()["v"].tolist() == [4.5, 0.5]
+    with pytest.raises(Exception, match="out of range"):
+        d.iloc[17]
+
+
+def test_iloc_cols(df):
+    got = df.iloc[1:3, ["s"]].to_pandas()
+    assert list(got.columns) == ["s"]
+    assert got["s"].tolist() == ["b", "c"]
+    got = df.iloc[0:6, "id":"v"].to_pandas()
+    assert list(got.columns) == ["id", "v"]
+
+
+def test_index_survives_selection(df):
+    d = df.set_index("id")
+    sub = d.iloc[[3, 2]]
+    # index entries rode along with the gather
+    got = sub.loc[[42]].to_pandas()
+    assert got["v"].tolist() == [2.5]
+
+
+def test_set_index_drop_and_reset(df):
+    d = df.set_index("id")  # pandas-parity default: drop=True
+    assert "id" not in d.columns
+    back = d.reset_index()
+    assert back.columns[0] == "id"
+    assert back.to_pandas()["id"].tolist() == [10, 7, 42, 3, 42, 19]
+
+
+def test_reset_index_range_and_collision(df):
+    # default RangeIndex -> positions column named "index"
+    back = df.reset_index()
+    assert back.columns[0] == "index"
+    assert back.to_pandas()["index"].tolist() == list(range(6))
+    # name collision raises like pandas
+    d = df.set_index("id", drop=False)
+    with pytest.raises(Exception, match="already exists"):
+        d.reset_index()
+
+
+def test_index_survives_column_selection(df):
+    d = df.set_index("id")
+    got = d[["v"]].loc[[42]].to_pandas()
+    assert got["v"].tolist() == [2.5]
+    got = d.rename({"v": "w"}).loc[42].to_pandas()
+    assert got["w"].tolist() == [2.5]
+
+
+def test_hash_index_sentinel_probe():
+    import pandas as pd
+
+    d = DataFrame(pd.DataFrame({
+        "k": pd.array([1, None, 3], dtype="Int64"),
+        "v": [10, 20, 30],
+    }))
+    idx = build_index(d.table.column("k"), d.table.nrows, IndexingType.HASH)
+    # int64 max is the null/padding sentinel internally; must NOT match
+    pos, found = idx.locate([np.iinfo(np.int64).max])
+    assert not bool(np.asarray(found)[0])
+    # a real row holding the sentinel value IS found
+    d2 = DataFrame({"k": np.array([5, np.iinfo(np.int64).max], np.int64),
+                    "v": np.array([1, 2])})
+    idx2 = build_index(d2.table.column("k"), d2.table.nrows,
+                       IndexingType.HASH)
+    pos, found = idx2.locate([np.iinfo(np.int64).max])
+    assert bool(np.asarray(found)[0])
+    assert int(np.asarray(pos)[0]) == 1
+
+
+def test_range_index_basics(df):
+    idx = df.index
+    assert isinstance(idx, RangeIndex)
+    assert len(idx) == 6
+    pos, found = idx.locate([2, 99])
+    assert np.asarray(found).tolist() == [True, False]
+    assert np.asarray(idx.to_numpy()).tolist() == list(range(6))
+
+
+def test_build_index_types(df):
+    t = df.table
+    for ityp, cls in [(IndexingType.LINEAR, LinearIndex),
+                      (IndexingType.HASH, HashIndex),
+                      (IndexingType.BTREE, HashIndex)]:
+        idx = build_index(t.column("id"), t.nrows, ityp)
+        assert type(idx) is cls
+        pos, found = idx.locate([42])
+        assert bool(np.asarray(found)[0])
+        assert int(np.asarray(pos)[0]) == 2  # first occurrence
+
+
+def test_hash_index_with_nulls():
+    d = DataFrame(pd.DataFrame({
+        "k": pd.array([1, None, 3, None, 5], dtype="Int64"),
+        "v": [10, 20, 30, 40, 50],
+    }))
+    idx = build_index(d.table.column("k"), d.table.nrows, IndexingType.HASH)
+    pos, found = idx.locate([3, 2])
+    assert np.asarray(found).tolist() == [True, False]
+    assert int(np.asarray(pos)[0]) == 2
+
+
+def test_loc_on_distributed_gathers(env4, df):
+    d = DataFrame(df.to_pandas(), env=env4)
+    got = d.set_index("id").loc[[42]].to_pandas()
+    assert got["v"].tolist() == [2.5]
