@@ -53,7 +53,9 @@ from cylon_tpu.errors import (
     TypeError_,
 )
 from cylon_tpu.table import Table
+from cylon_tpu.series import Series
 from cylon_tpu.frame import DataFrame, GroupByDataFrame, concat, merge, read_csv
+from cylon_tpu.indexing import IndexingType
 
 __version__ = "0.1.0"
 
@@ -76,6 +78,8 @@ __all__ = [
     "SortOptions",
     "DataFrame",
     "GroupByDataFrame",
+    "IndexingType",
+    "Series",
     "Table",
     "TPUConfig",
     "TypeError_",

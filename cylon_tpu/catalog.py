@@ -1,0 +1,137 @@
+"""String-id table catalog + id-keyed operation mirror.
+
+Parity target: ``cpp/src/cylon/table_api.{hpp,cpp}`` — a process-global
+registry mapping string ids to tables (``PutTable/GetTable/RemoveTable``,
+``table_api.hpp:38-90``) with every relational op mirrored on ids
+(``JoinTables(ctx, "left", "right", ...)``). In the reference this layer
+exists to give the Java JNI binding a stable C surface; here it is the
+FFI/embedding surface for non-Python hosts of the TPU runtime.
+"""
+
+import threading
+from typing import Mapping, Sequence
+
+from cylon_tpu.config import JoinConfig
+from cylon_tpu.errors import InvalidArgument, KeyError_
+from cylon_tpu.table import Table
+
+_lock = threading.Lock()
+_catalog: dict[str, Table] = {}
+
+
+def put_table(table_id: str, table: Table) -> None:
+    """Parity: ``PutTable`` (table_api.hpp:38)."""
+    if not isinstance(table, Table):
+        raise InvalidArgument(f"not a Table: {type(table)}")
+    with _lock:
+        _catalog[table_id] = table
+
+
+def get_table(table_id: str) -> Table:
+    """Parity: ``GetTable``."""
+    with _lock:
+        if table_id not in _catalog:
+            raise KeyError_(f"no table registered under {table_id!r}")
+        return _catalog[table_id]
+
+
+def remove_table(table_id: str) -> None:
+    """Parity: ``RemoveTable``."""
+    with _lock:
+        _catalog.pop(table_id, None)
+
+
+def list_tables() -> list[str]:
+    with _lock:
+        return sorted(_catalog)
+
+
+def clear() -> None:
+    with _lock:
+        _catalog.clear()
+
+
+# ---------------------------------------------------------------- id ops
+def read_csv(table_id: str, path, **kw) -> None:
+    """Parity: ``ReadCSV(ctx, path, id)`` (table_api.hpp)."""
+    from cylon_tpu.io import read_csv as _read
+
+    put_table(table_id, _read(path, **kw).to_table())
+
+
+def join_tables(left_id: str, right_id: str, out_id: str,
+                config: JoinConfig | None = None, *, on=None,
+                how: str = "inner", env=None, **kw) -> None:
+    """Parity: ``JoinTables(ctx, "left", "right", ...)``
+    (table_api.hpp:46)."""
+    from cylon_tpu.ops.join import join
+    from cylon_tpu.parallel import dist_join
+
+    lt, rt = get_table(left_id), get_table(right_id)
+    if config is not None:
+        on = None
+        kw.setdefault("left_on", list(config.left_on))
+        kw.setdefault("right_on", list(config.right_on))
+        how = config.join_type.value
+    if env is not None:
+        out = dist_join(env, lt, rt, on=on, how=how, **kw)
+    else:
+        out = join(lt, rt, on=on, how=how, **kw)
+    put_table(out_id, out)
+
+
+def _binary(op_name: str):
+    def run(left_id: str, right_id: str, out_id: str, env=None, **kw):
+        from cylon_tpu.ops import setops
+        from cylon_tpu.parallel import dist_ops
+
+        lt, rt = get_table(left_id), get_table(right_id)
+        if env is not None:
+            fn = getattr(dist_ops, f"dist_{op_name}")
+            put_table(out_id, fn(env, lt, rt, **kw))
+        else:
+            fn = getattr(setops, op_name)
+            put_table(out_id, fn(lt, rt, **kw))
+    run.__name__ = f"{op_name}_tables"
+    run.__doc__ = f"Parity: table_api {op_name.capitalize()}Tables."
+    return run
+
+
+union_tables = _binary("union")
+intersect_tables = _binary("intersect")
+subtract_tables = _binary("subtract")
+
+
+def sort_table(table_id: str, out_id: str, by, env=None, **kw) -> None:
+    """Parity: table_api Sort/DistributedSort."""
+    from cylon_tpu.ops.selection import sort_table as _sort
+    from cylon_tpu.parallel import dist_sort
+
+    t = get_table(table_id)
+    by = [by] if isinstance(by, str) else list(by)
+    if env is not None:
+        put_table(out_id, dist_sort(env, t, by, **kw))
+    else:
+        put_table(out_id, _sort(t, by, **kw))
+
+
+def unique_table(table_id: str, out_id: str, cols=None, env=None, **kw
+                 ) -> None:
+    """Parity: table_api Unique/DistributedUnique."""
+    from cylon_tpu.ops import setops
+    from cylon_tpu.parallel import dist_unique
+
+    t = get_table(table_id)
+    if env is not None:
+        put_table(out_id, dist_unique(env, t, cols, **kw))
+    else:
+        put_table(out_id, setops.unique(t, cols, **kw))
+
+
+def select_columns(table_id: str, out_id: str, names: Sequence[str]) -> None:
+    """Parity: table_api Project."""
+    put_table(out_id, get_table(table_id).select(list(names)))
+
+
+def table_to_pydict(table_id: str) -> Mapping[str, list]:
+    return get_table(table_id).to_pydict()

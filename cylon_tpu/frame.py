@@ -50,8 +50,10 @@ class DataFrame:
 
     def __init__(self, data=None, env: CylonEnv | None = None,
                  capacity: int | None = None):
+        index = None
         if isinstance(data, DataFrame):
             self._table = data._table
+            index = data._index
         elif isinstance(data, Table):
             self._table = data
         elif data is None:
@@ -79,7 +81,7 @@ class DataFrame:
                         f"cannot build DataFrame from {type(data)}")
         if env is not None:
             self._table = scatter_table(env, self._table)
-        self._index = None
+        self._index = index
 
     # -- construction helpers -------------------------------------------
     @staticmethod
@@ -313,16 +315,71 @@ class DataFrame:
                                 dtypes.from_numpy_dtype(data.dtype))
         return DataFrame._wrap(Table(cols, t.nrows))
 
+    def _unop(self, fn) -> "DataFrame":
+        return self._binop(0, lambda a, _: fn(a))
+
     def __add__(self, o): return self._binop(o, jnp.add)
+    def __radd__(self, o): return self._binop(o, lambda a, b: jnp.add(b, a))
     def __sub__(self, o): return self._binop(o, jnp.subtract)
+    def __rsub__(self, o): return self._binop(o, lambda a, b: jnp.subtract(b, a))
     def __mul__(self, o): return self._binop(o, jnp.multiply)
+    def __rmul__(self, o): return self._binop(o, lambda a, b: jnp.multiply(b, a))
     def __truediv__(self, o): return self._binop(o, jnp.true_divide)
+    def __rtruediv__(self, o): return self._binop(o, lambda a, b: jnp.true_divide(b, a))
+    def __floordiv__(self, o): return self._binop(o, jnp.floor_divide)
+    def __mod__(self, o): return self._binop(o, jnp.mod)
+    def __pow__(self, o): return self._binop(o, jnp.power)
+    def __neg__(self): return self._unop(jnp.negative)
+    def __abs__(self): return self._unop(jnp.abs)
+    # bitwise on ints, logical on bools — numpy/pandas semantics
+    def __invert__(self): return self._unop(jnp.invert)
+    def __and__(self, o): return self._binop(o, jnp.bitwise_and)
+    def __or__(self, o): return self._binop(o, jnp.bitwise_or)
+    def __xor__(self, o): return self._binop(o, jnp.bitwise_xor)
     def __eq__(self, o): return self._binop(o, jnp.equal)          # noqa: E501
     def __ne__(self, o): return self._binop(o, jnp.not_equal)
     def __lt__(self, o): return self._binop(o, jnp.less)
     def __le__(self, o): return self._binop(o, jnp.less_equal)
     def __gt__(self, o): return self._binop(o, jnp.greater)
     def __ge__(self, o): return self._binop(o, jnp.greater_equal)
+
+    def __hash__(self):  # __eq__ is elementwise; identity hashing
+        return id(self)
+
+    def abs(self) -> "DataFrame":
+        return self._unop(jnp.abs)
+
+    def applymap(self, fn) -> "DataFrame":
+        """Elementwise map over every column (parity: frame.py applymap /
+        ``compute.pyx`` infer_map). Traceable fns fuse into XLA; others
+        fall back to a host loop per column."""
+        from cylon_tpu.ops.dictenc import reencode_values
+
+        t = self._materialized().table
+        cols = {}
+        nrows = t.nrows
+        for name, c in t.columns.items():
+            if c.dtype.is_dictionary:
+                cols[name] = reencode_values(
+                    c, [fn(v) for v in c.dictionary.values])
+                continue
+            try:
+                data = jnp.asarray(jnp.vectorize(fn)(c.data))
+                cols[name] = Column(data, c.validity,
+                                    dtypes.from_numpy_dtype(np.dtype(data.dtype)))
+            except Exception:
+                host = np.array([fn(v) for v in c.to_numpy(int(nrows))])
+                cols[name] = Column.from_numpy(host, t.capacity)
+        return DataFrame._wrap(Table(cols, nrows), self._index)
+
+    map = applymap  # pandas 2.x name
+
+    def series(self, name: str):
+        """Single column as a :class:`cylon_tpu.series.Series`."""
+        from cylon_tpu.series import Series
+
+        t = self._materialized().table
+        return Series._wrap(t.column(name), t.nrows, name)
 
     def isnull(self) -> "DataFrame":
         """Parity: frame.py isnull."""
@@ -338,6 +395,9 @@ class DataFrame:
     def notnull(self) -> "DataFrame":
         inv = self.isnull()
         return inv._binop(True, jnp.not_equal)
+
+    isna = isnull
+    notna = notnull
 
     def fillna(self, value) -> "DataFrame":
         """Parity: frame.py fillna."""
@@ -362,6 +422,99 @@ class DataFrame:
                 validity = None
             cols[name] = Column(data, validity, c.dtype, c.dictionary)
         return DataFrame._wrap(Table(cols, t.nrows))
+
+    def dropna(self, axis: int = 0, how: str = "any", subset=None,
+               ) -> "DataFrame":
+        """Drop rows (axis=0) or columns (axis=1) with missing values
+        (parity: ``compute.pyx`` drop_na :728)."""
+        from cylon_tpu.ops import kernels
+
+        df = self._materialized()
+        t = df.table
+        names = ([subset] if isinstance(subset, str) else list(subset)
+                 ) if subset is not None else t.column_names
+        flags = []
+        for name in names:
+            f = _selection._null_flags(t.column(name))
+            flags.append(jnp.zeros(t.capacity, bool) if f is None
+                         else f.astype(bool))
+        if not flags:
+            return df
+        stack = jnp.stack(flags)
+        if axis == 1:
+            rm = t.row_mask()
+            bad = [bool((f & rm).any()) if how == "any"
+                   else bool((f | ~rm).all()) for f in stack]
+            keep = [n for n, b in zip(names, bad) if not b]
+            keep += [n for n in t.column_names if n not in names]
+            ordered = [n for n in t.column_names if n in set(keep)]
+            return DataFrame._wrap(t.select(ordered), df._index)
+        null_row = stack.all(axis=0) if how == "all" else stack.any(axis=0)
+        perm, count = kernels.compact_mask(~null_row, t.nrows)
+        out = _selection.take_columns(t, perm, count)
+        idx = df.index.take(perm, count) if df._index is not None else None
+        return DataFrame._wrap(out, idx)
+
+    def where(self, cond: "DataFrame", other=np.nan) -> "DataFrame":
+        """Keep values where ``cond`` holds, else ``other`` (parity:
+        frame.py where/mask). ``cond`` is a boolean frame (same shape) or
+        single boolean column applied to every column."""
+        import math
+
+        nan_fill = other is None or (isinstance(other, float)
+                                     and math.isnan(other))
+        t = self._materialized().table
+        cols = {}
+        for name, c in t.columns.items():
+            if isinstance(cond, DataFrame):
+                cc = (cond._table.column(name) if name in cond._table
+                      else cond._single_column())
+                m = cc.data.astype(bool)
+            else:
+                m = jnp.asarray(cond, bool)
+            base = (jnp.ones(t.capacity, bool) if c.validity is None
+                    else c.validity)
+            if c.dtype.is_dictionary:
+                if nan_fill:
+                    cols[name] = Column(c.data, base & m, c.dtype,
+                                        c.dictionary)
+                else:
+                    from cylon_tpu.ops.dictenc import encode_fill_value
+
+                    c2, code = encode_fill_value(c, other)
+                    data = jnp.where(m, c2.data, jnp.int32(code))
+                    # cond False takes `other` even over a prior null
+                    validity = None if c.validity is None else (base | ~m)
+                    cols[name] = Column(data, validity, c2.dtype,
+                                        c2.dictionary)
+            elif not jnp.issubdtype(jnp.asarray(c.data).dtype,
+                                    jnp.floating):
+                if nan_fill:
+                    # non-float columns take NaN through the validity
+                    # mask (null), matching Arrow semantics
+                    cols[name] = Column(c.data, base & m, c.dtype)
+                else:
+                    data = jnp.where(m, c.data,
+                                     jnp.asarray(other, c.data.dtype))
+                    validity = None if c.validity is None else (base | ~m)
+                    cols[name] = Column(data, validity, c.dtype)
+            else:
+                data = jnp.where(m, c.data,
+                                 jnp.nan if nan_fill
+                                 else jnp.asarray(other, c.data.dtype))
+                validity = (c.validity if nan_fill or c.validity is None
+                            else (base | ~m))
+                cols[name] = Column(data, validity, c.dtype)
+        return DataFrame._wrap(Table(cols, t.nrows), self._index)
+
+    def mask(self, cond: "DataFrame", other=np.nan) -> "DataFrame":
+        inv = (~cond) if isinstance(cond, DataFrame) else ~jnp.asarray(cond, bool)
+        return self.where(inv, other)
+
+    def equals(self, other: "DataFrame") -> bool:
+        """Exact frame equality (schema + values; NaN == NaN)."""
+        a, b = self.to_pandas(), other.to_pandas()
+        return bool(a.equals(b))
 
     def isin(self, values: Sequence) -> "DataFrame":
         """Parity: frame.py isin (membership per element)."""

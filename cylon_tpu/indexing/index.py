@@ -130,8 +130,15 @@ class LinearIndex(BaseIndex):
         return pos, found
 
     def mask_range(self, capacity: int, start, stop):
-        lo = self._encode_probe([start])[0]
-        hi = self._encode_probe([stop])[0]
+        if self.column.dtype.is_dictionary:
+            # a bound need not be an existing value: map to the code range
+            # via the sorted dictionary (codes are value-ordered)
+            vals = self.column.dictionary.values
+            lo = jnp.int32(np.searchsorted(vals, start, side="left"))
+            hi = jnp.int32(np.searchsorted(vals, stop, side="right") - 1)
+        else:
+            lo = self._encode_probe([start])[0]
+            hi = self._encode_probe([stop])[0]
         data = self.column.data
         valid = jnp.arange(capacity, dtype=jnp.int32) < self._nrows
         if self.column.validity is not None:

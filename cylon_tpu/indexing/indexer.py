@@ -43,6 +43,8 @@ def _take_with_index(df, idx, nrows, cols):
 
     t = take_columns(df.table, jnp.asarray(idx, jnp.int32), nrows,
                      names=cols)
+    # labels ride along the gather — an implicit RangeIndex degrades to a
+    # LinearIndex of the original positions (pandas keeps old labels)
     new_index = df.index.take(jnp.asarray(idx, jnp.int32), nrows)
     return DataFrame._wrap(t, index=new_index)
 
@@ -112,6 +114,8 @@ class ILocIndexer:
         names = _col_subset(df, cols)
         n = df.table.num_rows
 
+        if isinstance(rows, (bool, np.bool_)):
+            raise IndexError_("iloc position cannot be a bool")
         if isinstance(rows, slice):
             idx = np.arange(n)[rows]
         elif np.isscalar(rows):

@@ -17,6 +17,15 @@ from cylon_tpu.errors import IOError_
 from cylon_tpu.table import Table
 
 
+def _native_ok() -> bool:
+    try:
+        from cylon_tpu import native
+
+        return native.available()
+    except Exception:
+        return False
+
+
 def _arrow_csv_read(path, options: CSVReadOptions):
     import pyarrow.csv as pacsv
 
@@ -38,15 +47,55 @@ def _arrow_csv_read(path, options: CSVReadOptions):
 
 
 def read_csv(paths, options: CSVReadOptions | None = None,
-             env=None, capacity: int | None = None):
+             env=None, capacity: int | None = None,
+             engine: str = "auto"):
     """Read one or many CSVs (parity: ``FromCSV``, table.cpp:788 — many
     paths read concurrently on threads). With ``env``, rows are sliced
-    over the mesh (returns a distributed DataFrame)."""
+    over the mesh (returns a distributed DataFrame).
+
+    ``engine``: ``"native"`` uses the C++ chunk-parallel loader
+    (``cylon_tpu.native``), ``"arrow"`` pyarrow, ``"auto"`` native when
+    built and the options allow it (plain delimiter/header reads)."""
     from cylon_tpu.frame import DataFrame
 
     options = options or CSVReadOptions()
     single = isinstance(paths, (str, bytes))
     path_list = [paths] if single else list(paths)
+
+    plain = options.skip_rows == 0 and options.column_names is None
+    if engine == "native" or (engine == "auto" and plain and _native_ok()):
+        if not plain:
+            from cylon_tpu.errors import NotImplemented_
+
+            raise NotImplemented_(
+                "native csv engine does not support skip_rows/column_names;"
+                " use engine='arrow'")
+        from cylon_tpu import native
+
+        try:
+            if len(path_list) == 1:
+                t = native.csv_to_table(path_list[0], options.delimiter,
+                                        capacity=capacity)
+            else:
+                with ThreadPoolExecutor(
+                        max_workers=min(8, len(path_list))) as ex:
+                    tables = list(ex.map(
+                        lambda p: native.csv_to_table(p, options.delimiter),
+                        path_list))
+                from cylon_tpu.ops.selection import concat_tables
+
+                t = concat_tables(tables, capacity=capacity)
+        except Exception as e:
+            raise IOError_(f"csv read failed: {e}") from e
+        if options.use_cols:
+            t = t.select(list(options.use_cols))
+        df = DataFrame._wrap(t)
+        if env is not None or options.slice:
+            from cylon_tpu.context import CylonEnv
+            from cylon_tpu.parallel import scatter_table
+
+            df = DataFrame._wrap(scatter_table(env or CylonEnv(), t))
+        return df
     try:
         if len(path_list) == 1:
             atables = [_arrow_csv_read(path_list[0], options)]

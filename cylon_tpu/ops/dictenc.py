@@ -58,6 +58,20 @@ def unify_table_dictionaries(tables: list[Table]) -> list[Table]:
     return [Table(new_cols[i], t.nrows) for i, t in enumerate(tables)]
 
 
+def reencode_values(col: Column, new_values) -> Column:
+    """Replace the dictionary's values with ``new_values`` (one per old
+    code, e.g. after an elementwise map), restoring the sorted-unique
+    invariant (code order == value order) via a device code remap."""
+    vals = np.asarray(new_values, dtype=object)
+    uniq, inverse = np.unique(vals, return_inverse=True)
+    remap = inverse.astype(np.int32)
+    if len(remap):
+        codes = jnp.asarray(remap)[jnp.clip(col.data, 0, len(remap) - 1)]
+    else:
+        codes = col.data
+    return Column(codes, col.validity, col.dtype, Dictionary(uniq))
+
+
 def encode_fill_value(col: Column, value):
     """Resolve ``value`` to a code of ``col``'s dictionary, extending and
     re-sorting the dictionary (with a device-side code remap) when the

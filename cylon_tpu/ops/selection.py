@@ -93,6 +93,12 @@ def concat_tables(tables: Sequence[Table], capacity: int | None = None) -> Table
 
     if not tables:
         raise InvalidArgument("concat of no tables")
+    for t in tables:
+        # an overflowed input (nrows > capacity, from an undersized
+        # out_capacity) would silently scatter only part of its rows;
+        # fail loudly when the count is concrete
+        if not isinstance(t.nrows, jax.core.Tracer):
+            t.num_rows
     names = tables[0].column_names
     for t in tables[1:]:
         if t.column_names != names:

@@ -1,0 +1,50 @@
+"""Op-graph streaming execution engine (the reference's L7).
+
+Parity target: ``cpp/src/cylon/ops/`` — push-based dataflow of ``Op``
+nodes with per-child input queues and finalize propagation
+(``ops/api/parallel_op.hpp:32-183``), pluggable execution strategies
+(``ops/execution/execution.hpp:28-110``), and the prebuilt distributed
+graphs ``DisJoinOP``/``DisUnionOp`` (``ops/dis_join_op.cpp:21-72``).
+
+TPU redesign: the reference streams Arrow table chunks between threads
+to overlap partition/shuffle/local-join. Here a chunk is a
+capacity-bounded device table; streaming overlaps **host→device ingest
+with device compute** (XLA dispatch is async — enqueueing chunk k+1's
+kernels while chunk k executes keeps both DMA and compute busy), and
+the per-chunk ops are the same fused jit programs used by the eager
+path, so the op graph adds pipelining without a second kernel library.
+"""
+
+from cylon_tpu.ops_graph.op import Op, RootOp, TableChunk
+from cylon_tpu.ops_graph.execution import (
+    Execution,
+    JoinExecution,
+    PriorityExecution,
+    RoundRobinExecution,
+    SequentialExecution,
+)
+from cylon_tpu.ops_graph.graph import (
+    DisJoinOp,
+    DisUnionOp,
+    GroupByOp,
+    JoinOp,
+    PartitionOp,
+    UnionOp,
+)
+
+__all__ = [
+    "DisJoinOp",
+    "DisUnionOp",
+    "Execution",
+    "GroupByOp",
+    "JoinExecution",
+    "JoinOp",
+    "Op",
+    "PartitionOp",
+    "PriorityExecution",
+    "RootOp",
+    "RoundRobinExecution",
+    "SequentialExecution",
+    "TableChunk",
+    "UnionOp",
+]

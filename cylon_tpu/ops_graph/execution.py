@@ -1,0 +1,119 @@
+"""Execution strategies: the order in which op nodes are progressed.
+
+Parity: ``ops/execution/execution.hpp:28-110`` — ``RoundRobinExecution``
+(:43), ``PriorityExecution`` (weighted repeats, :57), ``JoinExecution``
+(drain two subtrees, then the join tail, :83), ``SequentialExecution``
+(:103). The reference spins these on the main thread between MPI
+progress calls; here a progress step dispatches one chunk's (async) XLA
+work, so the schedule controls how host→device transfer and device
+compute interleave.
+"""
+
+from typing import Sequence
+
+from cylon_tpu.ops_graph.op import Op
+
+
+class Execution:
+    """Parity: ``Execution`` (execution.hpp:28-37)."""
+
+    def progress(self) -> bool:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def is_complete(self) -> bool:
+        """One scheduling sweep; True when every op is drained+finalized."""
+        raise NotImplementedError
+
+
+class RoundRobinExecution(Execution):
+    """Each op progresses once per sweep (execution.hpp:43-55)."""
+
+    def __init__(self, ops: Sequence[Op] = ()):
+        self._ops = list(ops)
+
+    def add_op(self, op: Op) -> None:
+        self._ops.append(op)
+
+    def progress(self) -> bool:
+        did = False
+        for op in self._ops:
+            did |= op.progress()
+        return did
+
+    def is_complete(self) -> bool:
+        self.progress()
+        return all(op.done() for op in self._ops)
+
+
+class PriorityExecution(Execution):
+    """Ops progress proportionally to integer priorities
+    (execution.hpp:57-81 — the reference expands priorities into a
+    round-robin multiset)."""
+
+    def __init__(self, ops_with_priority: Sequence[tuple[Op, int]]):
+        self._ops = [op for op, _ in ops_with_priority]
+        self._schedule: list[Op] = []
+        for op, prio in ops_with_priority:
+            self._schedule.extend([op] * max(int(prio), 1))
+
+    def progress(self) -> bool:
+        did = False
+        for op in self._schedule:
+            did |= op.progress()
+        return did
+
+    def is_complete(self) -> bool:
+        self.progress()
+        return all(op.done() for op in self._ops)
+
+
+class SequentialExecution(Execution):
+    """Fully drain each op before moving to the next
+    (execution.hpp:103-110)."""
+
+    def __init__(self, ops: Sequence[Op] = ()):
+        self._ops = list(ops)
+
+    def add_op(self, op: Op) -> None:
+        self._ops.append(op)
+
+    def progress(self) -> bool:
+        for op in self._ops:
+            if op.progress():
+                return True
+        return False
+
+    def is_complete(self) -> bool:
+        for op in self._ops:
+            while op.progress():
+                pass
+        return all(op.done() for op in self._ops)
+
+
+class JoinExecution(Execution):
+    """Alternate between the two relation subtrees, then drain the join
+    tail (execution.hpp:83-101)."""
+
+    def __init__(self, left_ops: Sequence[Op], right_ops: Sequence[Op],
+                 tail_ops: Sequence[Op]):
+        self._left = list(left_ops)
+        self._right = list(right_ops)
+        self._tail = list(tail_ops)
+
+    def progress(self) -> bool:
+        did = False
+        for l, r in zip(self._left, self._right):
+            did |= l.progress()
+            did |= r.progress()
+        for extra in (self._left[len(self._right):],
+                      self._right[len(self._left):]):
+            for op in extra:
+                did |= op.progress()
+        for op in self._tail:
+            did |= op.progress()
+        return did
+
+    def is_complete(self) -> bool:
+        self.progress()
+        return all(op.done()
+                   for op in self._left + self._right + self._tail)

@@ -1,0 +1,263 @@
+"""Concrete streaming ops + prebuilt distributed graphs.
+
+Parity: ``cpp/src/cylon/ops/`` kernels and builders — ``PartitionOp``
+(``ops/partition_op.cpp``), ``JoinOp``/``UnionOp`` (``ops/join_op.cpp``,
+``ops/union_op.cpp``), and the graph builders ``DisJoinOP``/``DisUnionOp``
+(``ops/dis_join_op.cpp:21-72``: per-relation chain partition → shuffle →
+split → shared join). Here the shuffle/split stages collapse into tag
+routing (a chunk's tag IS its logical partition), since data movement
+between logical partitions inside one host is free — the heavy exchange
+path lives in ``cylon_tpu.parallel.shuffle``.
+"""
+
+from typing import Callable, Iterable, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from cylon_tpu.ops import setops as _setops
+from cylon_tpu.ops.groupby import groupby_aggregate
+from cylon_tpu.ops.hash import partition_ids
+from cylon_tpu.ops.join import join as _join
+from cylon_tpu.ops.selection import concat_tables, filter_table
+from cylon_tpu.ops_graph.op import Op, RootOp, TableChunk
+from cylon_tpu.table import Table
+
+
+def chunk_stream(table: Table, chunk_rows: int) -> Iterable[Table]:
+    """Slice a host-backed table into capacity-``chunk_rows`` chunks (the
+    ingest side of the streaming pipeline; parity: the reference streams
+    arrow record batches)."""
+    n = table.num_rows
+    for lo in range(0, max(n, 1), chunk_rows):
+        hi = min(lo + chunk_rows, n)
+        idx = jnp.arange(lo, lo + chunk_rows, dtype=jnp.int32)
+        from cylon_tpu.ops.selection import take_columns
+
+        yield take_columns(table, jnp.clip(idx, 0, max(n - 1, 0)), hi - lo)
+
+
+class PartitionOp(Op):
+    """Hash-partition each chunk into ``n_partitions`` sub-chunks, tagged
+    by partition id (parity: ``ops/partition_op.cpp`` +
+    ``ops/kernels/partition.cpp``)."""
+
+    def __init__(self, op_id: int, key_cols: Sequence[str],
+                 n_partitions: int):
+        super().__init__(op_id, name="PartitionOp")
+        self._keys = list(key_cols)
+        self._n = n_partitions
+
+    def execute(self, tag: int, table: Table):
+        names = self._keys or table.column_names
+        keys = [table.column(c).data for c in names]
+        vals = [table.column(c).validity for c in names]
+        pid = partition_ids(keys, self._n, vals)
+        for p in range(self._n):
+            yield TableChunk(p, filter_table(table, pid == p))
+
+
+class _SidePort(Op):
+    """Adapter routing chunks into one side of a binary op (the
+    reference distinguishes relations by tag ranges in
+    ``dis_join_op.cpp:34-71``; explicit ports are clearer)."""
+
+    def __init__(self, op_id: int, target: "JoinOp", side: int):
+        super().__init__(op_id, name=f"Port{side}")
+        self._target = target
+        self._side = side
+        self.add_child(target)
+
+    def execute(self, tag: int, table: Table):
+        self._target.accept(self._side, tag, table)
+        return ()
+
+
+class JoinOp(Op):
+    """Per-partition accumulate-then-join (parity: ``ops/join_op.cpp`` +
+    ``ops/kernels/join_kernel.cpp`` — the reference also concatenates a
+    relation's queued chunks before the local join)."""
+
+    def __init__(self, op_id: int, **join_kw):
+        super().__init__(op_id, name="JoinOp")
+        self._kw = join_kw
+        self._buf: dict[int, tuple[list, list]] = {}
+
+    def left_port(self, op_id: int) -> Op:
+        return _SidePort(op_id, self, 0)
+
+    def right_port(self, op_id: int) -> Op:
+        return _SidePort(op_id, self, 1)
+
+    def accept(self, side: int, tag: int, table: Table) -> None:
+        self._buf.setdefault(tag, ([], []))[side].append(table)
+
+    def on_finalize(self):
+        for tag in sorted(self._buf):
+            lefts, rights = self._buf[tag]
+            if not lefts or not rights:
+                # hash partitioning emits every partition (possibly empty)
+                # per chunk, so a truly absent side means the relation got
+                # no input at all
+                continue
+            lt = concat_tables(lefts) if len(lefts) > 1 else lefts[0]
+            rt = concat_tables(rights) if len(rights) > 1 else rights[0]
+            res = _join(lt, rt, **self._kw)
+            res.num_rows  # raises OutOfCapacity on overflow (host-side)
+            yield TableChunk(tag, res)
+
+
+class UnionOp(Op):
+    """Per-partition set union (parity: ``ops/union_op.cpp`` +
+    ``ops/kernels/union_kernel``)."""
+
+    def __init__(self, op_id: int, out_capacity: int | None = None):
+        super().__init__(op_id, name="UnionOp")
+        self._buf: dict[int, list] = {}
+        self._out_capacity = out_capacity
+
+    def execute(self, tag: int, table: Table):
+        self._buf.setdefault(tag, []).append(table)
+        return ()
+
+    def on_finalize(self):
+        for tag in sorted(self._buf):
+            chunks = self._buf[tag]
+            t = concat_tables(chunks) if len(chunks) > 1 else chunks[0]
+            yield TableChunk(tag, _setops.unique(
+                t, out_capacity=self._out_capacity))
+
+
+class GroupByOp(Op):
+    """Streaming groupby: each chunk is pre-combined on arrival (the
+    partials are tiny), finalize re-aggregates — parity with the
+    pre-combine → final combine structure of ``DistributedHashGroupBy``
+    (``groupby/groupby.cpp:62-78``) applied to the chunk dimension."""
+
+    _MERGE = {"sum": "sum", "count": "sum", "size": "sum",
+              "min": "min", "max": "max"}
+
+    def __init__(self, op_id: int, by: Sequence[str], aggs,
+                 out_capacity: int | None = None):
+        super().__init__(op_id, name="GroupByOp")
+        self._by = list(by)
+        self._aggs = [(a[0], a[1], a[2] if len(a) > 2 else f"{a[0]}_{a[1]}")
+                      for a in (tuple(x) for x in aggs)]
+        self._out_capacity = out_capacity
+        self._decomposable = all(op in self._MERGE
+                                 for _, op, _ in self._aggs)
+        self._buf: dict[int, list] = {}
+
+    def execute(self, tag: int, table: Table):
+        if self._decomposable:
+            part = groupby_aggregate(
+                table, self._by,
+                [(src, op, out) for src, op, out in self._aggs])
+            self._buf.setdefault(tag, []).append(part)
+        else:
+            self._buf.setdefault(tag, []).append(table)
+        return ()
+
+    def on_finalize(self):
+        for tag in sorted(self._buf):
+            chunks = self._buf[tag]
+            t = concat_tables(chunks) if len(chunks) > 1 else chunks[0]
+            if self._decomposable:
+                final = [(out, self._MERGE[op], out)
+                         for _, op, out in self._aggs]
+            else:
+                final = self._aggs
+            yield TableChunk(tag, groupby_aggregate(
+                t, self._by, final, out_capacity=self._out_capacity))
+
+
+class DisJoinOp:
+    """Prebuilt join graph (parity: ``DisJoinOP``, dis_join_op.cpp:21-72:
+    per relation partition → [shuffle] → shared join → callback).
+
+    ``n_partitions`` logical partitions bound per-partition working-set
+    size (the reference's parallelism knob); chunks stream through
+    ``insert_left/right`` and results arrive at the root after
+    ``finish()``.
+    """
+
+    def __init__(self, key_cols: Sequence[str] | str, n_partitions: int = 4,
+                 callback: Callable | None = None, **join_kw):
+        keys = [key_cols] if isinstance(key_cols, str) else list(key_cols)
+        join_kw.setdefault("on", keys if len(keys) > 1 else keys[0])
+        self.root = RootOp(0, callback)
+        self.join = JoinOp(1, **join_kw)
+        self.join.add_child(self.root)
+        lport = self.join.left_port(2)
+        rport = self.join.right_port(3)
+        self.left_partition = PartitionOp(4, keys, n_partitions)
+        self.right_partition = PartitionOp(5, keys, n_partitions)
+        self.left_partition.add_child(lport)
+        self.right_partition.add_child(rport)
+        self.ops = [self.left_partition, self.right_partition, lport, rport,
+                    self.join, self.root]
+
+    def insert_left(self, table: Table, tag: int = 0):
+        self.left_partition.insert(tag, table)
+
+    def insert_right(self, table: Table, tag: int = 0):
+        self.right_partition.insert(tag, table)
+
+    def finish(self):
+        self.left_partition.finish()
+        self.right_partition.finish()
+
+    def result(self, execution=None) -> Table:
+        """Drive to completion and concatenate per-partition results."""
+        from cylon_tpu.ops_graph.execution import JoinExecution
+
+        if execution is None:
+            execution = JoinExecution(
+                [self.left_partition], [self.right_partition],
+                [self.join, self.root])
+        self.finish()
+        chunks = self.root.wait_for_completion(execution)
+        tables = [c.table for c in chunks]
+        if not tables:
+            raise ValueError("join produced no partitions")
+        return concat_tables(tables) if len(tables) > 1 else tables[0]
+
+
+class DisUnionOp:
+    """Prebuilt union graph (parity: ``DisUnionOp``,
+    ``ops/dis_union_op.cpp``)."""
+
+    def __init__(self, n_partitions: int = 4,
+                 callback: Callable | None = None,
+                 out_capacity: int | None = None,
+                 key_cols: Sequence[str] | None = None):
+        self.root = RootOp(0, callback)
+        self.union = UnionOp(1, out_capacity)
+        self.union.add_child(self.root)
+        self._keys = key_cols
+        self._n = n_partitions
+        self._partitions: list[PartitionOp] = []
+
+    def add_input(self, key_cols: Sequence[str] | None = None) -> PartitionOp:
+        keys = list(key_cols or self._keys or ())
+        p = PartitionOp(10 + len(self._partitions), keys, self._n)
+        p.add_child(self.union)
+        self._partitions.append(p)
+        return p
+
+    def finish(self):
+        for p in self._partitions:
+            p.finish()
+
+    def result(self, execution=None) -> Table:
+        from cylon_tpu.ops_graph.execution import RoundRobinExecution
+
+        if execution is None:
+            execution = RoundRobinExecution(
+                self._partitions + [self.union, self.root])
+        self.finish()
+        chunks = self.root.wait_for_completion(execution)
+        tables = [c.table for c in chunks]
+        if not tables:
+            raise ValueError("union produced no partitions")
+        return concat_tables(tables) if len(tables) > 1 else tables[0]

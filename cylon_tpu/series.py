@@ -1,0 +1,261 @@
+"""Series: a single named device column with elementwise compute.
+
+Parity target: ``python/pycylon/series.py`` (Series over a single
+Cylon column) plus the single-column slice of the compute engine
+(``python/pycylon/data/compute.pyx``: comparison/math ops :455-700,
+``is_in`` :702, ``drop_na`` :728). All elementwise math lowers to one
+fused XLA program on the padded device array; validity (null) masks
+propagate through operations the way Arrow's validity bitmaps do.
+"""
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cylon_tpu import dtypes
+from cylon_tpu.column import Column
+from cylon_tpu.errors import InvalidArgument, TypeError_
+
+
+class Series:
+    """One named column + valid-row count (parity: pycylon ``Series``)."""
+
+    def __init__(self, data=None, name: str = "", capacity: int | None = None,
+                 nrows=None):
+        if isinstance(data, Series):
+            self._col, self._nrows, self.name = data._col, data._nrows, name or data.name
+            return
+        if isinstance(data, Column):
+            # a bare Column carries no row count; pass nrows when the
+            # column's capacity exceeds its logical length (padding)
+            self._col = data
+            self._nrows = jnp.asarray(
+                data.capacity if nrows is None else nrows, jnp.int32)
+        else:
+            arr = np.asarray(data)
+            self._col = Column.from_numpy(arr, capacity)
+            self._nrows = jnp.asarray(len(arr), jnp.int32)
+        self.name = name
+
+    @staticmethod
+    def _wrap(col: Column, nrows, name: str = "") -> "Series":
+        s = object.__new__(Series)
+        s._col, s._nrows, s.name = col, nrows, name
+        return s
+
+    # -- accessors -------------------------------------------------------
+    @property
+    def column(self) -> Column:
+        return self._col
+
+    @property
+    def dtype(self) -> dtypes.DType:
+        return self._col.dtype
+
+    @property
+    def nrows(self):
+        return self._nrows
+
+    def __len__(self):
+        return int(self._nrows)
+
+    @property
+    def shape(self):
+        return (len(self),)
+
+    @property
+    def values(self) -> np.ndarray:
+        return self.to_numpy()
+
+    def to_numpy(self) -> np.ndarray:
+        return self._col.to_numpy(len(self))
+
+    def to_pandas(self):
+        import pandas as pd
+
+        return pd.Series(self.to_numpy(), name=self.name or None)
+
+    def __repr__(self):
+        return f"Series(name={self.name!r}, {self.to_numpy()!r})"
+
+    # -- elementwise engine ---------------------------------------------
+    def _valid(self) -> jax.Array | None:
+        return self._col.validity
+
+    def _binop(self, other, fn: Callable, out_kind=None) -> "Series":
+        c = self._col
+        if c.dtype.is_dictionary:
+            raise TypeError_("math on string series requires codes/decode")
+        if isinstance(other, Series):
+            o, ov = other._col.data, other._col.validity
+        elif isinstance(other, Column):
+            o, ov = other.data, other.validity
+        else:
+            o, ov = other, None
+        data = fn(c.data, o)
+        validity = c.validity
+        if ov is not None:
+            validity = ov if validity is None else (validity & ov)
+        dt = (dtypes.from_numpy_dtype(np.dtype(data.dtype))
+              if out_kind is None else out_kind)
+        return Series._wrap(Column(data, validity, dt), self._nrows, self.name)
+
+    def _rbinop(self, other, fn):
+        return self._binop(other, lambda a, b: fn(b, a))
+
+    def __add__(self, o): return self._binop(o, jnp.add)
+    def __radd__(self, o): return self._rbinop(o, jnp.add)
+    def __sub__(self, o): return self._binop(o, jnp.subtract)
+    def __rsub__(self, o): return self._rbinop(o, jnp.subtract)
+    def __mul__(self, o): return self._binop(o, jnp.multiply)
+    def __rmul__(self, o): return self._rbinop(o, jnp.multiply)
+    def __truediv__(self, o): return self._binop(o, jnp.true_divide)
+    def __rtruediv__(self, o): return self._rbinop(o, jnp.true_divide)
+    def __floordiv__(self, o): return self._binop(o, jnp.floor_divide)
+    def __rfloordiv__(self, o): return self._rbinop(o, jnp.floor_divide)
+    def __mod__(self, o): return self._binop(o, jnp.mod)
+    def __pow__(self, o): return self._binop(o, jnp.power)
+    def __neg__(self): return self._binop(0, lambda a, _: jnp.negative(a))
+    def __abs__(self): return self._binop(0, lambda a, _: jnp.abs(a))
+
+    def __eq__(self, o): return self._binop(o, jnp.equal, dtypes.bool_)    # noqa: E501
+    def __ne__(self, o): return self._binop(o, jnp.not_equal, dtypes.bool_)
+    def __lt__(self, o): return self._binop(o, jnp.less, dtypes.bool_)
+    def __le__(self, o): return self._binop(o, jnp.less_equal, dtypes.bool_)
+    def __gt__(self, o): return self._binop(o, jnp.greater, dtypes.bool_)
+    def __ge__(self, o): return self._binop(o, jnp.greater_equal, dtypes.bool_)
+
+    def __and__(self, o): return self._binop(o, jnp.logical_and, dtypes.bool_)
+    def __or__(self, o): return self._binop(o, jnp.logical_or, dtypes.bool_)
+    def __xor__(self, o): return self._binop(o, jnp.logical_xor, dtypes.bool_)
+
+    def __invert__(self):
+        return self._binop(0, lambda a, _: jnp.logical_not(a), dtypes.bool_)
+
+    def __hash__(self):  # __eq__ is elementwise; keep identity hashing
+        return id(self)
+
+    # -- null handling ---------------------------------------------------
+    def null_flags(self) -> jax.Array:
+        """[capacity] bool, True where missing (validity or float NaN)."""
+        from cylon_tpu.ops.selection import _null_flags
+
+        f = _null_flags(self._col)
+        return (jnp.zeros(self._col.capacity, bool) if f is None
+                else f.astype(bool))
+
+    def isnull(self) -> "Series":
+        return Series._wrap(Column(self.null_flags(), None, dtypes.bool_),
+                            self._nrows, self.name)
+
+    isna = isnull
+
+    def notnull(self) -> "Series":
+        return Series._wrap(Column(~self.null_flags(), None, dtypes.bool_),
+                            self._nrows, self.name)
+
+    notna = notnull
+
+    def fillna(self, value) -> "Series":
+        c = self._col
+        if c.dtype.is_dictionary:
+            from cylon_tpu.ops.dictenc import encode_fill_value
+
+            if c.validity is None:
+                return self
+            c2, code = encode_fill_value(c, value)
+            data = jnp.where(c2.validity, c2.data, jnp.int32(code))
+            return Series._wrap(Column(data, None, c2.dtype, c2.dictionary),
+                                self._nrows, self.name)
+        data = c.data
+        if jnp.issubdtype(data.dtype, jnp.floating):
+            data = jnp.where(jnp.isnan(data), value, data)
+        if c.validity is not None:
+            data = jnp.where(c.validity, data, jnp.asarray(value, data.dtype))
+        return Series._wrap(Column(data, None, c.dtype, c.dictionary),
+                            self._nrows, self.name)
+
+    def dropna(self) -> "Series":
+        from cylon_tpu.ops import kernels
+
+        mask = ~self.null_flags()
+        perm, count = kernels.compact_mask(mask, self._nrows)
+        c = self._col
+        safe = jnp.clip(perm, 0, max(c.capacity - 1, 0))
+        col = Column(c.data[safe],
+                     None if c.validity is None else c.validity[safe],
+                     c.dtype, c.dictionary)
+        return Series._wrap(col, count, self.name)
+
+    # -- membership / map ------------------------------------------------
+    def isin(self, values) -> "Series":
+        """Parity: ``compute.pyx`` is_in (:702)."""
+        c = self._col
+        vset = list(values)
+        if c.dtype.is_dictionary:
+            lut = {v: i for i, v in enumerate(c.dictionary.values)}
+            probe = jnp.asarray([lut.get(v, -1) for v in vset] or [-1],
+                                jnp.int32)
+        else:
+            probe = jnp.asarray(np.asarray(vset, np.dtype(c.data.dtype)))
+        mask = (c.data[:, None] == probe[None, :]).any(axis=1)
+        if c.validity is not None:
+            mask = mask & c.validity
+        return Series._wrap(Column(mask, None, dtypes.bool_), self._nrows,
+                            self.name)
+
+    def map(self, fn: Callable) -> "Series":
+        """Elementwise map (parity: ``compute.pyx`` infer_map :805). A
+        jnp-traceable ``fn`` compiles into the XLA graph; anything else
+        falls back to a host round-trip like the reference's inferred
+        python loop."""
+        c = self._col
+        if c.dtype.is_dictionary:
+            from cylon_tpu.ops.dictenc import reencode_values
+
+            vals = [fn(v) for v in c.dictionary.values]
+            return Series._wrap(reencode_values(c, vals), self._nrows,
+                                self.name)
+        try:
+            data = jax.vmap(fn)(c.data)
+            dt = dtypes.from_numpy_dtype(np.dtype(data.dtype))
+            return Series._wrap(Column(data, c.validity, dt), self._nrows,
+                                self.name)
+        except Exception:
+            host = np.array([fn(v) for v in self.to_numpy()])
+            out = Series(host, self.name)
+            return out
+
+    applymap = map
+
+    # -- reductions ------------------------------------------------------
+    def _reduce(self, op: str):
+        from cylon_tpu.ops import aggregates
+        from cylon_tpu.table import Table
+
+        t = Table({self.name or "x": self._col}, self._nrows)
+        return np.asarray(
+            aggregates.table_aggregate(t, self.name or "x", op))[()]
+
+    def sum(self): return self._reduce("sum")
+    def count(self): return self._reduce("count")
+    def min(self): return self._reduce("min")
+    def max(self): return self._reduce("max")
+    def mean(self): return self._reduce("mean")
+    def var(self): return self._reduce("var")
+    def std(self): return self._reduce("std")
+    def nunique(self): return self._reduce("nunique")
+
+    def unique(self) -> np.ndarray:
+        """Distinct values, host-side (parity: ``table.pyx`` unique on a
+        single column)."""
+        vals = self.to_numpy()
+        seen, out = set(), []
+        for v in vals:
+            k = v if v == v else None  # NaN folds
+            if k not in seen:
+                seen.add(k)
+                out.append(v)
+        return np.asarray(out, dtype=vals.dtype)

@@ -1,0 +1,69 @@
+"""Table catalog (string-id registry) tests — parity with
+``cpp/src/cylon/table_api.hpp`` usage from the Java binding
+(``java/src/main/native/src/Table.cpp``)."""
+
+import numpy as np
+import pytest
+
+from cylon_tpu import Table
+from cylon_tpu import catalog
+
+
+@pytest.fixture(autouse=True)
+def clean():
+    catalog.clear()
+    yield
+    catalog.clear()
+
+
+def _t(d):
+    return Table.from_pydict({k: np.asarray(v) for k, v in d.items()})
+
+
+def test_put_get_remove():
+    t = _t({"a": [1, 2, 3]})
+    catalog.put_table("t1", t)
+    assert catalog.get_table("t1") is t
+    assert catalog.list_tables() == ["t1"]
+    catalog.remove_table("t1")
+    with pytest.raises(Exception, match="no table"):
+        catalog.get_table("t1")
+
+
+def test_join_by_id():
+    catalog.put_table("left", _t({"k": [1, 2, 3], "a": [10, 20, 30]}))
+    catalog.put_table("right", _t({"k": [2, 3, 4], "b": [200, 300, 400]}))
+    catalog.join_tables("left", "right", "out", on="k", how="inner")
+    out = catalog.get_table("out")
+    d = out.to_pydict()
+    assert sorted(d["k"]) == [2, 3]
+
+
+def test_setops_by_id():
+    catalog.put_table("a", _t({"x": [1, 2, 3]}))
+    catalog.put_table("b", _t({"x": [2, 3, 4]}))
+    catalog.intersect_tables("a", "b", "i")
+    catalog.union_tables("a", "b", "u")
+    catalog.subtract_tables("a", "b", "s")
+    assert sorted(catalog.table_to_pydict("i")["x"]) == [2, 3]
+    assert sorted(catalog.table_to_pydict("u")["x"]) == [1, 2, 3, 4]
+    assert catalog.table_to_pydict("s")["x"] == [1]
+
+
+def test_sort_unique_select_by_id():
+    catalog.put_table("t", _t({"x": [3, 1, 2, 1], "y": [1, 2, 3, 4]}))
+    catalog.sort_table("t", "s", "x")
+    assert catalog.table_to_pydict("s")["x"] == [1, 1, 2, 3]
+    catalog.unique_table("t", "u", cols=["x"])
+    assert sorted(catalog.table_to_pydict("u")["x"]) == [1, 2, 3]
+    catalog.select_columns("t", "p", ["y"])
+    assert list(catalog.get_table("p").column_names) == ["y"]
+
+
+def test_read_csv_by_id(tmp_path):
+    p = tmp_path / "f.csv"
+    p.write_text("a,b\n1,x\n2,y\n")
+    catalog.read_csv("csvt", str(p))
+    d = catalog.table_to_pydict("csvt")
+    assert d["a"] == [1, 2]
+    assert d["b"] == ["x", "y"]

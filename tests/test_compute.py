@@ -1,0 +1,261 @@
+"""Compute-engine parity tests.
+
+Mirrors ``python/test/test_compute.py`` + ``test_series.py`` coverage:
+elementwise math/comparison, membership, null handling, map, Series,
+with pandas as the oracle.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from cylon_tpu import DataFrame, Series
+
+
+@pytest.fixture
+def pdf():
+    return pd.DataFrame({
+        "a": np.array([1, 2, 3, 4], np.int64),
+        "b": np.array([10.0, np.nan, 30.0, 40.0]),
+    })
+
+
+def test_dataframe_math_dunders(pdf):
+    df = DataFrame(pdf[["a"]])
+    assert (df + 1).to_pandas()["a"].tolist() == [2, 3, 4, 5]
+    assert (df - 1).to_pandas()["a"].tolist() == [0, 1, 2, 3]
+    assert (df * 2).to_pandas()["a"].tolist() == [2, 4, 6, 8]
+    assert (df // 2).to_pandas()["a"].tolist() == [0, 1, 1, 2]
+    assert (df % 2).to_pandas()["a"].tolist() == [1, 0, 1, 0]
+    assert (df ** 2).to_pandas()["a"].tolist() == [1, 4, 9, 16]
+    assert (2 + df).to_pandas()["a"].tolist() == [3, 4, 5, 6]
+    assert (10 - df).to_pandas()["a"].tolist() == [9, 8, 7, 6]
+    assert (-df).to_pandas()["a"].tolist() == [-1, -2, -3, -4]
+    assert abs(df - 3).to_pandas()["a"].tolist() == [2, 1, 0, 1]
+
+
+def test_dataframe_bool_dunders():
+    a = DataFrame({"x": np.array([True, True, False, False])})
+    b = DataFrame({"x": np.array([True, False, True, False])})
+    assert (a & b).to_pandas()["x"].tolist() == [True, False, False, False]
+    assert (a | b).to_pandas()["x"].tolist() == [True, True, True, False]
+    assert (a ^ b).to_pandas()["x"].tolist() == [False, True, True, False]
+    assert (~a).to_pandas()["x"].tolist() == [False, False, True, True]
+
+
+def test_dropna_rows(pdf):
+    df = DataFrame(pdf)
+    got = df.dropna().to_pandas().reset_index(drop=True)
+    exp = pdf.dropna().reset_index(drop=True)
+    pd.testing.assert_frame_equal(got, exp)
+
+
+def test_dropna_how_all():
+    p = pd.DataFrame({"a": [1.0, np.nan, 3.0], "b": [np.nan, np.nan, 30.0]})
+    df = DataFrame(p)
+    got_any = df.dropna(how="any").to_pandas().reset_index(drop=True)
+    got_all = df.dropna(how="all").to_pandas().reset_index(drop=True)
+    pd.testing.assert_frame_equal(got_any, p.dropna(how="any").reset_index(drop=True))
+    pd.testing.assert_frame_equal(got_all, p.dropna(how="all").reset_index(drop=True))
+
+
+def test_dropna_subset(pdf):
+    df = DataFrame(pdf)
+    got = df.dropna(subset=["a"]).to_pandas().reset_index(drop=True)
+    pd.testing.assert_frame_equal(got, pdf.dropna(subset=["a"]).reset_index(drop=True))
+
+
+def test_dropna_columns(pdf):
+    df = DataFrame(pdf)
+    got = df.dropna(axis=1)
+    assert got.columns == ["a"]
+
+
+def test_where_mask(pdf):
+    df = DataFrame(pdf[["a"]])
+    cond = df > 2
+    got = df.where(cond).to_pandas()
+    exp = pdf[["a"]].where(pdf[["a"]] > 2)
+    # int columns go through validity -> None -> NaN on export
+    assert [x if x == x else None for x in got["a"]] == \
+        [x if x == x else None for x in exp["a"]]
+    got2 = df.where(cond, -1).to_pandas()
+    pd.testing.assert_frame_equal(got2, pdf[["a"]].where(pdf[["a"]] > 2, -1))
+    got3 = df.mask(cond, -1).to_pandas()
+    pd.testing.assert_frame_equal(got3, pdf[["a"]].mask(pdf[["a"]] > 2, -1))
+
+
+def test_applymap(pdf):
+    df = DataFrame(pdf[["a"]])
+    got = df.applymap(lambda x: x * 10).to_pandas()
+    pd.testing.assert_frame_equal(got, pdf[["a"]].map(lambda x: x * 10))
+    got = df.map(lambda x: x + 1).to_pandas()
+    pd.testing.assert_frame_equal(got, pdf[["a"]].map(lambda x: x + 1))
+    # string dictionary map
+    sdf = DataFrame({"s": np.array(["ab", "cd", "ab"])})
+    got = sdf.applymap(lambda s: s.upper()).to_pandas()
+    assert got["s"].tolist() == ["AB", "CD", "AB"]
+
+
+def test_equals(pdf):
+    df = DataFrame(pdf)
+    assert df.equals(DataFrame(pdf))
+    assert not df.equals(DataFrame(pdf[["a"]]))
+
+
+def test_series_basics():
+    s = Series([1, 2, 3, 4], name="x")
+    assert len(s) == 4
+    assert s.sum() == 10
+    assert s.mean() == 2.5
+    assert (s + 1).to_numpy().tolist() == [2, 3, 4, 5]
+    assert (s * s).to_numpy().tolist() == [1, 4, 9, 16]
+    assert (s > 2).to_numpy().tolist() == [False, False, True, True]
+    assert (1 / s).to_numpy()[0] == 1.0
+    assert s.isin([2, 4]).to_numpy().tolist() == [False, True, False, True]
+    assert s.map(lambda v: v * 2).to_numpy().tolist() == [2, 4, 6, 8]
+
+
+def test_series_nulls():
+    s = Series(np.array([1.0, np.nan, 3.0]), name="x")
+    assert s.isnull().to_numpy().tolist() == [False, True, False]
+    assert s.notna().to_numpy().tolist() == [True, False, True]
+    assert s.fillna(0.0).to_numpy().tolist() == [1.0, 0.0, 3.0]
+    assert s.dropna().to_numpy().tolist() == [1.0, 3.0]
+    assert s.count() == 2
+
+
+def test_series_strings():
+    s = Series(np.array(["b", "a", "b"]), name="s")
+    assert s.nunique() == 2
+    assert s.isin(["b"]).to_numpy().tolist() == [True, False, True]
+    assert s.map(str.upper).to_numpy().tolist() == ["B", "A", "B"]
+    assert sorted(s.unique().tolist()) == ["a", "b"]
+
+
+def test_series_fillna_strings():
+    s = Series(np.array(["x", None, "y"], object), name="s")
+    assert s.fillna("z").to_numpy().tolist() == ["x", "z", "y"]
+
+
+def test_map_preserves_dictionary_order():
+    # non-monotone map must re-sort the dictionary so code order == value
+    # order (sorts/joins/loc-ranges depend on it)
+    s = Series(np.array(["a", "b", "c"]), name="s")
+    m = s.map({"a": "z", "b": "m", "c": "a"}.get)
+    assert m.to_numpy().tolist() == ["z", "m", "a"]
+    vals = m.column.dictionary.values
+    assert list(vals) == sorted(vals)
+    from cylon_tpu import DataFrame
+
+    df = DataFrame({"s": np.array(["a", "b", "c"])})
+    got = df.applymap({"a": "z", "b": "m", "c": "a"}.get)
+    srt = got.sort_values("s").to_pandas()["s"].tolist()
+    assert srt == ["a", "m", "z"]
+
+
+def test_series_from_padded_column():
+    from cylon_tpu import DataFrame
+
+    df = DataFrame({"v": np.array([1.0, 2.0, 3.0])})
+    sub = df[np.array([False, True, True])]  # capacity 3, nrows 2
+    t = sub.to_table()
+    s = Series(t.column("v"), "v", nrows=t.nrows)
+    assert len(s) == 2
+    assert s.sum() == 5.0
+
+
+def test_where_float_nan_variants(pdf):
+    from cylon_tpu import DataFrame
+
+    df = DataFrame(pdf[["a"]])
+    for nan in (np.nan, float("nan"), None):
+        got = df.where(df > 2, nan).to_pandas()
+        assert [x if x == x else None for x in got["a"]] == \
+            [None, None, 3, 4]
+
+
+def test_copy_constructor_keeps_index(pdf):
+    from cylon_tpu import DataFrame
+
+    d = DataFrame(pdf).set_index("a")
+    copy = DataFrame(d)
+    assert copy.loc[3].to_pandas()["b"].tolist() == [30.0]
+
+
+def test_iloc_rejects_bool(pdf):
+    from cylon_tpu import DataFrame
+
+    with pytest.raises(Exception, match="bool"):
+        DataFrame(pdf).iloc[True]
+
+
+def test_loc_string_range_nonexistent_bounds():
+    from cylon_tpu import DataFrame, IndexingType
+
+    df = DataFrame({"s": np.array(["a", "b", "c", "d"]),
+                    "v": np.arange(4)})
+    d = df.set_index("s", indexing_type=IndexingType.LINEAR, drop=False)
+    got = d.loc["a":"cz"].to_pandas()
+    assert got["s"].tolist() == ["a", "b", "c"]
+
+
+def test_bitwise_int_semantics():
+    from cylon_tpu import DataFrame
+
+    df = DataFrame({"x": np.array([6, 3, 1], np.int64)})
+    assert (df & 1).to_pandas()["x"].tolist() == [0, 1, 1]
+    assert (df | 8).to_pandas()["x"].tolist() == [14, 11, 9]
+    assert (~df).to_pandas()["x"].tolist() == [-7, -4, -2]
+
+
+def test_where_string_and_null_other():
+    from cylon_tpu import DataFrame
+
+    df = DataFrame({"s": np.array(["a", "b", "c"])})
+    cond = np.array([True, False, True])
+    got = df.where(cond, "zz").to_pandas()
+    assert got["s"].tolist() == ["a", "zz", "c"]
+    # cond False overrides a prior null with `other`
+    p = pd.DataFrame({"k": pd.array([1, None, 3], dtype="Int64")})
+    d = DataFrame(p)
+    got = d.where(np.array([True, False, True]), 0).to_pandas()
+    assert got["k"].tolist() == [1, 0, 3]
+
+
+def test_iloc_keeps_labels():
+    from cylon_tpu import DataFrame
+
+    df = DataFrame({"v": np.arange(10.0)})
+    sub = df.iloc[[5, 3]]
+    assert sub.loc[5].to_pandas()["v"].tolist() == [5.0]
+    sub2 = df.loc[2:4]
+    assert sub2.loc[[3]].to_pandas()["v"].tolist() == [3.0]
+
+
+def test_native_engine_rejects_unsupported_options(tmp_path):
+    from cylon_tpu.config import CSVReadOptions
+    from cylon_tpu.io import read_csv
+
+    p = tmp_path / "x.csv"
+    p.write_text("a\n1\n2\n3\n")
+    with pytest.raises(Exception, match="native csv engine"):
+        read_csv(str(p), CSVReadOptions(skip_rows=1), engine="native")
+    # auto falls back to arrow for non-plain options
+    df = read_csv(str(p), CSVReadOptions(skip_rows=1), engine="auto")
+    assert len(df) == 2
+
+
+def test_native_engine_ioerror(tmp_path):
+    from cylon_tpu.errors import IOError_
+    from cylon_tpu.io import read_csv
+
+    with pytest.raises(IOError_):
+        read_csv(str(tmp_path / "missing.csv"), engine="native")
+
+
+def test_series_from_frame(pdf):
+    df = DataFrame(pdf)
+    s = df.series("a")
+    assert s.name == "a"
+    assert s.to_pandas().tolist() == [1, 2, 3, 4]

@@ -1,0 +1,106 @@
+"""Native host runtime tests (memory pool, murmur3, CSV loader).
+
+Parity oracles: the canonical murmur3_x86_32 test vectors (the reference
+vendors the same algorithm in ``util/murmur3.cpp``) and pyarrow's CSV
+reader for the loader.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from cylon_tpu import native
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason=f"native runtime not built: "
+                                       f"{native.build_error()}")
+
+
+def test_memory_pool_stats_and_reuse():
+    p = native.MemoryPool()
+    try:
+        a = p.alloc(1000)
+        assert a != 0
+        s = p.stats()
+        assert s["bytes_allocated"] == 1024  # 64B-aligned roundup
+        assert s["max_memory"] == 1024
+        p.free(a, 1000)
+        s = p.stats()
+        assert s["bytes_allocated"] == 0
+        assert s["pooled_bytes"] == 1024
+        b = p.alloc(1000)
+        assert b == a  # came from the free list
+        assert p.stats()["pooled_bytes"] == 0
+        p.free(b, 1000)
+    finally:
+        p.close()
+
+
+def test_murmur3_known_vectors():
+    # canonical MurmurHash3_x86_32 vectors
+    assert native.murmur3_32(b"", 0) == 0
+    assert native.murmur3_32(b"hello", 0) == 0x248BFA47
+    assert native.murmur3_32(b"hello, world", 0) == 0x149BBB7F
+    assert native.murmur3_32(b"The quick brown fox jumps over the lazy dog",
+                             0x9747B28C) == 0x2FA826CD
+
+
+def test_murmur3_bulk_matches_scalar():
+    keys = np.array([0, 1, -5, 2**40, -2**50], np.int64)
+    bulk = native.murmur3_int64(keys, seed=7)
+    for i, k in enumerate(keys):
+        assert bulk[i] == native.murmur3_32(
+            int(k).to_bytes(8, "little", signed=True), 7)
+
+
+@pytest.mark.parametrize("n_threads", [1, 4])
+def test_csv_loader_vs_pandas(tmp_path, rng, n_threads):
+    n = 5000
+    pdf = pd.DataFrame({
+        "i": rng.integers(-1000, 1000, n),
+        "f": rng.normal(size=n).round(6),
+        "s": np.array(["v" + str(x) for x in rng.integers(0, 50, n)]),
+    })
+    path = tmp_path / "data.csv"
+    pdf.to_csv(path, index=False)
+    t = native.csv_to_table(str(path), n_threads=n_threads)
+    got = t.to_pandas()
+    pd.testing.assert_frame_equal(got, pdf)
+
+
+def test_csv_loader_nulls(tmp_path):
+    path = tmp_path / "n.csv"
+    path.write_text("a,b,s\n1,1.5,x\n2,,y\n,3.5,\n")
+    t = native.csv_to_table(str(path))
+    d = t.to_pydict()
+    assert d["a"] == [1, 2, None]
+    assert d["b"][0] == 1.5 and d["b"][2] == 3.5 and d["b"][1] != d["b"][1]
+    assert d["s"] == ["x", "y", None]
+
+
+def test_csv_string_dictionary_sorted(tmp_path):
+    path = tmp_path / "s.csv"
+    path.write_text("s\nzebra\napple\nmango\napple\n")
+    t = native.csv_to_table(str(path))
+    c = t.columns["s"]
+    vals = list(c.dictionary.values)
+    assert vals == sorted(vals)
+    assert t.to_pydict()["s"] == ["zebra", "apple", "mango", "apple"]
+
+
+def test_read_csv_native_engine(tmp_path):
+    from cylon_tpu.io import read_csv
+
+    path = tmp_path / "e.csv"
+    path.write_text("a,b\n1,2.5\n3,4.5\n")
+    df = read_csv(str(path), engine="native")
+    assert df.to_pandas()["a"].tolist() == [1, 3]
+    df2 = read_csv([str(path), str(path)], engine="native")
+    assert len(df2) == 4
+
+
+def test_csv_crlf_and_empty_lines(tmp_path):
+    path = tmp_path / "c.csv"
+    path.write_bytes(b"a,b\r\n1,2\r\n\r\n3,4\r\n")
+    t = native.csv_to_table(str(path))
+    assert t.to_pydict() == {"a": [1, 3], "b": [2, 4]}

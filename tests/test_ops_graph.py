@@ -1,0 +1,127 @@
+"""Op-graph streaming engine tests.
+
+Mirrors the reference's op-graph examples (``cpp/src/examples/ops/``:
+streaming DisJoinOP / DisUnionOp driven by an Execution) with pandas as
+the oracle; chunked input exercises the accumulate/finalize protocol.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from cylon_tpu import Table
+from cylon_tpu.ops_graph import (
+    DisJoinOp,
+    DisUnionOp,
+    GroupByOp,
+    Op,
+    PartitionOp,
+    PriorityExecution,
+    RootOp,
+    RoundRobinExecution,
+    SequentialExecution,
+)
+from cylon_tpu.ops_graph.graph import chunk_stream
+
+
+def _t(d):
+    return Table.from_pydict({k: np.asarray(v) for k, v in d.items()})
+
+
+def test_op_wiring_and_finalize():
+    seen = []
+    a = Op(1, execute=lambda tag, t: t)
+    b = Op(2, execute=lambda tag, t: (seen.append(tag), None)[1])
+    a.add_child(b)
+    a.insert(7, _t({"x": [1]}))
+    a.insert(8, _t({"x": [2]}))
+    ex = RoundRobinExecution([a, b])
+    a.finish()
+    assert ex.is_complete()
+    assert seen == [7, 8]
+    assert a.done() and b.done()
+
+
+def test_partition_op_covers_all_rows():
+    t = _t({"k": np.arange(100, dtype=np.int64), "v": np.arange(100)})
+    part = PartitionOp(1, ["k"], 4)
+    root = RootOp(0)
+    part.add_child(root)
+    part.insert(0, t)
+    part.finish()
+    while root.progress():
+        pass
+    got = sorted(x for c in root.results for x in c.table.to_pydict()["k"])
+    assert got == list(range(100))
+    assert {c.tag for c in root.results} == {0, 1, 2, 3}
+
+
+@pytest.mark.parametrize("execution_cls", ["join", "roundrobin", "priority",
+                                           "sequential"])
+def test_streaming_join_matches_pandas(execution_cls, rng):
+    n = 300
+    lp = pd.DataFrame({"k": rng.integers(0, 40, n), "a": rng.normal(size=n)})
+    rp = pd.DataFrame({"k": rng.integers(0, 40, n), "b": rng.normal(size=n)})
+    g = DisJoinOp("k", n_partitions=4, how="inner", out_capacity=8 * n)
+    for chunk in chunk_stream(Table.from_pandas(lp), 64):
+        g.insert_left(chunk)
+    for chunk in chunk_stream(Table.from_pandas(rp), 128):
+        g.insert_right(chunk)
+
+    if execution_cls == "join":
+        execution = None  # default JoinExecution
+    elif execution_cls == "roundrobin":
+        execution = RoundRobinExecution(g.ops)
+    elif execution_cls == "priority":
+        execution = PriorityExecution([(op, i + 1)
+                                       for i, op in enumerate(g.ops)])
+    else:
+        execution = SequentialExecution(g.ops)
+
+    res = g.result(execution).to_pandas()
+    exp = lp.merge(rp, on="k", how="inner")
+    key = ["k", "a", "b"]
+    pd.testing.assert_frame_equal(
+        res.sort_values(key).reset_index(drop=True)[key],
+        exp.sort_values(key).reset_index(drop=True)[key])
+
+
+def test_streaming_union_matches_pandas(rng):
+    a = pd.DataFrame({"x": rng.integers(0, 30, 100)})
+    b = pd.DataFrame({"x": rng.integers(20, 50, 100)})
+    g = DisUnionOp(n_partitions=3)
+    pa = g.add_input(["x"])
+    pb = g.add_input(["x"])
+    for chunk in chunk_stream(Table.from_pandas(a), 32):
+        pa.insert(0, chunk)
+    for chunk in chunk_stream(Table.from_pandas(b), 32):
+        pb.insert(0, chunk)
+    res = g.result().to_pandas()
+    exp = sorted(set(a["x"]) | set(b["x"]))
+    assert sorted(res["x"].tolist()) == exp
+
+
+def test_streaming_groupby_matches_pandas(rng):
+    n = 400
+    p = pd.DataFrame({"k": rng.integers(0, 25, n), "v": rng.normal(size=n)})
+    t = Table.from_pandas(p)
+    gb = GroupByOp(1, ["k"], [("v", "sum", "s"), ("v", "count", "c")])
+    root = RootOp(0)
+    gb.add_child(root)
+    for chunk in chunk_stream(t, 100):
+        gb.insert(0, chunk)
+    gb.finish()
+    while root.progress():
+        pass
+    res = pd.concat([c.table.to_pandas() for c in root.results])
+    exp = p.groupby("k").agg(s=("v", "sum"), c=("v", "count")).reset_index()
+    res = res.sort_values("k").reset_index(drop=True)
+    np.testing.assert_allclose(res["s"], exp["s"])
+    np.testing.assert_array_equal(res["c"], exp["c"])
+
+
+def test_insert_after_finalize_raises():
+    op = Op(1)
+    op.finish()
+    with pytest.raises(Exception, match="finalize"):
+        op.insert(0, _t({"x": [1]}))
