@@ -1,0 +1,15 @@
+"""TPC-H workload: data generator + Q3/Q5 pipelines.
+
+BASELINE.json config 5 ("TPC-H SF100 Q3/Q5 multi-way join + groupby
+pipeline") names TPC-H as a headline benchmark of the rebuild; the
+reference itself ships only the synthetic join benchmarks
+(``cpp/src/examples/bench/``), so this subsystem is the benchmark-parity
+layer: a deterministic dbgen-style generator and the two queries
+expressed over the :class:`cylon_tpu.frame.DataFrame` surface, runnable
+locally or distributed over the mesh (``env=``).
+"""
+
+from cylon_tpu.tpch.dbgen import date_int, generate, generate_pandas
+from cylon_tpu.tpch.queries import q3, q5
+
+__all__ = ["generate", "generate_pandas", "date_int", "q3", "q5"]

@@ -1,0 +1,121 @@
+"""Deterministic dbgen-style TPC-H data generator.
+
+Generates the six tables Q3/Q5 touch (region, nation, customer,
+supplier, orders, lineitem) with TPC-H's cardinality ratios and the
+value distributions the two queries are sensitive to (mktsegment
+5-way uniform; orderdate uniform over the 1992-1998 window; shipdate =
+orderdate + U[1,121]; discount U[0,0.10]; 1-7 lineitems per order).
+
+Dates are int32 days-since-epoch: TPU tables are fixed-width numeric,
+and TPC-H date predicates are pure comparisons, so an ordinal integer
+is the faithful device representation (strings would be
+dictionary-coded anyway; dates ARE their own codes).
+
+Row counts per scale factor follow TPC-H: customer 150k·sf,
+supplier 10k·sf, orders 1.5M·sf, lineitem ~6M·sf, nation 25, region 5.
+"""
+
+import datetime
+from typing import Mapping
+
+import numpy as np
+
+_EPOCH = datetime.date(1970, 1, 1).toordinal()
+
+REGIONS = np.array(["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"],
+                   dtype=object)
+# TPC-H nation table: (name, regionkey)
+NATIONS = [
+    ("ALGERIA", 0), ("ARGENTINA", 1), ("BRAZIL", 1), ("CANADA", 1),
+    ("EGYPT", 4), ("ETHIOPIA", 0), ("FRANCE", 3), ("GERMANY", 3),
+    ("INDIA", 2), ("INDONESIA", 2), ("IRAN", 4), ("IRAQ", 4),
+    ("JAPAN", 2), ("JORDAN", 4), ("KENYA", 0), ("MOROCCO", 0),
+    ("MOZAMBIQUE", 0), ("PERU", 1), ("CHINA", 2), ("ROMANIA", 3),
+    ("SAUDI ARABIA", 4), ("VIETNAM", 2), ("RUSSIA", 3),
+    ("UNITED KINGDOM", 3), ("UNITED STATES", 1),
+]
+SEGMENTS = np.array(["AUTOMOBILE", "BUILDING", "FURNITURE", "HOUSEHOLD",
+                     "MACHINERY"], dtype=object)
+
+
+def date_int(year: int, month: int, day: int) -> int:
+    """Calendar date -> int32 days-since-epoch (the on-device encoding)."""
+    return datetime.date(year, month, day).toordinal() - _EPOCH
+
+
+_START = date_int(1992, 1, 1)
+_END = date_int(1998, 8, 2)
+
+
+def generate(sf: float = 0.01, seed: int = 0) -> Mapping[str, dict]:
+    """Generate all six tables as ``{name: {column: np.ndarray}}``.
+
+    ``sf`` is the TPC-H scale factor (1.0 => 6M-row lineitem); fractional
+    values scale every table proportionally (min 1 row), so tests run at
+    sf≈0.001 with the same shape of data the benchmark runs at sf=100.
+    """
+    rng = np.random.default_rng(seed)
+    n_cust = max(int(150_000 * sf), 10)
+    n_supp = max(int(10_000 * sf), 5)
+    n_ord = max(int(1_500_000 * sf), 20)
+
+    region = {
+        "r_regionkey": np.arange(5, dtype=np.int64),
+        "r_name": REGIONS.copy(),
+    }
+    nation = {
+        "n_nationkey": np.arange(len(NATIONS), dtype=np.int64),
+        "n_name": np.array([n for n, _ in NATIONS], dtype=object),
+        "n_regionkey": np.array([r for _, r in NATIONS], dtype=np.int64),
+    }
+    customer = {
+        "c_custkey": np.arange(1, n_cust + 1, dtype=np.int64),
+        "c_nationkey": rng.integers(0, len(NATIONS), n_cust).astype(np.int64),
+        "c_mktsegment": SEGMENTS[rng.integers(0, len(SEGMENTS), n_cust)],
+        "c_acctbal": np.round(rng.uniform(-999.99, 9999.99, n_cust), 2),
+    }
+    supplier = {
+        "s_suppkey": np.arange(1, n_supp + 1, dtype=np.int64),
+        "s_nationkey": rng.integers(0, len(NATIONS), n_supp).astype(np.int64),
+        "s_acctbal": np.round(rng.uniform(-999.99, 9999.99, n_supp), 2),
+    }
+    o_orderdate = rng.integers(_START, _END + 1, n_ord).astype(np.int32)
+    orders = {
+        "o_orderkey": np.arange(1, n_ord + 1, dtype=np.int64),
+        "o_custkey": rng.integers(1, n_cust + 1, n_ord).astype(np.int64),
+        "o_orderdate": o_orderdate,
+        "o_shippriority": np.zeros(n_ord, dtype=np.int64),
+        "o_totalprice": np.round(rng.uniform(800.0, 500_000.0, n_ord), 2),
+    }
+    # 1..7 lineitems per order (TPC-H mean 4)
+    per_order = rng.integers(1, 8, n_ord)
+    l_orderkey = np.repeat(orders["o_orderkey"], per_order)
+    n_li = len(l_orderkey)
+    l_orderdate = np.repeat(o_orderdate, per_order)
+    lineitem = {
+        "l_orderkey": l_orderkey,
+        "l_suppkey": rng.integers(1, n_supp + 1, n_li).astype(np.int64),
+        "l_quantity": rng.integers(1, 51, n_li).astype(np.int64),
+        "l_extendedprice": np.round(rng.uniform(900.0, 105_000.0, n_li), 2),
+        "l_discount": np.round(rng.integers(0, 11, n_li) / 100.0, 2),
+        "l_shipdate": (l_orderdate
+                       + rng.integers(1, 122, n_li)).astype(np.int32),
+    }
+    return {
+        "region": region,
+        "nation": nation,
+        "customer": customer,
+        "supplier": supplier,
+        "orders": orders,
+        "lineitem": lineitem,
+    }
+
+
+def generate_pandas(sf: float = 0.01, seed: int = 0):
+    """Same data as :func:`generate`, as pandas DataFrames (the
+    correctness oracle side, mirroring the reference's pandas-parity
+    test pattern, ``python/test/test_df_dist_sorting.py``)."""
+    import pandas as pd
+
+    return {name: pd.DataFrame(cols)
+            for name, cols in generate(sf, seed).items()}

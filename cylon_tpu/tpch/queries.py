@@ -1,0 +1,143 @@
+"""TPC-H Q3 / Q5 over the DataFrame surface.
+
+Each query is the standard multi-way join + groupby pipeline
+(BASELINE.json config 5), written exactly as a PyCylon user would write
+it (``DataFrame.merge`` / ``groupby`` / ``sort_values``, env-dispatch
+per ``python/pycylon/frame.py:1728-1743``): pass ``env=None`` for
+single-chip execution or a :class:`cylon_tpu.context.CylonEnv` to run
+every join/groupby as a fused shard_map program over the mesh.
+
+Row-local predicates (segment/date filters) are applied before the
+first shuffle — the same predicate-pushdown any TPC-H implementation
+does — so the all-to-all only moves surviving rows.
+"""
+
+from typing import Mapping
+
+import jax.numpy as jnp
+
+from cylon_tpu.errors import InvalidArgument
+from cylon_tpu.frame import DataFrame
+from cylon_tpu.table import Table
+from cylon_tpu.tpch.dbgen import date_int
+
+
+def _df(x) -> DataFrame:
+    if isinstance(x, DataFrame):
+        return x
+    return DataFrame(x)
+
+
+def _tables(data: Mapping, names) -> list[DataFrame]:
+    """Coerce inputs to *local-layout* DataFrames. Masks in the query
+    bodies are built on ``df.table`` and applied via ``df[mask]``, which
+    filters the gathered layout — materialising upfront keeps the two
+    views identical even when a caller feeds a distributed frame in."""
+    missing = [n for n in names if n not in data]
+    if missing:
+        raise InvalidArgument(f"tpch input missing tables {missing}")
+    return [_df(data[n])._materialized() for n in names]
+
+
+def _eq_str(df: DataFrame, col: str, value: str) -> jnp.ndarray:
+    """Boolean row mask ``col == value`` for a string column (rides
+    ``Series.isin``, which handles dictionary codes and null masking)."""
+    return df.series(col).isin([value]).column.data
+
+
+def _with_revenue(li: DataFrame) -> DataFrame:
+    """lineitem + revenue = l_extendedprice * (1 - l_discount)
+    (Series arithmetic: validity intersection comes for free)."""
+    rev = li.series("l_extendedprice") * (1 - li.series("l_discount"))
+    return DataFrame._wrap(li.table.add_column("revenue", rev.column))
+
+
+def q3(data: Mapping, env=None, segment: str = "BUILDING",
+       cutoff: int | None = None, limit: int = 10) -> DataFrame:
+    """TPC-H Q3 (shipping priority): revenue of unshipped orders for one
+    market segment.
+
+    SELECT l_orderkey, SUM(l_extendedprice*(1-l_discount)) AS revenue,
+           o_orderdate, o_shippriority
+    FROM customer, orders, lineitem
+    WHERE c_mktsegment = :segment AND c_custkey = o_custkey
+      AND l_orderkey = o_orderkey
+      AND o_orderdate < :cutoff AND l_shipdate > :cutoff
+    GROUP BY l_orderkey, o_orderdate, o_shippriority
+    ORDER BY revenue DESC, o_orderdate LIMIT :limit
+    """
+    if cutoff is None:
+        cutoff = date_int(1995, 3, 15)
+    customer, orders, lineitem = _tables(
+        data, ["customer", "orders", "lineitem"])
+
+    cust = customer[_eq_str(customer, "c_mktsegment", segment)]
+    cust = cust[["c_custkey"]]
+    ords = orders[jnp.asarray(orders.table.column("o_orderdate").data
+                              < jnp.int32(cutoff))]
+    ords = ords[["o_orderkey", "o_custkey", "o_orderdate", "o_shippriority"]]
+    li = lineitem[jnp.asarray(lineitem.table.column("l_shipdate").data
+                              > jnp.int32(cutoff))]
+    li = _with_revenue(li)[["l_orderkey", "revenue"]]
+
+    oc = ords.merge(cust, left_on="o_custkey", right_on="c_custkey",
+                    how="inner", env=env)
+    j = li.merge(oc, left_on="l_orderkey", right_on="o_orderkey",
+                 how="inner", env=env)
+    g = j.groupby(["l_orderkey", "o_orderdate", "o_shippriority"],
+                  env=env).agg([("revenue", "sum", "revenue")])
+    out = g.sort_values(["revenue", "o_orderdate"], ascending=[False, True])
+    out = out.head(limit)
+    return out[["l_orderkey", "revenue", "o_orderdate", "o_shippriority"]]
+
+
+def q5(data: Mapping, env=None, region: str = "ASIA",
+       date_from: int | None = None, date_to: int | None = None
+       ) -> DataFrame:
+    """TPC-H Q5 (local supplier volume): per-nation revenue where
+    customer and supplier share the nation, within one region and year.
+
+    SELECT n_name, SUM(l_extendedprice*(1-l_discount)) AS revenue
+    FROM customer, orders, lineitem, supplier, nation, region
+    WHERE c_custkey = o_custkey AND l_orderkey = o_orderkey
+      AND l_suppkey = s_suppkey AND c_nationkey = s_nationkey
+      AND s_nationkey = n_nationkey AND n_regionkey = r_regionkey
+      AND r_name = :region AND o_orderdate IN [:date_from, :date_to)
+    GROUP BY n_name ORDER BY revenue DESC
+    """
+    if date_from is None:
+        date_from = date_int(1994, 1, 1)
+    if date_to is None:
+        date_to = date_int(1995, 1, 1)
+    customer, orders, lineitem, supplier, nation, reg = _tables(
+        data, ["customer", "orders", "lineitem", "supplier", "nation",
+               "region"])
+
+    reg = reg[_eq_str(reg, "r_name", region)][["r_regionkey"]]
+    # nation ⋈ region: the in-region nations (tiny — stays local)
+    nat = nation.merge(reg, left_on="n_regionkey", right_on="r_regionkey",
+                       how="inner")[["n_nationkey", "n_name"]]
+    sup = supplier.merge(nat, left_on="s_nationkey",
+                         right_on="n_nationkey",
+                         how="inner")[["s_suppkey", "s_nationkey", "n_name"]]
+
+    od = orders.table.column("o_orderdate").data
+    ords = orders[jnp.asarray((od >= jnp.int32(date_from))
+                              & (od < jnp.int32(date_to)))]
+    ords = ords[["o_orderkey", "o_custkey"]]
+    cust = customer[["c_custkey", "c_nationkey"]]
+    li = _with_revenue(lineitem)[["l_orderkey", "l_suppkey", "revenue"]]
+
+    oc = ords.merge(cust, left_on="o_custkey", right_on="c_custkey",
+                    how="inner", env=env)
+    j = li.merge(oc, left_on="l_orderkey", right_on="o_orderkey",
+                 how="inner", env=env)
+    # the customer-supplier co-nation predicate folds into the supplier
+    # join as a second equi-key, so it runs shard-local after the
+    # shuffle — no gather, only surviving rows ever move
+    j = j.merge(sup, left_on=["l_suppkey", "c_nationkey"],
+                right_on=["s_suppkey", "s_nationkey"],
+                how="inner", env=env)
+    g = j.groupby(["n_name"], env=env).agg([("revenue", "sum", "revenue")])
+    out = g.sort_values(["revenue"], ascending=[False])
+    return out[["n_name", "revenue"]]
