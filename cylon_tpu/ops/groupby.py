@@ -34,6 +34,18 @@ AGG_OPS = ("sum", "count", "size", "min", "max", "mean", "var", "std",
            "nunique", "first", "last", "median", "quantile", "sumsq")
 
 
+def _segment_sum(vals, gid, num_segments: int):
+    """f32 sums ride the MXU one-hot Pallas kernel on TPU (scatter-add
+    is the slow path there); everything else stays on XLA's lowering."""
+    from cylon_tpu.ops import pallas_kernels
+
+    if (vals.dtype == jnp.float32
+            and pallas_kernels.segment_sum_ok(num_segments)
+            and pallas_kernels.usable_for(vals)):
+        return pallas_kernels.segment_sum(vals, gid, num_segments)
+    return jax.ops.segment_sum(vals, gid, num_segments=num_segments)
+
+
 def groupby_aggregate(table: Table, by: Sequence[str],
                       aggs: Sequence[tuple[str, str]] | Sequence[tuple[str, str, str]],
                       out_capacity: int | None = None,
@@ -96,8 +108,7 @@ def _aggregate_column(table: Table, src: str, op: str, gid, num_groups,
     if op == "sum":
         acc = kernels._acc_dtype(c.data.dtype)
         vals = jnp.where(value_ok, c.data, jnp.zeros((), c.data.dtype))
-        data = jax.ops.segment_sum(vals.astype(acc), gid_v,
-                                   num_segments=out_cap)
+        data = _segment_sum(vals.astype(acc), gid_v, out_cap)
         return Column(data, None, dtypes.from_numpy_dtype(acc))
     if op == "sumsq":
         f = jnp.float64 if c.data.dtype.itemsize >= 4 else jnp.float32

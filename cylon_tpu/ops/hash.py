@@ -16,9 +16,12 @@ from typing import Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-_C1 = jnp.uint32(0xCC9E2D51)
-_C2 = jnp.uint32(0x1B873593)
+# numpy (not jnp) scalars: these are also folded into the Pallas hash
+# kernel, where captured jnp constants are rejected at trace time
+_C1 = np.uint32(0xCC9E2D51)
+_C2 = np.uint32(0x1B873593)
 
 
 def _rotl32(x, r):
@@ -32,15 +35,15 @@ def _mix_word(h, k):
     k = k * _C2
     h = h ^ k
     h = _rotl32(h, 13)
-    return h * jnp.uint32(5) + jnp.uint32(0xE6546B64)
+    return h * np.uint32(5) + np.uint32(0xE6546B64)
 
 
 def _fmix32(h):
     """murmur3 finaliser (``util/murmur3.cpp`` fmix32)."""
     h = h ^ (h >> 16)
-    h = h * jnp.uint32(0x85EBCA6B)
+    h = h * np.uint32(0x85EBCA6B)
     h = h ^ (h >> 13)
-    h = h * jnp.uint32(0xC2B2AE35)
+    h = h * np.uint32(0xC2B2AE35)
     return h ^ (h >> 16)
 
 
@@ -65,17 +68,12 @@ def _words32(data: jax.Array) -> list[jax.Array]:
             (u64 >> 32).astype(jnp.uint32)]
 
 
-def hash_columns(arrays: Sequence[jax.Array],
-                 validities: Sequence[jax.Array | None] | None = None,
-                 seed: int = 0x9747B28C) -> jax.Array:
-    """[capacity] uint32 row hash over one or more key columns.
-
-    Nulls hash as a distinct word stream (validity folded in) so that
-    null == null for partitioning, matching ``dense_group_ids``.
-    """
-    n = arrays[0].shape[0]
-    h = jnp.full(n, jnp.uint32(seed))
-    nwords = 0
+def _row_words(arrays: Sequence[jax.Array],
+               validities: Sequence[jax.Array | None] | None
+               ) -> list[jax.Array]:
+    """Row key -> canonical uint32 word streams (nulls zeroed, validity
+    appended as its own word so null == null)."""
+    words = []
     for i, a in enumerate(arrays):
         v = validities[i] if validities is not None else None
         for w in _words32(a):
@@ -83,17 +81,43 @@ def hash_columns(arrays: Sequence[jax.Array],
                 # null payload bytes are arbitrary — zero them so all
                 # nulls hash identically
                 w = jnp.where(v, w, jnp.uint32(0))
-            h = _mix_word(h, w)
-            nwords += 1
+            words.append(w)
         if v is not None:
-            h = _mix_word(h, v.astype(jnp.uint32))
-            nwords += 1
-    h = h ^ jnp.uint32(4 * nwords)
+            words.append(v.astype(jnp.uint32))
+    return words
+
+
+def hash_columns(arrays: Sequence[jax.Array],
+                 validities: Sequence[jax.Array | None] | None = None,
+                 seed: int = 0x9747B28C) -> jax.Array:
+    """[capacity] uint32 row hash over one or more key columns.
+
+    Nulls hash as a distinct word stream (validity folded in) so that
+    null == null for partitioning, matching ``dense_group_ids``.
+    On TPU the mixing chain runs as one fused Pallas pass
+    (:mod:`cylon_tpu.ops.pallas_kernels`); the jnp fallback below is
+    bit-identical.
+    """
+    from cylon_tpu.ops import pallas_kernels
+
+    words = _row_words(arrays, validities)
+    if pallas_kernels.usable_for(words[0]):
+        return pallas_kernels.row_hash(words, seed=seed)
+    h = jnp.full(arrays[0].shape[0], jnp.uint32(seed))
+    for w in words:
+        h = _mix_word(h, w)
+    h = h ^ jnp.uint32(4 * len(words))
     return _fmix32(h)
 
 
 def partition_ids(arrays, num_partitions: int, validities=None) -> jax.Array:
     """hash % world — parity: ``MapToHashPartitions``
-    (``partition/partition.cpp:93-174``)."""
+    (``partition/partition.cpp:93-174``). Pallas path fuses the modulo
+    into the hash kernel."""
+    from cylon_tpu.ops import pallas_kernels
+
+    if pallas_kernels.usable_for(arrays[0]):
+        words = _row_words(arrays, validities)
+        return pallas_kernels.row_hash(words, num_partitions)
     return (hash_columns(arrays, validities) % jnp.uint32(num_partitions)
             ).astype(jnp.int32)

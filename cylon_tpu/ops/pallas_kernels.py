@@ -1,0 +1,212 @@
+"""Pallas TPU kernels for the hot relational loops.
+
+Reference hot loops (SURVEY §3.2–3.4): per-row murmur3 partition hashing
+(``arrow/arrow_partition_kernels.cpp:140-297``) and per-group aggregate
+accumulation (``groupby/hash_groupby.cpp:143,221-226``). On TPU both are
+memory-bound single-pass loops — exactly what Pallas is for:
+
+* :func:`row_hash` fuses the W-word murmur mixing chain (+ optional
+  ``% num_partitions``) into ONE pass over HBM, block-resident in VMEM.
+* :func:`segment_sum` re-expresses groupby scatter-add — which XLA
+  lowers to a slow sort/scatter on TPU — as one-hot **MXU matmuls**
+  accumulated across the grid: ``out[g] += onehot(gid)ᵀ · vals``.
+
+Both kernels run in ``interpret`` mode off-TPU, so the exact code path
+unit-tested on the CPU mesh (``tests/conftest.py``) is what compiles on
+real chips. Dispatch policy: :func:`enabled` — auto-on for the TPU
+backend, forceable via ``CYLON_PALLAS=1|0|interpret``.
+"""
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+# ---------------------------------------------------------------- dispatch
+
+#: group-count ceiling for the matmul segment-sum: above this the dense
+#: one-hot traffic (cap × ceil(G/512) reads) loses to XLA's sort-based
+#: lowering.
+SEGSUM_MAX_GROUPS = 8192
+
+_SUBLANES = 8          # Mosaic tile: second-to-last dim multiple of 8
+_HASH_LANES = 1024     # lanes per hash row; tile = 8x1024 elements
+_SEG_LANES = 512       # rows per segment-sum sublane; tile = 8x512
+_SEG_GBLOCK = 512      # group slots per out block (onehot = 1 MiB VMEM)
+
+
+def _mode() -> str:
+    return os.environ.get("CYLON_PALLAS", "auto").lower()
+
+
+def enabled() -> bool:
+    """Should ops route through the Pallas kernels?"""
+    m = _mode()
+    if m in ("0", "off", "false"):
+        return False
+    if m in ("1", "on", "true", "interpret"):
+        return True
+    return jax.default_backend() == "tpu"
+
+
+def _interpret() -> bool:
+    """Interpret off-TPU so CPU tests execute the same kernels."""
+    return _mode() == "interpret" or jax.default_backend() != "tpu"
+
+
+def _vma_varying(x) -> bool:
+    return bool(getattr(getattr(x, "aval", None), "vma", None))
+
+
+def usable_for(x) -> bool:
+    """Can the Pallas path run for this operand *here*? On TPU inside
+    ``shard_map`` Mosaic compiles fine (vma is forwarded to out_shape),
+    but the interpret-mode evaluator cannot mix vma-varying refs with
+    kernel constants (jax-ml/jax hlo_interpreter limitation) — there the
+    caller's jnp fallback (bit-identical) takes over."""
+    return enabled() and not (_interpret() and _vma_varying(x))
+
+
+def _pad_to(x: jax.Array, n: int, fill) -> jax.Array:
+    if x.shape[0] == n:
+        return x
+    return jnp.concatenate(
+        [x, jnp.full((n - x.shape[0],) + x.shape[1:], fill, x.dtype)])
+
+
+def _out_struct(shape, dtype, like) -> jax.ShapeDtypeStruct:
+    """Output aval matching ``like``'s mesh-axis variance — required for
+    pallas_call under ``shard_map(check_vma=True)`` (every distributed
+    op body here)."""
+    vma = getattr(getattr(like, "aval", None), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+# ---------------------------------------------------------------- row hash
+
+def _hash_kernel(nparts: int, nwords_tail: int, seed: int,
+                 *refs):
+    """One VMEM-resident block: the same murmur chain as
+    ``hash.hash_columns``'s jnp fallback — literally the same functions,
+    so the two paths cannot drift apart."""
+    from cylon_tpu.ops.hash import _fmix32, _mix_word
+
+    *word_refs, out_ref = refs
+    h = jnp.full(out_ref.shape, np.uint32(seed))
+    for wr in word_refs:
+        h = _mix_word(h, wr[...])
+    h = _fmix32(h ^ np.uint32(4 * nwords_tail))
+    if nparts:
+        out_ref[...] = (h % np.uint32(nparts)).astype(jnp.int32)
+    else:
+        out_ref[...] = h
+
+
+@functools.partial(jax.jit, static_argnames=("nparts", "nwords_tail",
+                                             "seed", "interpret"))
+def _row_hash_impl(words, nparts: int, nwords_tail: int,
+                   seed: int, interpret: bool) -> jax.Array:
+    cap = words[0].shape[0]
+    r, b = _SUBLANES, _HASH_LANES
+    tile = r * b
+    capp = -(-cap // tile) * tile
+    words2 = [_pad_to(w, capp, 0).reshape(capp // b, b) for w in words]
+    # x64 is package-global, but Mosaic rejects the i64 constants it
+    # puts into BlockSpec index maps — trace the kernel in 32-bit
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            functools.partial(_hash_kernel, nparts, nwords_tail, seed),
+            grid=(capp // tile,),
+            in_specs=[pl.BlockSpec((r, b), lambda i: (i, 0))] * len(words2),
+            out_specs=pl.BlockSpec((r, b), lambda i: (i, 0)),
+            out_shape=_out_struct((capp // b, b),
+                                  jnp.int32 if nparts else jnp.uint32,
+                                  words2[0]),
+            interpret=interpret,
+        )(*words2)
+    return out.reshape(capp)[:cap]
+
+
+def row_hash(words, nparts: int = 0, *, seed: int = 0x9747B28C) -> jax.Array:
+    """Murmur-mix ``words`` (list of uint32 ``[cap]`` arrays, one per
+    32-bit word of the row key) into a ``[cap]`` row hash; with
+    ``nparts`` also fuses ``% nparts`` → int32 partition ids.
+
+    Bit-identical to :func:`cylon_tpu.ops.hash.hash_columns`'s mixing
+    chain (same per-word block step + fmix32 finaliser).
+    """
+    return _row_hash_impl(tuple(words), nparts, len(words), seed,
+                          _interpret())
+
+
+# ------------------------------------------------------------ segment sum
+
+def _segsum_kernel(gblock: int, gid_ref, val_ref, out_ref):
+    """out[0, jG:(j+1)G] += onehot(gid)ᵀ · vals — MXU accumulation."""
+    i = pl.program_id(1)
+
+    @pl.when(i == 0)
+    def _init():
+        out_ref[...] = jnp.zeros_like(out_ref)
+
+    j = pl.program_id(0)
+    base = j * gblock
+    acc = jnp.zeros(out_ref.shape, out_ref.dtype)
+    for s in range(gid_ref.shape[0]):                  # static sublane loop
+        gid = gid_ref[s]                               # [B] int32
+        vals = val_ref[s]                              # [B] f32
+        lanes = jax.lax.broadcasted_iota(jnp.int32,
+                                         (gid.shape[0], gblock), 1)
+        onehot = (gid[:, None] - base == lanes).astype(vals.dtype)
+        # HIGHEST: default MXU precision truncates f32 operands to bf16
+        acc += jnp.dot(vals[None, :], onehot,
+                       preferred_element_type=out_ref.dtype,
+                       precision=jax.lax.Precision.HIGHEST)
+    out_ref[...] += acc
+
+
+@functools.partial(jax.jit, static_argnames=("num_segments", "interpret"))
+def _segment_sum_impl(vals: jax.Array, gid: jax.Array, num_segments: int,
+                      interpret: bool) -> jax.Array:
+    cap = vals.shape[0]
+    r, b, gb = _SUBLANES, _SEG_LANES, _SEG_GBLOCK
+    tile = r * b
+    capp = -(-cap // tile) * tile
+    gp = -(-num_segments // gb) * gb
+    # padding rows: gid := gp never matches a lane → zero contribution
+    vals = _pad_to(vals.astype(jnp.float32), capp, 0).reshape(capp // b, b)
+    gid = _pad_to(gid.astype(jnp.int32), capp, gp).reshape(capp // b, b)
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            functools.partial(_segsum_kernel, gb),
+            grid=(gp // gb, capp // tile),  # data sweep innermost: the
+            in_specs=[                      # out block stays VMEM-resident
+                pl.BlockSpec((r, b), lambda j, i: (i, 0)),   # while it
+                pl.BlockSpec((r, b), lambda j, i: (i, 0)),   # accumulates
+            ],
+            out_specs=pl.BlockSpec((1, gb), lambda j, i: (0, j)),
+            out_shape=_out_struct((1, gp), jnp.float32, vals),
+            interpret=interpret,
+        )(gid, vals)
+    return out[0, :num_segments]
+
+
+def segment_sum(vals: jax.Array, gid: jax.Array,
+                num_segments: int) -> jax.Array:
+    """f32 segment sum via one-hot MXU matmuls. Rows whose ``gid`` falls
+    outside ``[0, num_segments)`` are dropped (matching
+    ``jax.ops.segment_sum`` with out-of-range ids under clip-free
+    semantics used here: padding rows carry ``gid >= num_segments``).
+    """
+    return _segment_sum_impl(vals, gid, num_segments, _interpret())
+
+
+def segment_sum_ok(num_segments: int) -> bool:
+    """Policy gate: MXU path wins only while the dense one-hot traffic
+    stays below the sort-based lowering's."""
+    return enabled() and num_segments <= SEGSUM_MAX_GROUPS

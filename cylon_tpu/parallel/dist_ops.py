@@ -47,6 +47,16 @@ def _local_view(t: Table) -> Table:
     return t.with_nrows(t.nrows[0])
 
 
+def _checked_local(t: Table):
+    """Local view + carried-in poison flag: an upstream capacity-bounded
+    op may have marked this shard overflowed (nrows == capacity + 1).
+    Chained dist ops must keep that mark alive or the truncation goes
+    silent (the data itself is already clamped)."""
+    lt = _local_view(t)
+    of = lt.nrows > lt.capacity
+    return lt.with_nrows(jnp.minimum(lt.nrows, lt.capacity)), of
+
+
 def _shard_view(t: Table) -> Table:
     return t.with_nrows(t.nrows.reshape((1,)))
 
@@ -86,10 +96,12 @@ def shuffle(env: CylonEnv, table: Table, key_cols: Sequence[str],
     w = env.world_size
 
     def body(t):
-        lt = _local_view(t)
+        lt, inof = _checked_local(t)
         keys, vals = _key_data(lt, key_cols)
         pid = partition_ids(keys, w, vals)
-        return _shard_view(shuffle_local(lt, pid, out_l, bucket_cap))
+        res, of = checked_recv(shuffle_local(lt, pid, out_l, bucket_cap),
+                               out_l)
+        return _shard_view(poison(res, inof, of))
 
     return _smap(env, body, 1)(table)
 
@@ -104,14 +116,15 @@ def repartition(env: CylonEnv, table: Table,
     cap_l = dtable.local_capacity(table)
 
     def body(t):
-        lt = _local_view(t)
+        lt, inof = _checked_local(t)
         n = lt.nrows
         counts = jax.lax.all_gather(n[None], WORKER_AXIS).reshape(-1)
         me = jax.lax.axis_index(WORKER_AXIS)
         offset = (jnp.cumsum(counts) - counts)[me]
         pid = ((offset + jnp.arange(cap_l, dtype=jnp.int32)) % w
                ).astype(jnp.int32)
-        return _shard_view(shuffle_local(lt, pid, out_l))
+        res, of = checked_recv(shuffle_local(lt, pid, out_l), out_l)
+        return _shard_view(poison(res, inof, of))
 
     return _smap(env, body, 1)(table)
 
@@ -161,7 +174,8 @@ def dist_join(env: CylonEnv, left: Table, right: Table, *,
         join_l = -(-out_capacity // w)
 
     def body(lt, rt):
-        ltab, rtab = _local_view(lt), _local_view(rt)
+        ltab, liof = _checked_local(lt)
+        rtab, riof = _checked_local(rt)
         lkeys, lvals = _key_data(ltab, left_on)
         rkeys, rvals = _key_data(rtab, right_on)
         lpid = partition_ids(lkeys, w, lvals)
@@ -170,7 +184,7 @@ def dist_join(env: CylonEnv, left: Table, right: Table, *,
         rsh, rof = checked_recv(shuffle_local(rtab, rpid, shuf_r), shuf_r)
         res = _join_fn(lsh, rsh, left_on=left_on, right_on=right_on,
                        how=how, suffixes=suffixes, out_capacity=join_l)
-        return _shard_view(poison(res, lof, rof))
+        return _shard_view(poison(res, liof, riof, lof, rof))
 
     return _smap(env, body, 2)(left, right)
 
@@ -205,14 +219,14 @@ def dist_groupby(env: CylonEnv, table: Table, by: Sequence[str],
 
     if not decomposable:
         def body(t):
-            lt = _local_view(t)
+            lt, inof = _checked_local(t)
             keys, vals = _key_data(lt, by)
             pid = partition_ids(keys, w, vals)
             sh, of = checked_recv(shuffle_local(lt, pid, shuf_l), shuf_l)
             res = _groupby.groupby_aggregate(sh, by, aggs,
                                              out_capacity=out_l,
                                              quantile=quantile)
-            return _shard_view(poison(res, of))
+            return _shard_view(poison(res, inof, of))
 
         return _smap(env, body, 1)(table)
 
@@ -220,7 +234,7 @@ def dist_groupby(env: CylonEnv, table: Table, by: Sequence[str],
     pre, final, post = _combine_plan(aggs)
 
     def body(t):
-        lt = _local_view(t)
+        lt, inof = _checked_local(t)
         part = _groupby.groupby_aggregate(lt, by, pre)
         keys, vals = _key_data(part, by)
         pid = partition_ids(keys, w, vals)
@@ -228,7 +242,7 @@ def dist_groupby(env: CylonEnv, table: Table, by: Sequence[str],
         sh, of = checked_recv(shuffle_local(part, pid, shuf_l), shuf_l)
         res = _groupby.groupby_aggregate(sh, by, final, out_capacity=out_l)
         res = post(res)
-        return _shard_view(poison(res, of))
+        return _shard_view(poison(res, inof, of))
 
     return _smap(env, body, 1)(table)
 
@@ -321,7 +335,7 @@ def dist_sort(env: CylonEnv, table: Table, by: Sequence[str] | str,
     out_l = _out_cap_local(env, table, out_capacity=out_capacity)
 
     def body(t):
-        lt = _local_view(t)
+        lt, inof = _checked_local(t)
         c = t.column(by[0])
         key = kernels.order_key(c.data, asc0)
         if c.validity is not None:
@@ -345,7 +359,8 @@ def dist_sort(env: CylonEnv, table: Table, by: Sequence[str] | str,
         splitters = allsamp[cut]
         pid = jnp.searchsorted(splitters, key, side="left").astype(jnp.int32)
         sh, of = checked_recv(shuffle_local(lt, pid, out_l), out_l)
-        return _shard_view(poison(_sort_table(sh, by, ascending=asc), of))
+        return _shard_view(poison(_sort_table(sh, by, ascending=asc),
+                                  inof, of))
 
     return _smap(env, body, 1)(table)
 
@@ -362,14 +377,16 @@ def _dist_setop(env, a, b, local_op, out_capacity):
     out_l = None if out_capacity is None else -(-out_capacity // w)
 
     def body(ta, tb):
-        la, lb = _local_view(ta), _local_view(tb)
+        la, ina = _checked_local(ta)
+        lb, inb = _checked_local(tb)
         ka, va = _key_data(la, cols)
         kb, vb = _key_data(lb, cols)
         sa, ofa = checked_recv(
             shuffle_local(la, partition_ids(ka, w, va), shuf_a), shuf_a)
         sb, ofb = checked_recv(
             shuffle_local(lb, partition_ids(kb, w, vb), shuf_b), shuf_b)
-        return _shard_view(poison(local_op(sa, sb, out_l), ofa, ofb))
+        return _shard_view(poison(local_op(sa, sb, out_l),
+                                  ina, inb, ofa, ofb))
 
     return _smap(env, body, 2)(a, b)
 
@@ -410,11 +427,12 @@ def dist_unique(env: CylonEnv, table: Table,
     shuf_l = _out_cap_local(env, table, out_capacity=out_capacity)
 
     def body(t):
-        lt = _local_view(t)
+        lt, inof = _checked_local(t)
         keys, vals = _key_data(lt, names)
         pid = partition_ids(keys, w, vals)
         sh, of = checked_recv(shuffle_local(lt, pid, shuf_l), shuf_l)
-        return _shard_view(poison(_setops.unique(sh, cols, keep=keep), of))
+        return _shard_view(poison(_setops.unique(sh, cols, keep=keep),
+                                  inof, of))
 
     return _smap(env, body, 1)(table)
 
@@ -427,6 +445,7 @@ def dist_aggregate(env: CylonEnv, table: Table, col: str, op: str):
     from cylon_tpu.ops.selection import _null_flags
 
     table = _prep(env, table)
+    dtable.dist_num_rows(table)  # OutOfCapacity if any shard is poisoned
     w = env.world_size
     cap_l = dtable.local_capacity(table)
 

@@ -107,6 +107,7 @@ def gather_table(env: "CylonEnv | None", table: Table) -> Table:
     from cylon_tpu.ops import kernels
     from cylon_tpu.ops.selection import take_columns
 
+    dist_num_rows(table)  # raises OutOfCapacity on any poisoned shard
     mask = dist_row_mask(table)
     total = table.nrows.sum().astype(jnp.int32)
     keep = (~mask).astype(jnp.uint8)

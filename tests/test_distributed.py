@@ -220,3 +220,38 @@ def test_shuffle_overflow_poisons_pipeline(env8):
     assert "OutOfCapacity" in str(ei.type) or "capacity" in str(ei.value)
     # and the scalar path reports -1
     assert int(dist_aggregate(env8, dt, "v", "nunique")) in (-1, 160)
+
+
+def test_join_output_overflow_surfaces_through_chain(env8, rng):
+    """Regression: a local join whose output exceeds out_capacity poisons
+    its shard; gather_table and any chained dist op must surface that
+    (it used to be dropped -> silent truncation)."""
+    from cylon_tpu.errors import OutOfCapacity
+
+    n = 512
+    ldf = pd.DataFrame({"k": rng.integers(0, 8, n), "a": np.arange(n, dtype=np.float64)})
+    rdf = pd.DataFrame({"k": rng.integers(0, 8, n), "b": np.arange(n, dtype=np.float64)})
+    lt = scatter_table(env8, Table.from_pandas(ldf))
+    rt = scatter_table(env8, Table.from_pandas(rdf))
+    # ~n*n/8 = 32k join rows; cap them far below that
+    j = dist_join(env8, lt, rt, on="k", how="inner",
+                  out_capacity=2 * n, shuffle_capacity=8 * n)
+    with pytest.raises(OutOfCapacity):
+        gather_table(env8, j)
+    with pytest.raises(OutOfCapacity):
+        g = dist_groupby(env8, j, ["k"], [("a", "sum")])
+        dist_num_rows(g)
+
+
+def test_dist_aggregate_rejects_poisoned_input(env8, rng):
+    from cylon_tpu.errors import OutOfCapacity
+
+    n = 512
+    ldf = pd.DataFrame({"k": rng.integers(0, 8, n), "a": np.arange(n, dtype=np.float64)})
+    rdf = pd.DataFrame({"k": rng.integers(0, 8, n), "b": np.arange(n, dtype=np.float64)})
+    lt = scatter_table(env8, Table.from_pandas(ldf))
+    rt = scatter_table(env8, Table.from_pandas(rdf))
+    j = dist_join(env8, lt, rt, on="k", how="inner",
+                  out_capacity=2 * n, shuffle_capacity=8 * n)
+    with pytest.raises(OutOfCapacity):
+        dist_aggregate(env8, j, "a", "sum")
