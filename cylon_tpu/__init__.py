@@ -31,6 +31,11 @@ import jax as _jax
 if not _os.environ.get("CYLON_TPU_NO_X64"):
     _jax.config.update("jax_enable_x64", True)
 
+from cylon_tpu.utils.logging import init_logging as _init_logging
+
+# CYLON_LOG_LEVEL -> logger config (parity: pycylon/__init__.py:30-43)
+_init_logging()
+
 from cylon_tpu import dtypes
 from cylon_tpu.column import Column
 from cylon_tpu.config import (

@@ -533,16 +533,19 @@ class DataFrame:
         return DataFrame._wrap(Table(cols, t.nrows))
 
     # -- reductions ------------------------------------------------------
-    def _reduce(self, op: str, env: CylonEnv | None = None):
+    def _reduce(self, op: str, env: CylonEnv | None = None,
+                quantile: float = 0.5):
         out = {}
         local = None if env is not None else self._gathered()
         for name, c in self._table.columns.items():
             if not (c.dtype.is_numeric or op in ("count", "nunique")):
                 continue
             if env is not None:
-                out[name] = dist_aggregate(env, self._table, name, op)
+                out[name] = dist_aggregate(env, self._table, name, op,
+                                           quantile=quantile)
             else:
-                out[name] = _aggregates.table_aggregate(local, name, op)
+                out[name] = _aggregates.table_aggregate(local, name, op,
+                                                        quantile=quantile)
         return {k: np.asarray(v)[()] for k, v in out.items()}
 
     def sum(self, env=None): return self._reduce("sum", env)
@@ -553,6 +556,10 @@ class DataFrame:
     def var(self, env=None): return self._reduce("var", env)
     def std(self, env=None): return self._reduce("std", env)
     def nunique(self, env=None): return self._reduce("nunique", env)
+    def median(self, env=None): return self._reduce("median", env)
+
+    def quantile(self, q: float = 0.5, env=None):
+        return self._reduce("quantile", env, quantile=q)
 
     # -- materialisation -------------------------------------------------
     def _gathered(self) -> Table:

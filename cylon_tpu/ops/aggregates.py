@@ -15,12 +15,40 @@ from cylon_tpu.ops import kernels
 from cylon_tpu.ops.selection import _null_flags
 from cylon_tpu.table import Table
 
-AGGS = ("sum", "count", "min", "max", "mean", "var", "std", "nunique")
+AGGS = ("sum", "count", "min", "max", "mean", "var", "std", "nunique",
+        "median", "quantile")
 
 
-def table_aggregate(table: Table, col: str, op: str):
+def _masked_quantile(data, ok, q):
+    """Pandas-style linear-interpolation quantile over the valid rows:
+    sort with high sentinels, index at q*(n-1)."""
+    import jax
+
+    from cylon_tpu import dtypes as _dt
+
+    if isinstance(q, (int, float)) and not 0.0 <= q <= 1.0:
+        raise InvalidArgument(f"quantile {q} not in [0, 1]")
+
+    f = jnp.float64 if data.dtype.itemsize >= 4 else jnp.float32
+    sent = jnp.asarray(_dt.sentinel_high(data.dtype), data.dtype)
+    s = jnp.sort(jnp.where(ok, data, sent)).astype(f)
+    n = ok.sum(dtype=jnp.int32)
+    pos = jnp.asarray(q, f) * jnp.maximum(n - 1, 0).astype(f)
+    lo = jnp.floor(pos).astype(jnp.int32)
+    hi = jnp.ceil(pos).astype(jnp.int32)
+    cap_last = max(data.shape[0] - 1, 0)
+    vlo = s[jnp.clip(lo, 0, cap_last)]
+    vhi = s[jnp.clip(hi, 0, cap_last)]
+    out = vlo + (vhi - vlo) * (pos - lo.astype(f))
+    return jnp.where(n > 0, out, jnp.asarray(jnp.nan, f))
+
+
+def table_aggregate(table: Table, col: str, op: str, quantile: float = 0.5):
     """Scalar aggregate of one column, skipping nulls/NaN. Returns a
-    0-d jax array (device scalar; jit-safe)."""
+    0-d jax array (device scalar; jit-safe). Op set mirrors
+    ``AggregationOpId`` (compute/aggregate_kernels.hpp:40-52: SUM..MAX,
+    COUNT, MEAN, VAR, NUNIQUE, QUANTILE, STDDEV); ``quantile`` mirrors
+    ``QuantileKernelOptions`` (:81-84)."""
     if op not in AGGS:
         raise InvalidArgument(f"unknown aggregate {op!r}")
     c = table.column(col)
@@ -36,6 +64,9 @@ def table_aggregate(table: Table, col: str, op: str):
         gid, num_groups, _ = kernels.dense_group_ids(
             [data], ok, [None])
         return num_groups.astype(jnp.int64)
+    if op in ("median", "quantile"):
+        q = 0.5 if op == "median" else quantile
+        return _masked_quantile(data, ok, q)
     if op == "sum":
         acc = kernels._acc_dtype(data.dtype)
         return jnp.where(ok, data, jnp.zeros((), data.dtype)).astype(acc).sum()

@@ -1,0 +1,98 @@
+"""Partition-id assignment + local split.
+
+Parity: ``cpp/src/cylon/partition/partition.{hpp,cpp}`` —
+``MapToHashPartitions`` (:93), ``Split`` (:26) — and the per-dtype
+kernels of ``arrow/arrow_partition_kernels.cpp``: murmur
+``HashPartitionKernel`` (:140), ``ModuloPartitionKernel`` (:67); the
+Java surface additionally exposes round-robin
+(``Table.java:191 roundRobinPartition``). Range (sample-sort)
+partitioning lives with ``dist_sort``
+(``cylon_tpu/parallel/dist_ops.py``), as in the reference where
+``RangePartitionKernel`` exists for DistributedSort.
+
+On TPU a "split" cannot produce data-dependent shapes, so ``Split``'s
+unordered_map<partition, Table> becomes a list of capacity-bounded
+tables, each compacted by its partition mask.
+"""
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+from cylon_tpu.errors import InvalidArgument
+from cylon_tpu.ops import kernels
+from cylon_tpu.ops.hash import hash_columns, partition_ids
+from cylon_tpu.ops.selection import take_columns
+from cylon_tpu.table import Table
+
+__all__ = ["hash_partition_ids", "modulo_partition_ids",
+           "round_robin_ids", "assign_partitions", "split_by_partition",
+           "partition_table"]
+
+#: hash_partition_ids == ops.hash.partition_ids (murmur % nparts)
+hash_partition_ids = partition_ids
+
+
+def modulo_partition_ids(arrays: Sequence[jax.Array],
+                         num_partitions: int) -> jax.Array:
+    """First key column modulo nparts — the reference's cheap path for
+    already-uniform integer keys (``ModuloPartitionKernel``,
+    arrow_partition_kernels.cpp:67; single-column only there too)."""
+    a = arrays[0]
+    if not jnp.issubdtype(a.dtype, jnp.integer):
+        raise InvalidArgument(
+            f"modulo partitioning needs an integer key, got {a.dtype}")
+    return jnp.abs(a.astype(jnp.int64) % num_partitions).astype(jnp.int32)
+
+
+def round_robin_ids(nrows_or_cap, num_partitions: int,
+                    offset=0) -> jax.Array:
+    """Row index (plus global ``offset``) modulo nparts (parity:
+    ``roundRobinPartition``, Table.java:191)."""
+    cap = int(nrows_or_cap)
+    return ((offset + jnp.arange(cap, dtype=jnp.int32)) % num_partitions
+            ).astype(jnp.int32)
+
+
+def assign_partitions(table: Table, cols: Sequence[str],
+                      num_partitions: int, mode: str = "hash"
+                      ) -> jax.Array:
+    """[capacity] int32 partition id per row, by the named strategy."""
+    keys = [table.column(c).data for c in cols]
+    vals = [table.column(c).validity for c in cols]
+    if mode == "hash":
+        return partition_ids(keys, num_partitions, vals)
+    if mode == "modulo":
+        return modulo_partition_ids(keys, num_partitions)
+    if mode == "round_robin":
+        return round_robin_ids(table.capacity, num_partitions)
+    raise InvalidArgument(f"unknown partition mode {mode!r}")
+
+
+def split_by_partition(table: Table, pid: jax.Array, num_partitions: int,
+                       out_capacity: int | None = None) -> list[Table]:
+    """One compacted sub-table per partition id (parity: ``Split``,
+    partition/partition.cpp:26-92 building per-target tables)."""
+    cap = table.capacity
+    out_cap = out_capacity if out_capacity is not None else cap
+    vmask = kernels.valid_mask(cap, table.nrows)
+    outs = []
+    for p in range(num_partitions):
+        sel = vmask & (pid == p)
+        perm, n = kernels.compact_mask(sel, table.nrows)
+        idx = perm[:out_cap] if out_cap <= cap else jnp.pad(
+            perm, (0, out_cap - cap))
+        # a partition larger than out_cap is poisoned (nrows=cap+1) so
+        # materialisation raises instead of silently truncating
+        n_out = jnp.where(n > out_cap, jnp.int32(out_cap + 1), n)
+        outs.append(take_columns(table, idx, n_out))
+    return outs
+
+
+def partition_table(table: Table, cols: Sequence[str],
+                    num_partitions: int, mode: str = "hash",
+                    out_capacity: int | None = None) -> list[Table]:
+    """``HashPartition`` equivalent (table.hpp:338): assign + split."""
+    pid = assign_partitions(table, cols, num_partitions, mode)
+    return split_by_partition(table, pid, num_partitions, out_capacity)

@@ -22,6 +22,12 @@ from cylon_tpu.parallel.dtable import (
     scatter_table,
     dist_to_pandas,
 )
+from cylon_tpu.parallel.task_plan import (
+    LogicalTaskPlan,
+    task_shuffle,
+    task_tables,
+    task_view,
+)
 from cylon_tpu.parallel.dist_ops import (
     dist_aggregate,
     dist_groupby,

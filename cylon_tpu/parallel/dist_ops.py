@@ -35,6 +35,7 @@ from cylon_tpu.ops.dictenc import unify_table_dictionaries
 from cylon_tpu.parallel import dtable
 from cylon_tpu.parallel.shuffle import checked_recv, poison, shuffle_local
 from cylon_tpu.table import Table
+from cylon_tpu.utils.tracing import traced
 
 #: default headroom factor for post-shuffle local buffers (hash
 #: partitioning of uniform keys is balanced; skew beyond 2x should pass
@@ -86,11 +87,20 @@ def _out_cap_local(env, *tables, out_capacity=None, skew=DEFAULT_SKEW):
 
 
 # ------------------------------------------------------------------ shuffle
+@traced("shuffle")
 def shuffle(env: CylonEnv, table: Table, key_cols: Sequence[str],
             out_capacity: int | None = None,
-            bucket_cap: int | None = None) -> Table:
-    """Hash-shuffle rows so equal keys co-locate (parity:
-    ``Table::Shuffle``/``HashPartition``, table.hpp:329-338)."""
+            bucket_cap: int | None = None,
+            partitioning: str = "hash") -> Table:
+    """Shuffle rows so equal keys co-locate (parity:
+    ``Table::Shuffle``/``HashPartition``, table.hpp:329-338).
+    ``partitioning``: "hash" (murmur, the default everywhere) or
+    "modulo" (``ModuloPartitionKernel``,
+    arrow_partition_kernels.cpp:67 — first key column, integers)."""
+    from cylon_tpu.ops.partition import modulo_partition_ids
+
+    if partitioning not in ("hash", "modulo"):
+        raise InvalidArgument(f"unknown partitioning {partitioning!r}")
     table = _prep(env, table)
     out_l = _out_cap_local(env, table, out_capacity=out_capacity)
     w = env.world_size
@@ -98,7 +108,10 @@ def shuffle(env: CylonEnv, table: Table, key_cols: Sequence[str],
     def body(t):
         lt, inof = _checked_local(t)
         keys, vals = _key_data(lt, key_cols)
-        pid = partition_ids(keys, w, vals)
+        if partitioning == "hash":
+            pid = partition_ids(keys, w, vals)
+        else:
+            pid = modulo_partition_ids(keys, w)
         res, of = checked_recv(shuffle_local(lt, pid, out_l, bucket_cap),
                                out_l)
         return _shard_view(poison(res, inof, of))
@@ -106,6 +119,7 @@ def shuffle(env: CylonEnv, table: Table, key_cols: Sequence[str],
     return _smap(env, body, 1)(table)
 
 
+@traced("repartition")
 def repartition(env: CylonEnv, table: Table,
                 out_capacity: int | None = None) -> Table:
     """Round-robin row rebalancing (parity: Java ``roundRobinPartition``,
@@ -130,6 +144,7 @@ def repartition(env: CylonEnv, table: Table,
 
 
 # -------------------------------------------------------------------- join
+@traced("dist_join")
 def dist_join(env: CylonEnv, left: Table, right: Table, *,
               on=None, left_on=None, right_on=None, how: str = "inner",
               suffixes=("_x", "_y"), out_capacity: int | None = None,
@@ -195,6 +210,7 @@ _MERGEABLE = {"sum": "sum", "count": "sum", "size": "sum",
 _COMPOSITE = {"mean", "var", "std"}
 
 
+@traced("dist_groupby")
 def dist_groupby(env: CylonEnv, table: Table, by: Sequence[str],
                  aggs, out_capacity: int | None = None,
                  shuffle_capacity: int | None = None,
@@ -306,6 +322,7 @@ def _combine_plan(aggs):
 
 
 # -------------------------------------------------------------------- sort
+@traced("dist_sort")
 def dist_sort(env: CylonEnv, table: Table, by: Sequence[str] | str,
               ascending=True, options: SortOptions | None = None,
               out_capacity: int | None = None) -> Table:
@@ -391,6 +408,7 @@ def _dist_setop(env, a, b, local_op, out_capacity):
     return _smap(env, body, 2)(a, b)
 
 
+@traced("dist_union")
 def dist_union(env: CylonEnv, a: Table, b: Table,
                out_capacity: int | None = None) -> Table:
     """Parity: ``DistributedUnion`` (table.cpp:724-748)."""
@@ -399,6 +417,7 @@ def dist_union(env: CylonEnv, a: Table, b: Table,
                        out_capacity)
 
 
+@traced("dist_intersect")
 def dist_intersect(env: CylonEnv, a: Table, b: Table,
                    out_capacity: int | None = None) -> Table:
     """Parity: ``DistributedIntersect``."""
@@ -407,6 +426,7 @@ def dist_intersect(env: CylonEnv, a: Table, b: Table,
                        out_capacity)
 
 
+@traced("dist_subtract")
 def dist_subtract(env: CylonEnv, a: Table, b: Table,
                   out_capacity: int | None = None) -> Table:
     """Parity: ``DistributedSubtract``."""
@@ -415,6 +435,7 @@ def dist_subtract(env: CylonEnv, a: Table, b: Table,
                        out_capacity)
 
 
+@traced("dist_unique")
 def dist_unique(env: CylonEnv, table: Table,
                 cols: Sequence[str] | None = None,
                 out_capacity: int | None = None,
@@ -438,10 +459,13 @@ def dist_unique(env: CylonEnv, table: Table,
 
 
 # -------------------------------------------------------------- aggregates
-def dist_aggregate(env: CylonEnv, table: Table, col: str, op: str):
+@traced("dist_aggregate")
+def dist_aggregate(env: CylonEnv, table: Table, col: str, op: str,
+                   quantile: float = 0.5):
     """Distributed scalar aggregate (parity: ``compute::Sum/Count/Min/
-    Max`` + DoAllReduce, ``compute/aggregates.cpp:26-147``). Returns a
-    replicated 0-d array."""
+    Max`` + DoAllReduce, ``compute/aggregates.cpp:26-147``; quantile
+    extends the surface to the full ``AggregationOpId`` enum,
+    aggregate_kernels.hpp:40-52). Returns a replicated 0-d array."""
     from cylon_tpu.ops.selection import _null_flags
 
     table = _prep(env, table)
@@ -470,6 +494,19 @@ def dist_aggregate(env: CylonEnv, table: Table, col: str, op: str):
             sent = dtypes.sentinel_low(data.dtype)
             local = jnp.where(ok, data, jnp.asarray(sent, data.dtype)).max()
             return jax.lax.pmax(local, WORKER_AXIS)
+        if op in ("median", "quantile"):
+            from cylon_tpu.ops.aggregates import _masked_quantile
+
+            # exact global quantile: gather all shards' values (the
+            # reference has no distributed quantile; sketches can
+            # replace this if column width ever outgrows HBM)
+            all_data = jax.lax.all_gather(data, WORKER_AXIS).reshape(-1)
+            all_ok = jax.lax.all_gather(ok, WORKER_AXIS).reshape(-1)
+            q = 0.5 if op == "median" else quantile
+            res = _masked_quantile(all_data, all_ok, q)
+            # every shard computed the same value from the gathered
+            # column; pmax is an identity that proves replication
+            return jax.lax.pmax(res, WORKER_AXIS)
         if op == "nunique":
             pid = partition_ids([data], w, [c.validity])
             arrays = [data] + ([] if c.validity is None else [c.validity])

@@ -1,0 +1,166 @@
+"""Task-parallelism overlay: many logical tasks per worker.
+
+Parity: ``cpp/src/cylon/arrow/arrow_task_all_to_all.{h,cpp}`` —
+``LogicalTaskPlan`` (task_source/task_targets/worker_sources/
+worker_targets/task_to_worker, :24-47) and ``ArrowTaskAllToAll``
+(:56-75), the Twister2-style layer that lets a job address *logical
+task ids* while the physical exchange runs worker-to-worker.
+
+TPU-native shape: rows are labelled with a target task id; the plan
+resolves task→worker; one ordinary fused shuffle moves rows to the
+owning worker with the task id riding along as an extra column
+(``TASK_COL``); receivers split locally by task. The reference's
+mutex-guarded ``InsertTable(table, task)`` + progress loop collapses
+into one XLA program, like every other exchange here.
+"""
+
+from typing import Mapping, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cylon_tpu.column import Column
+from cylon_tpu.context import CylonEnv, WORKER_AXIS
+from cylon_tpu import dtypes
+from cylon_tpu.errors import InvalidArgument
+from cylon_tpu.parallel import dtable
+from cylon_tpu.parallel.shuffle import checked_recv, poison, shuffle_local
+from cylon_tpu.table import Table
+from cylon_tpu.utils.tracing import traced
+
+#: the carried task tag (stripped by :func:`task_view`)
+TASK_COL = "__task__"
+
+
+class LogicalTaskPlan:
+    """Static mapping of logical task ids onto mesh workers.
+
+    Mirrors the reference ctor fields (arrow_task_all_to_all.h:27-46);
+    ``task_sources``/``task_targets`` are the logical graph edge ends,
+    ``task_to_worker`` places every task on a worker.
+    """
+
+    def __init__(self, task_sources: Sequence[int],
+                 task_targets: Sequence[int],
+                 worker_sources: Sequence[int],
+                 worker_targets: Sequence[int],
+                 task_to_worker: Mapping[int, int]):
+        self.task_sources = list(task_sources)
+        self.task_targets = list(task_targets)
+        self.worker_sources = list(worker_sources)
+        self.worker_targets = list(worker_targets)
+        self.task_to_worker = dict(task_to_worker)
+        for t in self.task_targets:
+            if t not in self.task_to_worker:
+                raise InvalidArgument(f"target task {t} has no worker")
+
+    @staticmethod
+    def round_robin(num_tasks: int, world: int) -> "LogicalTaskPlan":
+        """tasks 0..n-1 dealt over workers 0..w-1 (the common layout in
+        the reference's Twister2 integrations)."""
+        t2w = {t: t % world for t in range(num_tasks)}
+        tasks = list(range(num_tasks))
+        workers = list(range(world))
+        return LogicalTaskPlan(tasks, tasks, workers, workers, t2w)
+
+    def worker_of(self) -> np.ndarray:
+        """Dense [max_task+1] task->worker lookup (int32; -1 unmapped)."""
+        n = max(self.task_to_worker) + 1
+        out = np.full(n, -1, np.int32)
+        for t, w in self.task_to_worker.items():
+            out[t] = w
+        return out
+
+    def tasks_of(self, worker: int) -> list[int]:
+        return sorted(t for t, w in self.task_to_worker.items()
+                      if w == worker)
+
+
+@traced("task_shuffle")
+def task_shuffle(env: CylonEnv, table: Table, task_ids,
+                 plan: LogicalTaskPlan,
+                 out_capacity: int | None = None) -> Table:
+    """Route each row to the worker owning its target task (parity:
+    ``ArrowTaskAllToAll::InsertTable(table, task_target)``).
+
+    ``task_ids``: per-row int array (or column name) of target task ids
+    aligned with ``table``'s capacity. Returns a distributed table
+    carrying ``TASK_COL``; split it with :func:`task_view` /
+    :func:`task_tables`.
+    """
+    from cylon_tpu.parallel.dist_ops import (_checked_local, _out_cap_local,
+                                             _shard_view, _smap)
+
+    from cylon_tpu.ops import kernels
+
+    table = dtable.scatter_table(env, table)
+    if isinstance(task_ids, str):
+        tid_name = task_ids
+        work = table
+    else:
+        tid_name = TASK_COL
+        tid = jnp.asarray(task_ids, jnp.int32)
+        if tid.shape[0] != table.capacity:
+            raise InvalidArgument(
+                f"task_ids length {tid.shape[0]} != table capacity "
+                f"{table.capacity} (pass one id per buffered row, or a "
+                f"column name)")
+        work = table.add_column(
+            TASK_COL, Column(tid.astype(jnp.int64), None, dtypes.int64))
+    lookup = jnp.asarray(plan.worker_of())
+    out_l = _out_cap_local(env, work, out_capacity=out_capacity)
+    w = env.world_size
+
+    def body(t):
+        lt, inof = _checked_local(t)
+        tcol = lt.column(tid_name).data.astype(jnp.int32)
+        safe = jnp.clip(tcol, 0, lookup.shape[0] - 1)
+        pid = lookup[safe]
+        # unmapped (-1) or out-of-range task ids on live rows poison the
+        # result rather than silently dropping/misrouting the rows
+        vmask = kernels.valid_mask(lt.capacity, lt.nrows)
+        bad = vmask & ((tcol < 0) | (tcol >= lookup.shape[0]) | (pid < 0))
+        me = jax.lax.axis_index(WORKER_AXIS).astype(pid.dtype)
+        pid = jnp.where(bad, me, pid)
+        res, of = checked_recv(shuffle_local(lt, pid, out_l), out_l)
+        return _shard_view(poison(res, inof, of, bad.any()))
+
+    out = _smap(env, body, 1)(work)
+    if tid_name != TASK_COL:
+        out = out.rename({tid_name: TASK_COL})
+    return out
+
+
+def task_view(shuffled: Table, task: int) -> Table:
+    """Local view of one task's rows (strips ``TASK_COL``). Call on a
+    gathered/local shard table."""
+    from cylon_tpu.ops.selection import filter_table
+
+    mask = shuffled.column(TASK_COL).data.astype(jnp.int64) == task
+    out = filter_table(shuffled, mask)
+    return out.drop([TASK_COL])
+
+
+def task_tables(env: CylonEnv, shuffled: Table,
+                plan: LogicalTaskPlan) -> dict[int, Table]:
+    """Host-side split of a task-shuffled distributed table into one
+    local table per task (the receive callback's per-task delivery,
+    arrow_task_all_to_all.cpp onReceive)."""
+    dtable.dist_num_rows(shuffled)  # OutOfCapacity on poisoned shards
+    cap_l = dtable.local_capacity(shuffled)
+    w = dtable.num_shards(shuffled)
+    out: dict[int, Table] = {}
+    counts = np.asarray(shuffled.nrows)
+    for worker in range(w):
+        lo = worker * cap_l
+        shard_cols = {}
+        for name, c in shuffled.columns.items():
+            shard_cols[name] = Column(
+                c.data[lo:lo + cap_l],
+                None if c.validity is None else c.validity[lo:lo + cap_l],
+                c.dtype, c.dictionary)
+        shard = Table(shard_cols, jnp.int32(counts[worker]))
+        for task in plan.tasks_of(worker):
+            out[task] = task_view(shard, task)
+    return out

@@ -1,0 +1,16 @@
+"""Runtime utilities: logging and op tracing/profiling.
+
+Reference analog: ``cpp/src/cylon/util/`` (logging.{hpp,cpp} glog wrap,
+macros) plus the inline ``std::chrono`` op timing at table boundaries
+(``table.cpp:167-177``).
+"""
+
+from cylon_tpu.utils.logging import (disable_logging, get_logger,
+                                     init_logging, log_level)
+from cylon_tpu.utils.tracing import (profile_to, report, reset_timings,
+                                     span, timings, traced)
+
+__all__ = [
+    "disable_logging", "get_logger", "init_logging", "log_level",
+    "profile_to", "report", "reset_timings", "span", "timings", "traced",
+]

@@ -1,0 +1,63 @@
+"""Logging: glog-style levels driven by ``CYLON_LOG_LEVEL``.
+
+Parity: the reference logs through glog everywhere (``table.hpp:18``)
+with ``util/logging.{hpp,cpp}`` wrapping init, and PyCylon maps the
+``CYLON_LOG_LEVEL`` env var to ``log_level()``/``disable_logging()``
+(``python/pycylon/__init__.py:30-43``). Same contract here on the
+stdlib ``logging`` module: glog severities 0..3 = INFO, WARNING, ERROR,
+FATAL; anything above disables.
+"""
+
+import logging
+import os
+
+_LOGGER_NAME = "cylon_tpu"
+
+#: glog severity -> stdlib level (``python/pycylon/util/logging.pyx``).
+_GLOG_LEVELS = {0: logging.INFO, 1: logging.WARNING,
+                2: logging.ERROR, 3: logging.CRITICAL}
+
+_initialized = False
+
+
+def get_logger() -> logging.Logger:
+    return logging.getLogger(_LOGGER_NAME)
+
+
+def init_logging() -> None:
+    """Idempotent init, called at package import (mirrors
+    ``pycylon.__init__``): reads ``CYLON_LOG_LEVEL`` and attaches one
+    stderr handler with a glog-flavoured format."""
+    global _initialized
+    if _initialized:
+        return
+    _initialized = True
+    logger = get_logger()
+    if not logger.handlers:
+        h = logging.StreamHandler()
+        h.setFormatter(logging.Formatter(
+            "%(levelname).1s %(asctime)s %(name)s] %(message)s",
+            datefmt="%H:%M:%S"))
+        logger.addHandler(h)
+    logger.propagate = False
+    env = os.environ.get("CYLON_LOG_LEVEL")
+    if env is None:
+        logger.setLevel(logging.WARNING)
+        return
+    try:
+        log_level(int(env))
+    except ValueError:
+        logger.setLevel(logging.WARNING)
+        logger.warning("bad CYLON_LOG_LEVEL=%r (want 0..4)", env)
+
+
+def log_level(glog_severity: int) -> None:
+    """Set the minimum severity, glog numbering (0=INFO .. 3=FATAL)."""
+    if glog_severity in _GLOG_LEVELS:
+        get_logger().setLevel(_GLOG_LEVELS[glog_severity])
+    else:
+        disable_logging()
+
+
+def disable_logging() -> None:
+    get_logger().setLevel(logging.CRITICAL + 1)

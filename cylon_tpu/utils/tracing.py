@@ -1,0 +1,119 @@
+"""Op tracing: wall-clock spans + JAX profiler hooks.
+
+The reference has no tracer — it inlines ``std::chrono`` timing and
+glog INFO lines at op boundaries (shuffle timings ``table.cpp:167-177``;
+bench binaries log ``j_t``/``w_t`` per rank,
+``cpp/src/examples/bench/table_join_dist_test.cpp:38-56``). The rebuild
+formalises that: every public op runs under a :func:`span`, spans
+accumulate into a process-local registry (count/total/min/max), and the
+same spans emit ``jax.profiler.TraceAnnotation`` so they line up with
+XLA device traces in xprof/tensorboard (:func:`profile_to`).
+
+Caveat that doesn't exist in the reference: JAX dispatch is async, so a
+span around a jitted call measures *host orchestration* unless
+``sync=`` is given a value to ``block_until_ready`` on.
+"""
+
+import contextlib
+import functools
+import threading
+import time
+from dataclasses import dataclass, field
+
+from cylon_tpu.utils.logging import get_logger
+
+
+@dataclass
+class SpanStat:
+    count: int = 0
+    total_s: float = 0.0
+    min_s: float = field(default=float("inf"))
+    max_s: float = 0.0
+
+    def add(self, dt: float) -> None:
+        self.count += 1
+        self.total_s += dt
+        self.min_s = min(self.min_s, dt)
+        self.max_s = max(self.max_s, dt)
+
+
+_stats: dict[str, SpanStat] = {}
+_lock = threading.Lock()
+
+
+@contextlib.contextmanager
+def span(name: str, sync=None):
+    """Time a named region; optionally block on ``sync`` (any pytree of
+    jax arrays) so device work is included in the measurement."""
+    import jax
+
+    t0 = time.perf_counter()
+    with jax.profiler.TraceAnnotation(name):
+        try:
+            yield
+        finally:
+            if sync is not None:
+                jax.block_until_ready(sync)
+            dt = time.perf_counter() - t0
+            with _lock:
+                _stats.setdefault(name, SpanStat()).add(dt)
+            get_logger().info("%s: %.3f ms", name, dt * 1e3)
+
+
+def traced(name: str | None = None):
+    """Decorator: run the function under a :func:`span` (host timing)."""
+
+    def deco(fn):
+        label = name or fn.__qualname__
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with span(label):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+def timings() -> dict[str, SpanStat]:
+    """Snapshot of accumulated span statistics."""
+    with _lock:
+        return {k: SpanStat(v.count, v.total_s, v.min_s, v.max_s)
+                for k, v in _stats.items()}
+
+
+def reset_timings() -> None:
+    with _lock:
+        _stats.clear()
+
+
+def report() -> str:
+    """Human-readable table of span stats, slowest total first."""
+    snap = timings()
+    if not snap:
+        return "(no spans recorded)"
+    rows = sorted(snap.items(), key=lambda kv: -kv[1].total_s)
+    w = max(len(k) for k, _ in rows)
+    lines = [f"{'span':<{w}}  {'count':>6}  {'total ms':>10}  "
+             f"{'mean ms':>9}  {'min ms':>8}  {'max ms':>8}"]
+    for k, s in rows:
+        lines.append(
+            f"{k:<{w}}  {s.count:>6}  {s.total_s * 1e3:>10.3f}  "
+            f"{s.total_s / s.count * 1e3:>9.3f}  {s.min_s * 1e3:>8.3f}  "
+            f"{s.max_s * 1e3:>8.3f}")
+    return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def profile_to(logdir: str):
+    """Capture a JAX/XLA device profile (xprof format) for the enclosed
+    region — the deep-dive tool the reference lacks; view with
+    tensorboard or xprof."""
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

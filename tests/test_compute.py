@@ -259,3 +259,43 @@ def test_series_from_frame(pdf):
     s = df.series("a")
     assert s.name == "a"
     assert s.to_pandas().tolist() == [1, 2, 3, 4]
+
+
+def test_table_quantile_median_vs_pandas(rng):
+    from cylon_tpu import Table
+    from cylon_tpu.ops.aggregates import table_aggregate
+
+    x = rng.normal(size=501)
+    x[::7] = np.nan
+    t = Table.from_pydict({"x": x})
+    s = pd.Series(x)
+    np.testing.assert_allclose(
+        float(table_aggregate(t, "x", "median")), s.median(), rtol=1e-12)
+    for q in (0.0, 0.25, 0.9, 1.0):
+        np.testing.assert_allclose(
+            float(table_aggregate(t, "x", "quantile", quantile=q)),
+            s.quantile(q), rtol=1e-12)
+
+
+def test_dist_quantile_vs_pandas(env8, rng):
+    from cylon_tpu import Table
+    from cylon_tpu.parallel import dist_aggregate, scatter_table
+
+    x = rng.normal(size=800)
+    t = scatter_table(env8, Table.from_pydict({"x": x}))
+    s = pd.Series(x)
+    np.testing.assert_allclose(
+        float(dist_aggregate(env8, t, "x", "median")), s.median(), rtol=1e-12)
+    np.testing.assert_allclose(
+        float(dist_aggregate(env8, t, "x", "quantile", quantile=0.75)),
+        s.quantile(0.75), rtol=1e-12)
+
+
+def test_frame_median_quantile(env8, rng):
+    import cylon_tpu as ct
+
+    x = rng.normal(size=256)
+    df = ct.DataFrame({"x": x})
+    np.testing.assert_allclose(df.median()["x"], np.median(x), rtol=1e-12)
+    np.testing.assert_allclose(df.quantile(0.3)["x"],
+                               pd.Series(x).quantile(0.3), rtol=1e-12)

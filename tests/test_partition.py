@@ -1,0 +1,97 @@
+"""Partitioning strategies + local split (parity:
+``cpp/test/partition_test.cpp`` and partition/partition.cpp Split)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from cylon_tpu import Table
+from cylon_tpu.errors import InvalidArgument
+from cylon_tpu.ops import partition as P
+
+
+@pytest.fixture
+def t(rng):
+    return Table.from_pydict({
+        "k": rng.integers(-50, 50, 300).astype(np.int64),
+        "v": rng.normal(size=300),
+    })
+
+
+def test_modulo_ids_match_definition(t):
+    pid = np.asarray(P.assign_partitions(t, ["k"], 4, "modulo"))
+    k = np.asarray(t.column("k").data)
+    np.testing.assert_array_equal(pid, np.abs(k.astype(np.int64) % 4))
+    assert pid.min() >= 0 and pid.max() < 4
+
+
+def test_modulo_rejects_floats(t):
+    with pytest.raises(InvalidArgument):
+        P.modulo_partition_ids([t.column("v").data], 4)
+
+
+def test_round_robin_balanced(t):
+    pid = np.asarray(P.assign_partitions(t, ["k"], 8, "round_robin"))
+    counts = np.bincount(pid, minlength=8)
+    assert counts.max() - counts.min() <= 1
+
+
+def test_hash_mode_equals_partition_ids(t):
+    from cylon_tpu.ops.hash import partition_ids
+
+    a = np.asarray(P.assign_partitions(t, ["k"], 8, "hash"))
+    b = np.asarray(partition_ids([t.column("k").data], 8,
+                                 [t.column("k").validity]))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_split_by_partition_roundtrip(t):
+    parts = P.partition_table(t, ["k"], 4, "hash")
+    assert len(parts) == 4
+    dfs = [p.to_pandas() for p in parts]
+    got = pd.concat(dfs).sort_values(["k", "v"]).reset_index(drop=True)
+    want = t.to_pandas().sort_values(["k", "v"]).reset_index(drop=True)
+    pd.testing.assert_frame_equal(got, want)
+    # rows within one split really share the partition id
+    from cylon_tpu.ops.hash import partition_ids
+    for p, df in enumerate(dfs):
+        if len(df):
+            sub = Table.from_pandas(df)
+            pid = np.asarray(partition_ids([sub.column("k").data], 4))
+            assert (pid[: len(df)] == p).all()
+
+
+def test_shuffle_modulo_mode(env8, rng):
+    from cylon_tpu.parallel import scatter_table, shuffle
+    from cylon_tpu.parallel.dist_ops import _local_view  # noqa: F401
+
+    df = pd.DataFrame({"k": rng.integers(0, 64, 400).astype(np.int64),
+                       "v": rng.normal(size=400)})
+    dt = scatter_table(env8, Table.from_pandas(df))
+    sh = shuffle(env8, dt, ["k"], partitioning="modulo")
+    # every key lands on shard key % 8, and nothing is lost
+    from cylon_tpu.parallel import dist_to_pandas
+    got = dist_to_pandas(env8, sh).sort_values(["k", "v"]).reset_index(drop=True)
+    pd.testing.assert_frame_equal(got, df.sort_values(["k", "v"]).reset_index(drop=True))
+    caps = sh.capacity // 8
+    ks = np.asarray(sh.column("k").data).reshape(8, caps)
+    ns = np.asarray(sh.nrows)
+    for shard in range(8):
+        valid = ks[shard][: ns[shard]]
+        assert (valid % 8 == shard).all()
+
+
+def test_split_overflow_poisons(t):
+    from cylon_tpu.errors import OutOfCapacity
+
+    parts = P.partition_table(t, ["k"], 2, "hash", out_capacity=10)
+    with pytest.raises(OutOfCapacity):
+        for p in parts:
+            p.to_pandas()
+
+
+def test_quantile_out_of_range_raises(t):
+    from cylon_tpu.ops.aggregates import table_aggregate
+
+    with pytest.raises(InvalidArgument):
+        table_aggregate(t, "v", "quantile", quantile=1.5)

@@ -1,0 +1,95 @@
+"""Task overlay parity (``arrow_task_all_to_all.h`` LogicalTaskPlan /
+ArrowTaskAllToAll): rows addressed to logical tasks land, intact, on the
+worker owning the task."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from cylon_tpu import Table
+from cylon_tpu.errors import InvalidArgument
+from cylon_tpu.parallel import (LogicalTaskPlan, scatter_table,
+                                task_shuffle, task_tables)
+
+
+def test_plan_validates_mapping():
+    with pytest.raises(InvalidArgument):
+        LogicalTaskPlan([0], [0, 1], [0], [0], {0: 0})  # task 1 unmapped
+
+
+def test_round_robin_plan():
+    p = LogicalTaskPlan.round_robin(10, 4)
+    assert p.tasks_of(0) == [0, 4, 8]
+    assert p.tasks_of(3) == [3, 7]
+    lut = p.worker_of()
+    assert lut.tolist() == [0, 1, 2, 3, 0, 1, 2, 3, 0, 1]
+
+
+def test_task_shuffle_routes_rows(env8, rng):
+    n = 640
+    ntasks = 16  # two tasks per worker
+    df = pd.DataFrame({"k": rng.integers(0, 1000, n).astype(np.int64),
+                       "v": rng.normal(size=n)})
+    tasks = rng.integers(0, ntasks, n).astype(np.int64)
+    df["__task__"] = tasks
+
+    plan = LogicalTaskPlan.round_robin(ntasks, env8.world_size)
+    dt = scatter_table(env8, Table.from_pandas(df))
+    sh = task_shuffle(env8, dt, "__task__", plan, out_capacity=8 * n)
+
+    per_task = task_tables(env8, sh, plan)
+    assert sorted(per_task) == list(range(ntasks))
+    # each task table holds exactly the rows addressed to it
+    for t in range(ntasks):
+        want = df[df["__task__"] == t].drop(columns="__task__")
+        got = per_task[t].to_pandas()
+        pd.testing.assert_frame_equal(
+            got.sort_values(["k", "v"]).reset_index(drop=True),
+            want.sort_values(["k", "v"]).reset_index(drop=True))
+
+
+def test_task_shuffle_skewed_ownership(env8, rng):
+    # all tasks on worker 0: the exchange concentrates everything there
+    n = 160
+    df = pd.DataFrame({"k": np.arange(n, dtype=np.int64)})
+    tasks = rng.integers(0, 4, n)
+    plan = LogicalTaskPlan([0], list(range(4)), [0], [0],
+                           {t: 0 for t in range(4)})
+    df["__task__"] = tasks
+    dt = scatter_table(env8, Table.from_pandas(df))
+    sh = task_shuffle(env8, dt, "__task__", plan, out_capacity=16 * n)
+    counts = np.asarray(sh.nrows)
+    assert counts[0] == n and counts[1:].sum() == 0
+
+
+def test_unmapped_task_poisons(env8, rng):
+    from cylon_tpu.errors import OutOfCapacity
+
+    n = 80
+    df = pd.DataFrame({"k": np.arange(n, dtype=np.int64)})
+    df["__task__"] = rng.integers(0, 8, n)
+    df.loc[0, "__task__"] = 99  # out of range
+    plan = LogicalTaskPlan.round_robin(8, env8.world_size)
+    dt = scatter_table(env8, Table.from_pandas(df))
+    sh = task_shuffle(env8, dt, "__task__", plan, out_capacity=8 * n)
+    with pytest.raises(OutOfCapacity):
+        task_tables(env8, sh, plan)
+
+
+def test_task_ids_array_path(env8, rng):
+    n = 160
+    df = pd.DataFrame({"k": np.arange(n, dtype=np.int64)})
+    dt = scatter_table(env8, Table.from_pandas(df))
+    tids = rng.integers(0, 8, dt.capacity).astype(np.int64)
+    plan = LogicalTaskPlan.round_robin(8, env8.world_size)
+    sh = task_shuffle(env8, dt, tids, plan, out_capacity=8 * n)
+    tt = task_tables(env8, sh, plan)
+    assert sum(len(t.to_pandas()) for t in tt.values()) == n
+
+
+def test_task_ids_wrong_length_raises(env8):
+    df = pd.DataFrame({"k": np.arange(16, dtype=np.int64)})
+    dt = scatter_table(env8, Table.from_pandas(df))
+    plan = LogicalTaskPlan.round_robin(8, env8.world_size)
+    with pytest.raises(InvalidArgument):
+        task_shuffle(env8, dt, np.zeros(3, np.int64), plan)

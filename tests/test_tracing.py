@@ -1,0 +1,102 @@
+"""Tracing/logging subsystem: spans, registry, report, env log level.
+
+The reference's analog is inline chrono+glog timing (``table.cpp:
+167-177``); these tests pin the formalised replacement.
+"""
+
+import logging
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from cylon_tpu.utils import tracing
+from cylon_tpu.utils.logging import (disable_logging, get_logger,
+                                     log_level)
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    tracing.reset_timings()
+    yield
+    tracing.reset_timings()
+
+
+def test_span_records():
+    with tracing.span("unit"):
+        pass
+    with tracing.span("unit"):
+        pass
+    t = tracing.timings()
+    assert t["unit"].count == 2
+    assert t["unit"].total_s >= t["unit"].max_s >= t["unit"].min_s >= 0
+
+
+def test_span_sync_blocks_on_device_work():
+    import jax.numpy as jnp
+
+    x = jnp.arange(1024.0)
+    with tracing.span("devwork", sync=x * 2):
+        y = x * 2
+    assert tracing.timings()["devwork"].count == 1
+
+
+def test_traced_decorator_preserves_fn():
+    @tracing.traced("mylabel")
+    def f(a, b=1):
+        """doc."""
+        return a + b
+
+    assert f(2, b=3) == 5
+    assert f.__doc__ == "doc."
+    assert tracing.timings()["mylabel"].count == 1
+
+
+def test_dist_ops_emit_spans(env8, rng):
+    from cylon_tpu import Table
+    from cylon_tpu.parallel import dist_join, scatter_table
+
+    n = 256
+    lt = scatter_table(env8, Table.from_pydict(
+        {"k": rng.integers(0, 50, n), "a": rng.normal(size=n)}))
+    rt = scatter_table(env8, Table.from_pydict(
+        {"k": rng.integers(0, 50, n), "b": rng.normal(size=n)}))
+    dist_join(env8, lt, rt, on="k", how="inner", out_capacity=16 * n)
+    assert tracing.timings()["dist_join"].count == 1
+
+
+def test_report_renders():
+    with tracing.span("a"):
+        pass
+    out = tracing.report()
+    assert "span" in out and "a" in out and "count" in out
+    tracing.reset_timings()
+    assert "no spans" in tracing.report()
+
+
+def test_log_levels():
+    logger = get_logger()
+    log_level(0)
+    assert logger.level == logging.INFO
+    log_level(2)
+    assert logger.level == logging.ERROR
+    log_level(9)  # out of range -> disabled
+    assert logger.level > logging.CRITICAL
+    disable_logging()
+    assert logger.level > logging.CRITICAL
+    log_level(1)  # restore default-ish for other tests
+    assert logger.level == logging.WARNING
+
+
+def test_span_logs_at_info(caplog):
+    log_level(0)
+    logger = get_logger()
+    logger.propagate = True
+    try:
+        with caplog.at_level(logging.INFO, logger="cylon_tpu"):
+            with tracing.span("logged"):
+                pass
+        assert any("logged" in r.message for r in caplog.records)
+    finally:
+        logger.propagate = False
+        log_level(1)
