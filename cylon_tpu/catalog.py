@@ -135,3 +135,21 @@ def select_columns(table_id: str, out_id: str, names: Sequence[str]) -> None:
 
 def table_to_pydict(table_id: str) -> Mapping[str, list]:
     return get_table(table_id).to_pydict()
+
+
+# --------------------------------------------------------- native bridge
+def to_native(table_id: str) -> None:
+    """Copy a catalog entry into the native C-ABI registry
+    (``cylon_catalog_*`` in ``native/cylon_host.cpp``) where any FFI
+    host — the JNI-style binding surface — can read it."""
+    from cylon_tpu import native
+
+    native.catalog_put(table_id, get_table(table_id))
+
+
+def from_native(table_id: str) -> None:
+    """Import a table published in the native registry into this
+    catalog (reverse direction of :func:`to_native`)."""
+    from cylon_tpu import native
+
+    put_table(table_id, native.catalog_get(table_id))

@@ -100,6 +100,30 @@ def _declare(lib):
     lib.cylon_csv_dict_value.argtypes = [c.c_void_p, c.c_int32, c.c_int32]
     lib.cylon_csv_free.argtypes = [c.c_void_p]
 
+    lib.cylon_catalog_put.restype = c.c_int32
+    lib.cylon_catalog_put.argtypes = [
+        c.c_char_p, c.c_int32, c.POINTER(c.c_char_p),
+        c.POINTER(c.c_int32), c.c_int64, c.POINTER(c.c_void_p),
+        c.POINTER(c.c_int64), c.POINTER(c.c_void_p)]
+    lib.cylon_catalog_rows.restype = c.c_int64
+    lib.cylon_catalog_rows.argtypes = [c.c_char_p]
+    lib.cylon_catalog_ncols.restype = c.c_int32
+    lib.cylon_catalog_ncols.argtypes = [c.c_char_p]
+    lib.cylon_catalog_col_info.restype = c.c_int32
+    lib.cylon_catalog_col_info.argtypes = [
+        c.c_char_p, c.c_int32, c.c_char_p, c.c_int32,
+        c.POINTER(c.c_int32), c.POINTER(c.c_int64), c.POINTER(c.c_int32)]
+    lib.cylon_catalog_col_read.restype = c.c_int32
+    lib.cylon_catalog_col_read.argtypes = [
+        c.c_char_p, c.c_int32, c.c_void_p, c.c_int64, c.c_void_p]
+    lib.cylon_catalog_remove.restype = c.c_int32
+    lib.cylon_catalog_remove.argtypes = [c.c_char_p]
+    lib.cylon_catalog_size.restype = c.c_int32
+    lib.cylon_catalog_size.argtypes = []
+    lib.cylon_catalog_ids.restype = c.c_int64
+    lib.cylon_catalog_ids.argtypes = [c.c_char_p, c.c_int64]
+    lib.cylon_catalog_clear.argtypes = []
+
 
 def available() -> bool:
     return _load() is not None
@@ -262,3 +286,176 @@ def csv_to_table(path: str, delimiter: str = ",", header: bool = True,
                 col = Column(col.data, jnp.asarray(validity), col.dtype)
             cols[name] = col
     return Table(cols, n)
+
+
+# ------------------------------------------------------------- catalog
+# Parity: table_api.{hpp,cpp} PutTable/GetTable/RemoveTable (:38-90),
+# the registry the reference's Java JNI binding drives
+# (Table.java:289-307). The same C symbols are bindable from JNI/cffi/
+# .NET; this is the ctypes client. Wire format per column: a raw byte
+# buffer + dtype code + optional uint8 validity; dictionary columns ship
+# their codes plus two companion pseudo-columns (utf8 blob, int64
+# offsets) named "<col>\x01blob" / "<col>\x01offs".
+
+#: dtype tag = Kind enum value | (temporal-unit index << 8); opaque to C.
+_UNITS = [None, "s", "ms", "us", "ns", "D", "h", "m", "W"]
+_DICT_BLOB = "\x01blob"
+_DICT_OFFS = "\x01offs"
+
+
+def _dtype_tag(dt) -> int:
+    if dt.unit not in _UNITS:
+        raise ValueError(f"temporal unit {dt.unit!r} not representable "
+                         f"in the catalog tag (known: {_UNITS[1:]})")
+    return int(dt.kind.value) | (_UNITS.index(dt.unit) << 8)
+
+
+def _tag_dtype(tag: int):
+    from cylon_tpu import dtypes as _dt
+
+    kind = _dt.Kind(tag & 0xFF)
+    unit = _UNITS[(tag >> 8) & 0xFF]
+    return _dt.DType(kind, unit)
+
+
+def _require():
+    lib = _load()
+    if lib is None:
+        raise RuntimeError(f"native runtime unavailable: {_build_error}")
+    return lib
+
+
+def catalog_put(table_id: str, table) -> None:
+    """Copy a (host-materialised) Table into the native catalog
+    (parity: ``PutTable``, table_api.hpp:38)."""
+    lib = _require()
+    n = table.num_rows
+    names, dtags, bufs, lens, vals = [], [], [], [], []
+
+    def add(name, arr, tag, validity=None):
+        arr = np.ascontiguousarray(arr)
+        names.append(name.encode())
+        dtags.append(tag)
+        bufs.append(arr)
+        lens.append(arr.nbytes)
+        vals.append(validity)
+
+    from cylon_tpu import dtypes as _dt
+
+    for name, c in table.columns.items():
+        data = np.asarray(c.data[:n])
+        validity = None
+        if c.validity is not None:
+            validity = np.ascontiguousarray(
+                np.asarray(c.validity[:n]), dtype=np.uint8)
+        add(name, data, _dtype_tag(c.dtype), validity)
+        if c.dtype.is_dictionary and c.dictionary is not None:
+            blobs = [str(v).encode() for v in c.dictionary.values]
+            offs = np.zeros(len(blobs) + 1, np.int64)
+            for i, b in enumerate(blobs):
+                offs[i + 1] = offs[i] + len(b)
+            blob = (np.frombuffer(b"".join(blobs), np.uint8).copy()
+                    if blobs else np.zeros(0, np.uint8))
+            add(name + _DICT_BLOB, blob, _dtype_tag(_dt.uint8))
+            add(name + _DICT_OFFS, offs, _dtype_tag(_dt.int64))
+
+    nc = len(names)
+    c_names = (ctypes.c_char_p * nc)(*names)
+    c_dtypes = (ctypes.c_int32 * nc)(*dtags)
+    c_bufs = (ctypes.c_void_p * nc)(
+        *[b.ctypes.data_as(ctypes.c_void_p).value for b in bufs])
+    c_lens = (ctypes.c_int64 * nc)(*lens)
+    c_vals = (ctypes.c_void_p * nc)(
+        *[(v.ctypes.data_as(ctypes.c_void_p).value if v is not None else None)
+          for v in vals])
+    rc = lib.cylon_catalog_put(table_id.encode(), nc, c_names, c_dtypes,
+                               n, c_bufs, c_lens, c_vals)
+    if rc != 0:
+        raise RuntimeError(f"catalog put failed rc={rc}")
+
+
+def catalog_get(table_id: str):
+    """Rebuild a cylon_tpu Table from a native catalog entry
+    (parity: ``GetTable``, table_api.hpp:44)."""
+    import jax.numpy as jnp
+
+    from cylon_tpu.column import Column, Dictionary
+    from cylon_tpu.table import Table
+
+    lib = _require()
+    n = lib.cylon_catalog_rows(table_id.encode())
+    if n < 0:
+        raise KeyError(table_id)
+    nc = lib.cylon_catalog_ncols(table_id.encode())
+    raw = {}
+    for i in range(nc):
+        cap = 512
+        while True:
+            name_buf = ctypes.create_string_buffer(cap)
+            tag = ctypes.c_int32()
+            nbytes = ctypes.c_int64()
+            hasv = ctypes.c_int32()
+            rc = lib.cylon_catalog_col_info(table_id.encode(), i, name_buf,
+                                            cap, ctypes.byref(tag),
+                                            ctypes.byref(nbytes),
+                                            ctypes.byref(hasv))
+            if rc < 0:
+                raise RuntimeError(f"catalog col_info failed rc={rc}")
+            if rc < cap:  # full name fit
+                break
+            cap = rc + 1
+        dt = _tag_dtype(tag.value)
+        npdt = np.dtype(dt.physical)
+        if nbytes.value % npdt.itemsize:
+            raise RuntimeError(
+                f"column {i} of {table_id!r}: byte length {nbytes.value} "
+                f"not a multiple of {npdt} itemsize (foreign writer bug?)")
+        data = np.empty(nbytes.value // npdt.itemsize, npdt)
+        validity = np.empty(n, np.uint8) if hasv.value else None
+        rc = lib.cylon_catalog_col_read(
+            table_id.encode(), i, data.ctypes.data_as(ctypes.c_void_p),
+            data.nbytes,
+            validity.ctypes.data_as(ctypes.c_void_p)
+            if validity is not None else None)
+        if rc != 0:
+            raise RuntimeError(f"catalog col_read failed rc={rc}")
+        raw[name_buf.value.decode()] = (dt, data, validity)
+
+    cols = {}
+    for name, (dt, data, validity) in raw.items():
+        if _DICT_BLOB in name or _DICT_OFFS in name:
+            continue
+        vmask = (None if validity is None
+                 else jnp.asarray(validity.astype(bool)))
+        dictionary = None
+        if name + _DICT_BLOB in raw:
+            _, blob, _ = raw[name + _DICT_BLOB]
+            _, offs, _ = raw[name + _DICT_OFFS]
+            b = blob.tobytes()
+            dictionary = Dictionary(np.array(
+                [b[offs[j]:offs[j + 1]].decode()
+                 for j in range(len(offs) - 1)], object))
+        cols[name] = Column(jnp.asarray(data), vmask, dt, dictionary)
+    return Table(cols, n)
+
+
+def catalog_ids() -> list:
+    lib = _require()
+    need = lib.cylon_catalog_ids(None, 0)
+    while True:
+        buf = ctypes.create_string_buffer(int(need) + 1)
+        got = lib.cylon_catalog_ids(buf, need + 1)
+        if got <= need:  # fit (a concurrent put may have grown the set)
+            break
+        need = got
+    s = buf.value.decode()
+    return sorted(s.split("\n")) if s else []
+
+
+def catalog_remove(table_id: str) -> None:
+    if _require().cylon_catalog_remove(table_id.encode()) != 0:
+        raise KeyError(table_id)
+
+
+def catalog_clear() -> None:
+    _require().cylon_catalog_clear()

@@ -20,9 +20,11 @@
 #include <cctype>
 #include <condition_variable>
 #include <cstdint>
+#include <cstdio>
 #include <cstdlib>
 #include <cstring>
 #include <fstream>
+#include <unordered_map>
 #include <functional>
 #include <map>
 #include <mutex>
@@ -576,5 +578,150 @@ const char* cylon_csv_dict_value(void* r, int32_t col, int32_t code) {
 }
 
 void cylon_csv_free(void* r) { delete static_cast<CsvResult*>(r); }
+
+// ------------------------------------------------------------------
+// Catalog: string-id keyed columnar table registry, C ABI.
+//
+// Parity: table_api.{hpp,cpp} PutTable/GetTable/RemoveTable (:38-90) —
+// the exact surface the reference's Java binding drives over JNI
+// (Table.java:289-307 -> java/src/main/native/src/Table.cpp). Any FFI
+// runtime (JNI, ctypes, cffi, .NET) binds these symbols; the Python
+// bridge in native/__init__.py is one such client and round-trips full
+// cylon_tpu Tables (dictionary columns ride as a codes column plus two
+// companion blob/offset columns, documented there).
+//
+// Columns are opaque byte buffers tagged with a caller-defined dtype
+// code; the catalog copies in on put and out on read, so callers never
+// share ownership across the ABI. All entry points are mutex-guarded
+// (the JNI bridge in the reference serialises through the same kind of
+// global registry).
+// ------------------------------------------------------------------
+
+namespace {
+
+struct CatColumn {
+  std::string name;
+  int32_t dtype = 0;
+  std::vector<uint8_t> data;
+  std::vector<uint8_t> validity;  // empty = no nulls
+};
+
+struct CatTable {
+  int64_t n_rows = 0;
+  std::vector<CatColumn> cols;
+};
+
+std::mutex g_catalog_mu;
+std::unordered_map<std::string, CatTable>& catalog() {
+  static std::unordered_map<std::string, CatTable> c;
+  return c;
+}
+
+}  // namespace
+
+int32_t cylon_catalog_put(const char* id, int32_t ncols,
+                          const char** names, const int32_t* dtypes,
+                          int64_t n_rows, const void** data_bufs,
+                          const int64_t* data_lens,
+                          const uint8_t** validity_bufs) {
+  if (!id || ncols < 0 || n_rows < 0) return -1;
+  CatTable t;
+  t.n_rows = n_rows;
+  t.cols.reserve(ncols);
+  for (int32_t i = 0; i < ncols; ++i) {
+    CatColumn col;
+    col.name = names[i];
+    col.dtype = dtypes[i];
+    const auto* p = static_cast<const uint8_t*>(data_bufs[i]);
+    col.data.assign(p, p + data_lens[i]);
+    if (validity_bufs && validity_bufs[i]) {
+      col.validity.assign(validity_bufs[i], validity_bufs[i] + n_rows);
+    }
+    t.cols.push_back(std::move(col));
+  }
+  std::lock_guard<std::mutex> lk(g_catalog_mu);
+  catalog()[id] = std::move(t);  // overwrite, like PutTable
+  return 0;
+}
+
+int64_t cylon_catalog_rows(const char* id) {
+  std::lock_guard<std::mutex> lk(g_catalog_mu);
+  auto it = catalog().find(id);
+  return it == catalog().end() ? -1 : it->second.n_rows;
+}
+
+int32_t cylon_catalog_ncols(const char* id) {
+  std::lock_guard<std::mutex> lk(g_catalog_mu);
+  auto it = catalog().find(id);
+  return it == catalog().end() ? -1
+                               : static_cast<int32_t>(it->second.cols.size());
+}
+
+// returns the column name's byte length on success (callers retry with
+// a bigger buffer when it is >= name_cap — snprintf truncated), or a
+// negative error code.
+int32_t cylon_catalog_col_info(const char* id, int32_t i, char* name_out,
+                               int32_t name_cap, int32_t* dtype_out,
+                               int64_t* nbytes_out, int32_t* has_validity) {
+  std::lock_guard<std::mutex> lk(g_catalog_mu);
+  auto it = catalog().find(id);
+  if (it == catalog().end()) return -1;
+  if (i < 0 || i >= static_cast<int32_t>(it->second.cols.size())) return -2;
+  const CatColumn& c = it->second.cols[i];
+  std::snprintf(name_out, name_cap, "%s", c.name.c_str());
+  *dtype_out = c.dtype;
+  *nbytes_out = static_cast<int64_t>(c.data.size());
+  *has_validity = c.validity.empty() ? 0 : 1;
+  return static_cast<int32_t>(c.name.size());
+}
+
+// data_cap bounds the write into data_out (-3 if too small).
+int32_t cylon_catalog_col_read(const char* id, int32_t i, void* data_out,
+                               int64_t data_cap, uint8_t* validity_out) {
+  std::lock_guard<std::mutex> lk(g_catalog_mu);
+  auto it = catalog().find(id);
+  if (it == catalog().end()) return -1;
+  if (i < 0 || i >= static_cast<int32_t>(it->second.cols.size())) return -2;
+  const CatColumn& c = it->second.cols[i];
+  if (data_cap < static_cast<int64_t>(c.data.size())) return -3;
+  std::memcpy(data_out, c.data.data(), c.data.size());
+  if (validity_out && !c.validity.empty()) {
+    std::memcpy(validity_out, c.validity.data(), c.validity.size());
+  }
+  return 0;
+}
+
+int32_t cylon_catalog_remove(const char* id) {
+  std::lock_guard<std::mutex> lk(g_catalog_mu);
+  return catalog().erase(id) ? 0 : -1;
+}
+
+int32_t cylon_catalog_size() {
+  std::lock_guard<std::mutex> lk(g_catalog_mu);
+  return static_cast<int32_t>(catalog().size());
+}
+
+// newline-joined ids; returns bytes written (excluding NUL), or the
+// required size if cap is too small (call twice).
+int64_t cylon_catalog_ids(char* buf, int64_t cap) {
+  std::lock_guard<std::mutex> lk(g_catalog_mu);
+  std::string all;
+  for (const auto& kv : catalog()) {
+    if (!all.empty()) all += '\n';
+    all += kv.first;
+  }
+  int64_t need = static_cast<int64_t>(all.size());
+  if (buf && cap > need) {
+    std::memcpy(buf, all.data(), all.size());
+    buf[all.size()] = '\0';
+    return need;
+  }
+  return need + 1;
+}
+
+void cylon_catalog_clear() {
+  std::lock_guard<std::mutex> lk(g_catalog_mu);
+  catalog().clear();
+}
 
 }  // extern "C"

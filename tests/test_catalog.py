@@ -67,3 +67,24 @@ def test_read_csv_by_id(tmp_path):
     d = catalog.table_to_pydict("csvt")
     assert d["a"] == [1, 2]
     assert d["b"] == ["x", "y"]
+
+
+def test_catalog_native_bridge(rng):
+    from cylon_tpu import catalog, native
+
+    if not native.available():
+        import pytest
+
+        pytest.skip("native runtime unavailable")
+    native.catalog_clear()
+    catalog.clear()
+    t = Table.from_pydict({"a": [1, 2, 3], "s": ["x", "y", "x"]})
+    catalog.put_table("t", t)
+    catalog.to_native("t")
+    catalog.remove_table("t")
+    catalog.from_native("t")
+    got = catalog.get_table("t").to_pandas()
+    assert got["a"].tolist() == [1, 2, 3]
+    assert got["s"].tolist() == ["x", "y", "x"]
+    native.catalog_clear()
+    catalog.clear()
