@@ -109,25 +109,6 @@ def exclusive_cumsum(x: jax.Array) -> jax.Array:
     return jnp.cumsum(x) - x
 
 
-def expand_rows(counts: jax.Array, out_capacity: int
-                ) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """Run-length expansion: row i repeated counts[i] times, in order.
-
-    Returns ``(parent, within, total)`` where for output slot j < total,
-    ``parent[j]`` is the source row and ``within[j]`` its repeat index.
-    This is the static-shape engine behind join result materialisation
-    (replacing the reference's dynamic index vectors,
-    ``join/join_utils.hpp:34`` build_final_table).
-    """
-    offs = exclusive_cumsum(counts)
-    total = offs[-1] + counts[-1] if counts.shape[0] else jnp.int32(0)
-    j = jnp.arange(out_capacity, dtype=counts.dtype)
-    parent = jnp.searchsorted(offs, j, side="right").astype(jnp.int32) - 1
-    parent = jnp.clip(parent, 0, max(counts.shape[0] - 1, 0))
-    within = j - offs[parent]
-    return parent, within, total.astype(jnp.int32)
-
-
 def dense_group_ids(keys: Sequence[jax.Array], nrows,
                     validities: Sequence[jax.Array | None] | None = None
                     ) -> tuple[jax.Array, jax.Array, jax.Array]:

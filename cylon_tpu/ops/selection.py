@@ -18,21 +18,91 @@ from cylon_tpu.ops import kernels
 from cylon_tpu.table import Table
 
 
+def _packable(data: jax.Array) -> bool:
+    """float64 cannot ride the u32 packing (XLA's TPU x64-emulation
+    pass implements cross-width bitcasts for 64-bit ints but not
+    doubles) and neither can multi-dim columns; both gather
+    individually instead."""
+    return data.ndim == 1 and data.dtype != jnp.float64
+
+
+def _to_words(data: jax.Array) -> jax.Array:
+    """[cap] packable column -> [cap, w] u32 words (bit-preserving)."""
+    dt = data.dtype
+    if dt == jnp.bool_:
+        return data.astype(jnp.uint32)[:, None]
+    if dt.itemsize == 8:
+        return jax.lax.bitcast_convert_type(data, jnp.uint32)
+    if dt.itemsize == 4:
+        return jax.lax.bitcast_convert_type(data, jnp.uint32)[:, None]
+    # 8/16-bit: zero-extend each element into its own word
+    unsigned = jnp.dtype(f"uint{dt.itemsize * 8}")
+    return jax.lax.bitcast_convert_type(data, unsigned).astype(
+        jnp.uint32)[:, None]
+
+
+def _from_words(words: jax.Array, dt) -> jax.Array:
+    dt = jnp.dtype(dt)
+    if dt == jnp.bool_:
+        return words[:, 0] != 0
+    if dt.itemsize == 8:
+        return jax.lax.bitcast_convert_type(words, dt)
+    if dt.itemsize == 4:
+        return jax.lax.bitcast_convert_type(words[:, 0], dt)
+    unsigned = jnp.dtype(f"uint{dt.itemsize * 8}")
+    return jax.lax.bitcast_convert_type(
+        words[:, 0].astype(unsigned), dt)
+
+
 def take_columns(table: Table, idx: jax.Array, nrows_out,
                  null_mask: jax.Array | None = None,
                  names: Sequence[str] | None = None) -> Table:
     """Gather rows by index into a new table of capacity ``len(idx)``.
 
+    All fixed-width columns (and validity flags) are bit-packed into ONE
+    [cap, words] u32 matrix and row-gathered in a single pass: on TPU a
+    random row gather costs the same per index for 1 lane or 128, so one
+    wide gather replaces ncols narrow ones (the dominant cost of join
+    materialisation, ``join/join_utils.hpp:34`` build_final_table).
+
     ``null_mask`` marks output slots whose row should be all-null (used for
-    non-matching sides of outer joins; reference builds these in
-    ``join/join_utils.cpp`` build_final_table with -1 indices).
+    non-matching sides of outer joins; reference builds these with -1
+    indices in ``join/join_utils.cpp``).
     """
     safe = jnp.clip(idx, 0, max(table.capacity - 1, 0))
-    cols = {}
-    for name in (names if names is not None else table.column_names):
+    use = list(names if names is not None else table.column_names)
+
+    layout = []  # (name, column, word_slice | None, validity_word | None)
+    word_arrays = []
+    w = 0
+    for name in use:
         c = table.column(name)
-        data = c.data[safe]
-        validity = None if c.validity is None else c.validity[safe]
+        sl = None
+        if _packable(c.data):
+            cw = _to_words(c.data)
+            word_arrays.append(cw)
+            sl = slice(w, w + cw.shape[1])
+            w += cw.shape[1]
+        vslot = None
+        if c.validity is not None:
+            word_arrays.append(c.validity.astype(jnp.uint32)[:, None])
+            vslot = w
+            w += 1
+        layout.append((name, c, sl, vslot))
+
+    out_words = None
+    if word_arrays:
+        packed = (jnp.concatenate(word_arrays, axis=1)
+                  if len(word_arrays) > 1 else word_arrays[0])
+        out_words = packed[safe]
+
+    cols = {}
+    for name, c, sl, vslot in layout:
+        if sl is None:  # unpackable (f64): dedicated gather
+            data = c.data[safe]
+        else:
+            data = _from_words(out_words[:, sl], c.data.dtype)
+        validity = None if vslot is None else out_words[:, vslot] != 0
         if null_mask is not None:
             base = jnp.ones_like(null_mask) if validity is None else validity
             validity = base & ~null_mask
