@@ -53,12 +53,13 @@ def _words32(data: jax.Array) -> list[jax.Array]:
     if dt == jnp.bool_:
         return [data.astype(jnp.uint32)]
     if jnp.issubdtype(dt, jnp.floating):
+        from cylon_tpu.ops.kernels import float_bits
+
         data = jnp.where(data == 0, jnp.zeros((), dt), data)
         data = jnp.where(jnp.isnan(data), jnp.full((), jnp.nan, dt), data)
         if dt.itemsize < 4:
             data = data.astype(jnp.float32)
-        bits = jax.lax.bitcast_convert_type(
-            data, jnp.uint32 if data.dtype.itemsize == 4 else jnp.uint64)
+        bits = float_bits(data)  # routes f64 around the TPU bitcast hole
     else:
         bits = data
     if bits.dtype.itemsize <= 4:

@@ -23,6 +23,67 @@ import jax.numpy as jnp
 _UINT_OF_WIDTH = {1: jnp.uint8, 2: jnp.uint16, 4: jnp.uint32, 8: jnp.uint64}
 
 
+def f64_bits(data: jax.Array) -> jax.Array:
+    """IEEE-754 bit pattern of a float64 array, computed with exact
+    arithmetic — no bitcast. XLA's TPU x64-emulation pass cannot lower
+    64-bit float bitcasts (or frexp), so the decomposition is done by
+    hand: scale |x| by constant powers of two into [2^52, 2^53) — exact,
+    since any double's significand has at most 52 fractional bits — read
+    it off as an integer, and rebuild the exponent/subnormal/special
+    fields. Bit-identical to ``lax.bitcast_convert_type(x, uint64)``
+    (pinned by tests on CPU, where the bitcast exists).
+    """
+    a = jnp.abs(data)
+    e_acc = jnp.zeros(data.shape, jnp.int32)
+    # Rung constants stay within float32 range: TPU's f64 emulation is a
+    # float32 pair (~2^-49 ulp, f32-like exponent range), so a 2^512
+    # scale constant would itself overflow there. 9x127 covers the full
+    # IEEE-f64 normal range (1074 doublings) for real-f64 platforms.
+    # The scaled candidate is computed first and tested after: an
+    # overflowed candidate (inf) simply fails its `< 2^53` bound.
+    for p in (127,) * 9 + (64, 32, 16, 8, 4, 2, 1):
+        cand = a * (2.0 ** p)                      # exact (power of two)
+        grow = cand < 2.0 ** 53
+        a = jnp.where(grow, cand, a)
+        e_acc = jnp.where(grow, e_acc - p, e_acc)
+        cand = a * (2.0 ** -p)
+        shrink = cand >= 2.0 ** 52
+        a = jnp.where(shrink, cand, a)
+        e_acc = jnp.where(shrink, e_acc + p, e_acc)
+    finite = jnp.isfinite(data) & (data != 0)
+    mant53 = jnp.where(finite, a, 0.0).astype(jnp.uint64)
+    bexp = 52 + e_acc  # unbiased IEEE exponent of the value
+    is_sub = bexp < -1022
+    sub_shift = jnp.clip(-(bexp + 1022), 0, 63).astype(jnp.uint64)
+    mag_sub = mant53 >> sub_shift
+    be = jnp.clip(bexp + 1023, 1, 2046).astype(jnp.uint64)
+    mag_norm = (be << 52) | (mant53 & jnp.uint64((1 << 52) - 1))
+    mag = jnp.where(is_sub, mag_sub, mag_norm)
+    # XLA arithmetic flushes denormal operands to zero (DAZ), so the
+    # scaling loop sees subnormal inputs as 0 (mant53 == 0) — map them
+    # to signed zero, consistent with how every other arithmetic op on
+    # this platform treats them. Non-flushing platforms take the exact
+    # mag_sub branch above.
+    mag = jnp.where(mant53 == 0, jnp.uint64(0), mag)
+    mag = jnp.where(data == 0, jnp.uint64(0), mag)
+    mag = jnp.where(jnp.isinf(data), jnp.uint64(0x7FF0000000000000), mag)
+    mag = jnp.where(jnp.isnan(data), jnp.uint64(0x7FF8000000000000), mag)
+    # jnp.signbit lowers to a (64-bit) bitcast — detect the sign
+    # arithmetically; for +-0 the sign of 1/x distinguishes them
+    sign = jnp.where(data == 0, (1.0 / data) < 0, data < 0)
+    sign = sign & ~jnp.isnan(data)
+    return jnp.where(sign, mag | jnp.uint64(1 << 63), mag)
+
+
+def float_bits(data: jax.Array) -> jax.Array:
+    """Bit pattern of any float array, routing f64 around the TPU
+    bitcast hole."""
+    udt = _UINT_OF_WIDTH[data.dtype.itemsize]
+    if data.dtype.itemsize == 8 and jax.default_backend() == "tpu":
+        return f64_bits(data)
+    return jax.lax.bitcast_convert_type(data, udt)
+
+
 def order_key(data: jax.Array, ascending: bool = True) -> jax.Array:
     """Map values to unsigned ints whose unsigned order == value order.
 
@@ -45,7 +106,7 @@ def order_key(data: jax.Array, ascending: bool = True) -> jax.Array:
         # equality consistent with numeric equality)
         data = jnp.where(data == 0, jnp.zeros((), dt), data)
         data = jnp.where(jnp.isnan(data), jnp.full((), jnp.nan, dt), data)
-        bits = jax.lax.bitcast_convert_type(data, udt)
+        bits = float_bits(data)
         sign = udt(1 << (dt.itemsize * 8 - 1))
         # negative floats: flip all bits; positive: set sign bit
         key = jnp.where(bits & sign != 0, ~bits, bits | sign)

@@ -215,6 +215,35 @@ class Table:
                    capacity: int | None = None) -> "Table":
         return Table.from_pydict(dict(zip(names, arrays)), capacity)
 
+    def row(self, i: int) -> "Row":
+        """Typed host view of row ``i`` (parity: ``cylon::Row``,
+        row.hpp:23). Columnar access is the fast path; this syncs."""
+        from cylon_tpu.row import Row
+
+        n = self.num_rows
+        if not -n <= i < n:
+            raise IndexError(f"row {i} out of range [0, {n})")
+        if i < 0:
+            i += n
+        names = list(self._columns)
+        values = []
+        for c in self._columns.values():
+            v = c.to_numpy(n)[i]
+            values.append(v.item() if hasattr(v, "item") else v)
+        return Row(names, values)
+
+    def iterrows(self):
+        """Iterate host Rows (one device sync total, not per row)."""
+        from cylon_tpu.row import Row
+
+        n = self.num_rows
+        names = list(self._columns)
+        mats = [c.to_numpy(n) for c in self._columns.values()]
+        for i in range(n):
+            vals = [m[i].item() if hasattr(m[i], "item") else m[i]
+                    for m in mats]
+            yield Row(names, vals)
+
     def to_pydict(self) -> dict:
         n = self.num_rows
         return {name: c.to_numpy(n).tolist() for name, c in self._columns.items()}

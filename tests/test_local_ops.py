@@ -331,3 +331,34 @@ def test_equal_tables_multiset():
     b = Table.from_pydict({"x": [1, 2, 2]})
     assert not equal_tables(a, b)
     assert equal_tables(a, Table.from_pydict({"x": [2, 1, 1]}))
+
+
+def test_f64_bits_matches_bitcast(rng):
+    """f64_bits (the TPU software path) must be bit-identical to the
+    real bitcast for every value class."""
+    import jax
+    import jax.numpy as jnp
+
+    from cylon_tpu.ops.kernels import f64_bits
+
+    nasty = np.array([
+        0.0, -0.0, 1.0, -1.0, 1.5, -2.25, np.pi, -np.e,
+        np.inf, -np.inf, np.nan,
+        np.finfo(np.float64).max, np.finfo(np.float64).min,
+        np.finfo(np.float64).tiny,          # smallest normal
+        2.0**52, 2.0**52 + 1, 2.0**53, 2.0**-1022, 2.0**1023,
+        1 + 2.0**-52,                       # mantissa LSB
+    ])
+    vals = np.concatenate([nasty, rng.normal(size=500),
+                           rng.normal(size=500) * 1e300,
+                           rng.normal(size=500) * 1e-300])
+    x = jnp.asarray(vals)
+    got = np.asarray(f64_bits(x))
+    want = np.asarray(jax.lax.bitcast_convert_type(x, jnp.uint64))
+    np.testing.assert_array_equal(got, want)
+    # subnormal inputs: XLA arithmetic is DAZ, so the software path maps
+    # them to signed zero — the same value every arithmetic op sees
+    subs = jnp.asarray(np.array([5e-324, -5e-324, 1e-310, -3.1e-320]))
+    got = np.asarray(f64_bits(subs))
+    np.testing.assert_array_equal(
+        got, np.array([0, 1 << 63, 0, 1 << 63], np.uint64))

@@ -113,3 +113,30 @@ def test_timestamp_roundtrip():
     assert t.column("t").dtype.kind == dtypes.Kind.TIMESTAMP
     out = t.to_pandas()["t"].to_numpy()
     assert (out == ts).all()
+
+
+def test_row_typed_getters():
+    t = Table.from_pydict({"i": [1, 2], "f": [1.5, 2.5],
+                           "s": ["a", "b"], "b": [True, False]})
+    r = t.row(0)
+    assert r.get_int64("i") == 1 and r.get_int64(0) == 1
+    assert r.get_double("f") == 1.5
+    assert r.get_string("s") == "a"
+    assert r.get_bool("b") is True
+    with pytest.raises(TypeError):
+        r.get_int64("f")
+    assert r.to_dict() == {"i": 1, "f": 1.5, "s": "a", "b": True}
+    assert t.row(-1)["s"] == "b"
+    with pytest.raises(IndexError):
+        t.row(2)
+
+
+def test_iterrows_and_nulls():
+    import numpy as np
+
+    t = Table.from_pydict({"x": [1.0, np.nan], "s": ["p", None]})
+    rows = list(t.iterrows())
+    assert len(rows) == 2
+    assert rows[0]["s"] == "p"
+    assert rows[1]["s"] is None
+    assert rows[1]["x"] != rows[1]["x"]  # NaN
