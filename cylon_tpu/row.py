@@ -52,18 +52,23 @@ class Row:
         return NotImplemented
 
     def __hash__(self):
-        return hash((tuple(self._names), tuple(map(repr, self._values))))
+        # value-based, like __eq__ (hash(1) == hash(1.0) in Python, so
+        # the hash/eq contract holds across int/float typed columns)
+        return hash((tuple(self._names), tuple(self._values)))
 
     # -- typed getters (row.hpp:23 surface) ------------------------------
-    def _typed(self, i: int, kinds) -> Any:
+    def _typed(self, i: int, kinds, exclude=()) -> Any:
         v = self._values[i if isinstance(i, int) else self._names.index(i)]
-        if not isinstance(v, kinds) and v is not None:
+        bad = not isinstance(v, kinds) or isinstance(v, exclude)
+        if bad and v is not None:
             raise TypeError(f"column {i}: {type(v).__name__} is not "
                             f"{'/'.join(k.__name__ for k in kinds)}")
         return v
 
     def get_int64(self, i) -> int | None:
-        return self._typed(i, (int, np.integer))
+        # bool is an int subclass in Python; the typed surface keeps
+        # them distinct like the reference's per-type getters
+        return self._typed(i, (int, np.integer), exclude=(bool, np.bool_))
 
     get_int8 = get_int16 = get_int32 = get_int64
     get_uint8 = get_uint16 = get_uint32 = get_uint64 = get_int64

@@ -228,7 +228,12 @@ class Table:
         names = list(self._columns)
         values = []
         for c in self._columns.values():
-            v = c.to_numpy(n)[i]
+            # slice ONE element on device before the host transfer —
+            # a full-column copy per cell would make row loops O(n^2)
+            one = Column(c.data[i:i + 1],
+                         None if c.validity is None else c.validity[i:i + 1],
+                         c.dtype, c.dictionary)
+            v = one.to_numpy(1)[0]
             values.append(v.item() if hasattr(v, "item") else v)
         return Row(names, values)
 

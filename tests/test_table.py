@@ -140,3 +140,15 @@ def test_iterrows_and_nulls():
     assert rows[0]["s"] == "p"
     assert rows[1]["s"] is None
     assert rows[1]["x"] != rows[1]["x"]  # NaN
+
+
+def test_row_hash_eq_contract_and_bool_getter():
+    ta = Table.from_pydict({"x": [1]})
+    tb = Table.from_pydict({"x": [1.0]})
+    ra, rb = ta.row(0), tb.row(0)
+    assert ra == rb and hash(ra) == hash(rb)
+    assert rb in {ra}
+    tbool = Table.from_pydict({"f": [True]})
+    with pytest.raises(TypeError):
+        tbool.row(0).get_int64("f")
+    assert tbool.row(0).get_bool("f") is True
