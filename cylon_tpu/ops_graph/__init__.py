@@ -25,6 +25,7 @@ from cylon_tpu.ops_graph.execution import (
 )
 from cylon_tpu.ops_graph.graph import (
     DisJoinOp,
+    chunk_stream,
     DisUnionOp,
     GroupByOp,
     JoinOp,
@@ -34,6 +35,7 @@ from cylon_tpu.ops_graph.graph import (
 
 __all__ = [
     "DisJoinOp",
+    "chunk_stream",
     "DisUnionOp",
     "Execution",
     "GroupByOp",

@@ -1,0 +1,28 @@
+"""String-id catalog + native C-ABI registry (parity: the table_api.cpp
+registry the reference's Java binding drives over JNI —
+Table.java:289-307)."""
+
+import _mesh
+
+_mesh.setup()
+
+import cylon_tpu as ct
+from cylon_tpu import catalog, native
+
+catalog.put_table("orders", ct.Table.from_pydict(
+    {"id": [1, 2, 3], "item": ["ax", "bolt", "ax"]}))
+catalog.put_table("prices", ct.Table.from_pydict(
+    {"item": ["ax", "bolt"], "price": [9.5, 1.25]}))
+
+# id-keyed op mirror (JoinTables(ctx, "left", "right", ...) analog)
+catalog.join_tables("orders", "prices", "priced", on="item")
+print(catalog.table_to_pydict("priced"))
+
+if native.available():
+    # publish through the C ABI — any FFI host (JNI, cffi, ...) can now
+    # read `orders` via the cylon_catalog_* symbols
+    catalog.to_native("orders")
+    print("native registry ids:", native.catalog_ids())
+    print("round-trip:", native.catalog_get("orders").to_pydict())
+else:
+    print("native runtime unavailable:", native.build_error())

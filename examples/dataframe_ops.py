@@ -1,0 +1,29 @@
+"""Local relational surface (parity: python/examples/dataframe/{merge,
+join,groupby,sort,drop_duplicates,concat}.py)."""
+
+import _mesh
+
+_mesh.setup()
+
+import numpy as np
+import cylon_tpu as ct
+
+rng = np.random.default_rng(0)
+df = ct.DataFrame({"k": rng.integers(0, 5, 20),
+                   "v": rng.normal(size=20).round(2)})
+other = ct.DataFrame({"k": [1, 2, 3], "w": [10., 20., 30.]})
+
+print("--- merge (inner) ---")
+print(df.merge(other, on="k").head(5).to_pandas())
+
+print("--- groupby agg ---")
+print(df.groupby("k").agg({"v": ["sum", "mean", "count"]}).to_pandas())
+
+print("--- sort / dedup / concat ---")
+print(df.sort_values("v").head(3).to_pandas())
+print(df.drop_duplicates(subset=["k"]).to_pandas())
+print(ct.concat([df.head(2), df.head(2)]).to_pandas())
+
+print("--- elementwise + reductions ---")
+print((df["v"] * 2 + 1).head(3).to_pandas())
+print("sum:", df.sum(), " median:", df.median())

@@ -1,0 +1,27 @@
+"""Distributed merge + groupby + sort over a device mesh (parity:
+python/examples/dataframe/join.py + cpp join_example.cpp, run under
+mpirun there — here one process, SPMD over the mesh)."""
+
+import _mesh
+
+_mesh.setup()
+
+import numpy as np
+import cylon_tpu as ct
+from cylon_tpu.utils import tracing
+
+env = ct.CylonEnv(ct.TPUConfig())
+print(env)
+
+rng = np.random.default_rng(1)
+n = 10_000
+left = ct.DataFrame({"k": rng.integers(0, 500, n), "a": rng.normal(size=n)})
+right = ct.DataFrame({"k": rng.integers(0, 500, n), "b": rng.normal(size=n)})
+
+joined = left.merge(right, on="k", env=env, out_capacity=64 * n)
+gb = joined.groupby("k", env=env).agg({"a": "sum", "b": "mean"})
+top = gb.sort_values("a_sum", ascending=False, env=env).head(5)
+print(top.to_pandas())
+
+print("--- op spans ---")
+print(tracing.report())

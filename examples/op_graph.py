@@ -1,0 +1,28 @@
+"""Streaming op-graph execution (parity: cpp/src/examples/ops/ and the
+DisJoinOP chain of ops/dis_join_op.cpp:21-72): chunks stream through
+partition -> join with a pluggable scheduler."""
+
+import _mesh
+
+_mesh.setup()
+
+import numpy as np
+import cylon_tpu as ct
+from cylon_tpu.ops_graph import DisJoinOp, RoundRobinExecution, chunk_stream
+
+rng = np.random.default_rng(2)
+n = 4000
+left = ct.Table.from_pydict({"k": rng.integers(0, 100, n).astype(np.int64),
+                             "a": rng.normal(size=n)})
+right = ct.Table.from_pydict({"k": rng.integers(0, 100, n).astype(np.int64),
+                              "b": rng.normal(size=n)})
+
+op = DisJoinOp("k", n_partitions=4, out_capacity=16 * n)
+for chunk in chunk_stream(left, 512):
+    op.insert_left(chunk)
+for chunk in chunk_stream(right, 512):
+    op.insert_right(chunk)
+op.finish()
+result = op.result(RoundRobinExecution())
+print("streamed join rows:", result.num_rows)
+print(result.to_pandas().head())

@@ -1,0 +1,21 @@
+"""TPC-H: generate tables (dbgen-style) and run Q3/Q5 with a pandas
+cross-check (parity: the reference's TPC-H-flavoured join benchmarks)."""
+
+import _mesh
+
+_mesh.setup()
+
+import time
+
+from cylon_tpu.tpch import dbgen, queries
+
+t0 = time.perf_counter()
+data = dbgen.generate(sf=0.01, seed=0)
+print(f"dbgen sf=0.01: {time.perf_counter() - t0:.2f}s "
+      f"({data['lineitem']['l_orderkey'].shape[0]} lineitems)")
+
+for name, q in (("Q3", queries.q3), ("Q5", queries.q5)):
+    t0 = time.perf_counter()
+    res = q(data).to_pandas()
+    print(f"{name}: {len(res)} rows in {time.perf_counter() - t0:.2f}s")
+    print(res.head(3))
