@@ -81,8 +81,26 @@ class CylonEnv:
                 devices = devices[: config.n_devices]
         self._mesh = Mesh(np.array(devices), (WORKER_AXIS,))
         self._finalized = False
+        self._kv: dict[str, str] = {}
+
+    # -- string KV config store (parity: ctx/cylon_context.hpp:32,69-77
+    #    AddConfig/GetConfig/GetConfigs) ---------------------------------
+    def add_config(self, key: str, value: str) -> None:
+        self._kv[str(key)] = str(value)
+
+    def get_config(self, key: str, default: str | None = None) -> str | None:
+        return self._kv.get(str(key), default)
+
+    def get_configs(self) -> dict[str, str]:
+        return dict(self._kv)
 
     # -- world topology (parity: ctx/cylon_context.hpp:101) ---------------
+    @property
+    def context(self) -> "CylonEnv":
+        """pycylon exposes ``env.context`` (the CylonContext); here env
+        and context are one object."""
+        return self
+
     @property
     def mesh(self) -> Mesh:
         return self._mesh

@@ -288,6 +288,19 @@ class DataFrame:
     def sample_rows(self, n: int) -> "DataFrame":
         return DataFrame._wrap(_selection.sample(self._gathered(), n))
 
+    def add_prefix(self, prefix: str) -> "DataFrame":
+        return DataFrame._wrap(self._table.add_prefix(prefix), self._index)
+
+    def add_suffix(self, suffix: str) -> "DataFrame":
+        return DataFrame._wrap(self._table.add_suffix(suffix), self._index)
+
+    def to_csv(self, path, **kw) -> None:
+        """Parity: pycylon ``DataFrame.to_csv`` / ``WriteCSV``
+        (table.cpp:243)."""
+        from cylon_tpu.io import write_csv
+
+        write_csv(self, path, **kw)
+
     def rename(self, columns: Mapping[str, str]) -> "DataFrame":
         return DataFrame._wrap(self._table.rename(columns), self._index)
 

@@ -61,4 +61,19 @@ __all__ = [
     "repartition",
     "scatter_table",
     "shuffle",
+    "distributed_join",
+    "distributed_sort",
+    "distributed_union",
+    "distributed_intersect",
+    "distributed_subtract",
+    "distributed_unique",
 ]
+
+# pycylon-style names (table.pyx distributed_join/...): aliases so
+# reference scripts port mechanically
+distributed_join = dist_join
+distributed_sort = dist_sort
+distributed_union = dist_union
+distributed_intersect = dist_intersect
+distributed_subtract = dist_subtract
+distributed_unique = dist_unique

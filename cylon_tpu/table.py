@@ -215,6 +215,98 @@ class Table:
                    capacity: int | None = None) -> "Table":
         return Table.from_pydict(dict(zip(names, arrays)), capacity)
 
+    # -- thin op/convenience surface (parity: table.pyx methods) ----------
+    @property
+    def row_count(self) -> int:
+        """Alias of :attr:`num_rows` (table.pyx ``row_count``)."""
+        return self.num_rows
+
+    @property
+    def column_count(self) -> int:
+        return self.num_columns
+
+    @property
+    def schema(self) -> dict:
+        """name -> logical dtype (parity: table.pyx ``schema``)."""
+        return {n: c.dtype for n, c in self._columns.items()}
+
+    def project(self, cols: Sequence) -> "Table":
+        """Select columns by index or name (parity: ``Project``,
+        table.hpp / table.pyx ``project``)."""
+        names = [self.column_names[c] if isinstance(c, int) else c
+                 for c in cols]
+        return self.select(names)
+
+    def add_prefix(self, prefix: str) -> "Table":
+        return self.rename({n: prefix + n for n in self.column_names})
+
+    def add_suffix(self, suffix: str) -> "Table":
+        return self.rename({n: n + suffix for n in self.column_names})
+
+    def filter(self, mask) -> "Table":
+        """Keep rows where ``mask`` is True (compacted)."""
+        from cylon_tpu.ops.selection import filter_table
+
+        return filter_table(self, mask)
+
+    def sort(self, by, ascending=True) -> "Table":
+        from cylon_tpu.ops.selection import sort_table
+
+        by = [by] if isinstance(by, str) else list(by)
+        return sort_table(self, by, ascending=ascending)
+
+    def join(self, right: "Table", **kw) -> "Table":
+        from cylon_tpu.ops.join import join as _join
+
+        return _join(self, right, **kw)
+
+    def union(self, other: "Table", out_capacity=None) -> "Table":
+        from cylon_tpu.ops import setops
+
+        if out_capacity is None:
+            out_capacity = self.capacity + other.capacity
+        return setops.union(self, other, out_capacity)
+
+    def intersect(self, other: "Table", out_capacity=None) -> "Table":
+        from cylon_tpu.ops import setops
+
+        if out_capacity is None:
+            out_capacity = self.capacity
+        return setops.intersect(self, other, out_capacity)
+
+    def subtract(self, other: "Table", out_capacity=None) -> "Table":
+        from cylon_tpu.ops import setops
+
+        if out_capacity is None:
+            out_capacity = self.capacity
+        return setops.subtract(self, other, out_capacity)
+
+    def unique(self, cols=None, keep: str = "first") -> "Table":
+        from cylon_tpu.ops import setops
+
+        return setops.unique(self, cols, keep=keep)
+
+    def show(self, n: int = 10) -> None:
+        """Print the first ``n`` rows (parity: table.pyx ``show``)."""
+        print(self.to_string(n))
+
+    def to_string(self, n: int | None = None) -> str:
+        from cylon_tpu.ops.selection import head
+
+        t = self if n is None else head(self, n)
+        return t.to_pandas().to_string()
+
+    def to_csv(self, path, **kw) -> None:
+        from cylon_tpu.io import write_csv
+
+        write_csv(self, path, **kw)
+
+    @staticmethod
+    def from_list(col_names: Sequence[str], cols: Sequence) -> "Table":
+        """Build from a COLUMN-major list of lists (parity: table.pyx
+        ``from_list`` semantics)."""
+        return Table.from_numpy(col_names, cols)
+
     def row(self, i: int) -> "Row":
         """Typed host view of row ``i`` (parity: ``cylon::Row``,
         row.hpp:23). Columnar access is the fast path; this syncs."""

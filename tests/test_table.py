@@ -152,3 +152,43 @@ def test_row_hash_eq_contract_and_bool_getter():
     with pytest.raises(TypeError):
         tbool.row(0).get_int64("f")
     assert tbool.row(0).get_bool("f") is True
+
+
+def test_table_thin_surface(tmp_path):
+    import numpy as np
+
+    t = Table.from_pydict({"a": [3, 1, 2], "b": [1.0, 2.0, 3.0]})
+    assert t.row_count == 3 and t.column_count == 2
+    assert str(t.schema["a"]) == "int64"
+    assert t.project([0]).column_names == ["a"]
+    assert t.project(["b"]).column_names == ["b"]
+    assert t.add_prefix("x_").column_names == ["x_a", "x_b"]
+    assert t.add_suffix("_y").column_names == ["a_y", "b_y"]
+    assert t.sort("a").to_pydict()["a"] == [1, 2, 3]
+    assert t.filter(t.column("a").data > 1).num_rows == 2
+    j = t.join(t, on="a", how="inner", out_capacity=8)
+    assert j.num_rows == 3
+    u = Table.from_pydict({"a": [2, 9], "b": [3.0, 9.0]})
+    assert t.union(u).num_rows == 4
+    assert t.intersect(u).num_rows == 1
+    assert t.subtract(u).num_rows == 2
+    assert t.unique(["a"]).num_rows == 3
+    assert "a" in t.to_string(2)
+    p = tmp_path / "t.csv"
+    t.to_csv(str(p))
+    assert p.read_text().startswith("a,b")
+    t2 = Table.from_list(["x", "y"], [[1, 2], [3.0, 4.0]])
+    assert t2.to_pydict() == {"x": [1, 2], "y": [3.0, 4.0]}
+
+
+def test_env_kv_and_aliases():
+    import cylon_tpu as ct
+    from cylon_tpu import parallel
+
+    env = ct.CylonEnv(ct.LocalConfig(), distributed=False)
+    env.add_config("compression", "lz4")
+    assert env.get_config("compression") == "lz4"
+    assert env.get_config("missing", "dflt") == "dflt"
+    assert env.get_configs() == {"compression": "lz4"}
+    assert env.context is env
+    assert parallel.distributed_join is parallel.dist_join
