@@ -1,0 +1,22 @@
+"""Examples stay runnable (the reference ships runnable examples as its
+de-facto integration surface; same here)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_EX = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples")
+
+
+@pytest.mark.parametrize("script", ["dataframe_ops.py", "catalog_ffi.py",
+                                    "op_graph.py"])
+def test_example_runs(script):
+    env = dict(os.environ)
+    env.pop("CYLON_EXAMPLES_TPU", None)
+    out = subprocess.run([sys.executable, os.path.join(_EX, script)],
+                         capture_output=True, text=True, timeout=600,
+                         env=env, cwd=_EX)
+    assert out.returncode == 0, out.stderr[-2000:]
