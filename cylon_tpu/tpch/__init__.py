@@ -10,6 +10,6 @@ locally or distributed over the mesh (``env=``).
 """
 
 from cylon_tpu.tpch.dbgen import date_int, generate, generate_pandas
-from cylon_tpu.tpch.queries import q3, q5
+from cylon_tpu.tpch.queries import q1, q3, q5, q6
 
-__all__ = ["generate", "generate_pandas", "date_int", "q3", "q5"]
+__all__ = ["generate", "generate_pandas", "date_int", "q1", "q3", "q5", "q6"]

@@ -1,6 +1,6 @@
 """Deterministic dbgen-style TPC-H data generator.
 
-Generates the six tables Q3/Q5 touch (region, nation, customer,
+Generates the six tables Q1/Q3/Q5/Q6 touch (region, nation, customer,
 supplier, orders, lineitem) with TPC-H's cardinality ratios and the
 value distributions the two queries are sensitive to (mktsegment
 5-way uniform; orderdate uniform over the 1992-1998 window; shipdate =
@@ -98,6 +98,10 @@ def generate(sf: float = 0.01, seed: int = 0) -> Mapping[str, dict]:
         "l_quantity": rng.integers(1, 51, n_li).astype(np.int64),
         "l_extendedprice": np.round(rng.uniform(900.0, 105_000.0, n_li), 2),
         "l_discount": np.round(rng.integers(0, 11, n_li) / 100.0, 2),
+        "l_tax": np.round(rng.integers(0, 9, n_li) / 100.0, 2),
+        "l_returnflag": np.array(["R", "A", "N"])[
+            rng.integers(0, 3, n_li)],
+        "l_linestatus": np.array(["O", "F"])[rng.integers(0, 2, n_li)],
         "l_shipdate": (l_orderdate
                        + rng.integers(1, 122, n_li)).astype(np.int32),
     }

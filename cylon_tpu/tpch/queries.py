@@ -141,3 +141,72 @@ def q5(data: Mapping, env=None, region: str = "ASIA",
     g = j.groupby(["n_name"], env=env).agg([("revenue", "sum", "revenue")])
     out = g.sort_values(["revenue"], ascending=[False])
     return out[["n_name", "revenue"]]
+
+
+def q1(data: Mapping, env=None, cutoff: int | None = None) -> DataFrame:
+    """TPC-H Q1 (pricing summary report): per (returnflag, linestatus)
+    sums/averages over shipped lineitems.
+
+    SELECT l_returnflag, l_linestatus, SUM(l_quantity), 
+           SUM(l_extendedprice), SUM(l_extendedprice*(1-l_discount)),
+           SUM(l_extendedprice*(1-l_discount)*(1+l_tax)),
+           AVG(l_quantity), AVG(l_extendedprice), AVG(l_discount),
+           COUNT(*)
+    FROM lineitem WHERE l_shipdate <= :cutoff
+    GROUP BY l_returnflag, l_linestatus ORDER BY 1, 2
+    """
+    if cutoff is None:
+        cutoff = date_int(1998, 9, 2)
+    (lineitem,) = _tables(data, ["lineitem"])
+    li = lineitem[jnp.asarray(lineitem.table.column("l_shipdate").data
+                              <= jnp.int32(cutoff))]
+    price = li.series("l_extendedprice")
+    disc = li.series("l_discount")
+    disc_price = price * (1 - disc)
+    charge = disc_price * (1 + li.series("l_tax"))
+    t = li.table.add_column("disc_price", disc_price.column)
+    t = t.add_column("charge", charge.column)
+    li = DataFrame._wrap(t)
+    g = li.groupby(["l_returnflag", "l_linestatus"], env=env).agg([
+        ("l_quantity", "sum", "sum_qty"),
+        ("l_extendedprice", "sum", "sum_base_price"),
+        ("disc_price", "sum", "sum_disc_price"),
+        ("charge", "sum", "sum_charge"),
+        ("l_quantity", "mean", "avg_qty"),
+        ("l_extendedprice", "mean", "avg_price"),
+        ("l_discount", "mean", "avg_disc"),
+        ("l_quantity", "count", "count_order"),
+    ])
+    return g.sort_values(["l_returnflag", "l_linestatus"])
+
+
+def q6(data: Mapping, env=None, date_from: int | None = None,
+       date_to: int | None = None, discount: float = 0.06,
+       quantity: int = 24):
+    """TPC-H Q6 (forecasting revenue change) — a scalar:
+
+    SELECT SUM(l_extendedprice * l_discount) FROM lineitem
+    WHERE l_shipdate >= :from AND l_shipdate < :to
+      AND l_discount BETWEEN :discount-0.01 AND :discount+0.01
+      AND l_quantity < :quantity
+    """
+    if date_from is None:
+        date_from = date_int(1994, 1, 1)
+    if date_to is None:
+        date_to = date_int(1995, 1, 1)
+    (lineitem,) = _tables(data, ["lineitem"])
+    t = lineitem.table
+    sd = t.column("l_shipdate").data
+    dc = t.column("l_discount").data
+    qt = t.column("l_quantity").data
+    mask = ((sd >= jnp.int32(date_from)) & (sd < jnp.int32(date_to))
+            & (dc >= discount - 0.01001) & (dc <= discount + 0.01001)
+            & (qt < quantity))
+    li = lineitem[jnp.asarray(mask)]
+    rev = li.series("l_extendedprice") * li.series("l_discount")
+    if env is not None:
+        from cylon_tpu.parallel import dist_aggregate
+
+        t2 = li.table.add_column("rev", rev.column)
+        return dist_aggregate(env, t2, "rev", "sum")
+    return rev.sum()

@@ -117,3 +117,61 @@ def test_generator_shapes(data):
     assert li["l_shipdate"].min() > o["o_orderdate"].min()
     assert data["nation"]["n_nationkey"].shape == (25,)
     assert data["region"]["r_regionkey"].shape == (5,)
+
+
+def test_q1_vs_pandas():
+    from cylon_tpu.tpch import dbgen, queries
+
+    data = dbgen.generate(sf=0.005, seed=4)
+    pdd = dbgen.generate_pandas(sf=0.005, seed=4)
+    got = queries.q1(data).to_pandas().reset_index(drop=True)
+
+    cutoff = dbgen.date_int(1998, 9, 2)
+    li = pdd["lineitem"]
+    li = li[li["l_shipdate"] <= cutoff].copy()
+    li["disc_price"] = li["l_extendedprice"] * (1 - li["l_discount"])
+    li["charge"] = li["disc_price"] * (1 + li["l_tax"])
+    want = li.groupby(["l_returnflag", "l_linestatus"]).agg(
+        sum_qty=("l_quantity", "sum"),
+        sum_base_price=("l_extendedprice", "sum"),
+        sum_disc_price=("disc_price", "sum"),
+        sum_charge=("charge", "sum"),
+        avg_qty=("l_quantity", "mean"),
+        avg_price=("l_extendedprice", "mean"),
+        avg_disc=("l_discount", "mean"),
+        count_order=("l_quantity", "count"),
+    ).reset_index().sort_values(["l_returnflag", "l_linestatus"]).reset_index(drop=True)
+    assert got["l_returnflag"].tolist() == want["l_returnflag"].tolist()
+    assert got["l_linestatus"].tolist() == want["l_linestatus"].tolist()
+    for c in ("sum_qty", "sum_base_price", "sum_disc_price", "sum_charge",
+              "avg_qty", "avg_price", "avg_disc"):
+        np.testing.assert_allclose(got[c], want[c], rtol=1e-9)
+    assert got["count_order"].tolist() == want["count_order"].tolist()
+
+
+def test_q6_vs_pandas(env8):
+    from cylon_tpu.tpch import dbgen, queries
+
+    data = dbgen.generate(sf=0.005, seed=4)
+    pdd = dbgen.generate_pandas(sf=0.005, seed=4)
+    li = pdd["lineitem"]
+    m = ((li["l_shipdate"] >= dbgen.date_int(1994, 1, 1))
+         & (li["l_shipdate"] < dbgen.date_int(1995, 1, 1))
+         & (li["l_discount"] >= 0.05) & (li["l_discount"] <= 0.07)
+         & (li["l_quantity"] < 24))
+    want = (li[m]["l_extendedprice"] * li[m]["l_discount"]).sum()
+    got = float(queries.q6(data))
+    np.testing.assert_allclose(got, want, rtol=1e-9)
+    got_d = float(queries.q6(data, env=env8))
+    np.testing.assert_allclose(got_d, want, rtol=1e-9)
+
+
+def test_q1_distributed(env8):
+    from cylon_tpu.tpch import dbgen, queries
+
+    data = dbgen.generate(sf=0.005, seed=4)
+    local = queries.q1(data).to_pandas().reset_index(drop=True)
+    dist = queries.q1(data, env=env8).to_pandas().reset_index(drop=True)
+    pd.testing.assert_frame_equal(
+        dist.sort_values(["l_returnflag", "l_linestatus"]).reset_index(drop=True),
+        local, rtol=1e-9)
