@@ -195,7 +195,8 @@ class Series:
         c = self._col
         vset = list(values)
         if c.dtype.is_dictionary:
-            lut = {v: i for i, v in enumerate(c.dictionary.values)}
+            dvals = [] if c.dictionary is None else c.dictionary.values
+            lut = {v: i for i, v in enumerate(dvals)}
             probe = jnp.asarray([lut.get(v, -1) for v in vset] or [-1],
                                 jnp.int32)
         else:
@@ -205,6 +206,30 @@ class Series:
             mask = mask & c.validity
         return Series._wrap(Column(mask, None, dtypes.bool_), self._nrows,
                             self.name)
+
+    def _dict_pred(self, pred: Callable) -> "Series":
+        """Boolean mask from a host predicate over the dictionary values
+        of a string column. The predicate runs once per DISTINCT value
+        (host-side, tiny); the row mask is an ``isin`` over matching
+        codes — the device never sees bytes. This is how LIKE-style
+        predicates (``p_type LIKE 'PROMO%'``) map onto dictionary
+        encoding."""
+        c = self._col
+        if not c.dtype.is_dictionary:
+            raise TypeError_("string predicate on non-string column")
+        vals = [] if c.dictionary is None else list(c.dictionary.values)
+        return self.isin([v for v in vals if pred(v)])
+
+    def str_startswith(self, prefix: str) -> "Series":
+        return self._dict_pred(lambda v: v is not None
+                               and str(v).startswith(prefix))
+
+    def str_endswith(self, suffix: str) -> "Series":
+        return self._dict_pred(lambda v: v is not None
+                               and str(v).endswith(suffix))
+
+    def str_contains(self, pat: str) -> "Series":
+        return self._dict_pred(lambda v: v is not None and pat in str(v))
 
     def map(self, fn: Callable) -> "Series":
         """Elementwise map (parity: ``compute.pyx`` infer_map :805). A

@@ -1,15 +1,18 @@
-"""TPC-H workload: data generator + Q3/Q5 pipelines.
+"""TPC-H workload: dbgen-style generator + ten query pipelines
+(Q1, Q3, Q4, Q5, Q6, Q10, Q12, Q14, Q18, Q19).
 
 BASELINE.json config 5 ("TPC-H SF100 Q3/Q5 multi-way join + groupby
 pipeline") names TPC-H as a headline benchmark of the rebuild; the
 reference itself ships only the synthetic join benchmarks
 (``cpp/src/examples/bench/``), so this subsystem is the benchmark-parity
-layer: a deterministic dbgen-style generator and the two queries
+layer: a deterministic dbgen-style generator and the queries
 expressed over the :class:`cylon_tpu.frame.DataFrame` surface, runnable
 locally or distributed over the mesh (``env=``).
 """
 
 from cylon_tpu.tpch.dbgen import date_int, generate, generate_pandas
-from cylon_tpu.tpch.queries import q1, q3, q5, q6
+from cylon_tpu.tpch.queries import (q1, q3, q4, q5, q6, q10, q12,
+                                    q14, q18, q19)
 
-__all__ = ["generate", "generate_pandas", "date_int", "q1", "q3", "q5", "q6"]
+__all__ = ["generate", "generate_pandas", "date_int", "q1", "q3",
+           "q4", "q5", "q6", "q10", "q12", "q14", "q18", "q19"]

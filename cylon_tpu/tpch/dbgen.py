@@ -1,10 +1,12 @@
 """Deterministic dbgen-style TPC-H data generator.
 
-Generates the six tables Q1/Q3/Q5/Q6 touch (region, nation, customer,
-supplier, orders, lineitem) with TPC-H's cardinality ratios and the
-value distributions the two queries are sensitive to (mktsegment
-5-way uniform; orderdate uniform over the 1992-1998 window; shipdate =
-orderdate + U[1,121]; discount U[0,0.10]; 1-7 lineitems per order).
+Generates the eight TPC-H tables (region, nation, customer, supplier,
+part, partsupp, orders, lineitem) with TPC-H's cardinality ratios and
+the value distributions the implemented queries are sensitive to
+(mktsegment 5-way uniform; orderdate uniform over the 1992-1998 window;
+shipdate = orderdate + U[1,121]; commitdate = orderdate + U[30,90];
+receiptdate = shipdate + U[1,30]; discount U[0,0.10]; 1-7 lineitems per
+order; part type/brand/container drawn from the spec's syllable grids).
 
 Dates are int32 days-since-epoch: TPU tables are fixed-width numeric,
 and TPC-H date predicates are pure comparisons, so an ordinal integer
@@ -12,7 +14,8 @@ is the faithful device representation (strings would be
 dictionary-coded anyway; dates ARE their own codes).
 
 Row counts per scale factor follow TPC-H: customer 150k·sf,
-supplier 10k·sf, orders 1.5M·sf, lineitem ~6M·sf, nation 25, region 5.
+supplier 10k·sf, part 200k·sf, partsupp 800k·sf, orders 1.5M·sf,
+lineitem ~6M·sf, nation 25, region 5.
 """
 
 import datetime
@@ -36,6 +39,18 @@ NATIONS = [
 ]
 SEGMENTS = np.array(["AUTOMOBILE", "BUILDING", "FURNITURE", "HOUSEHOLD",
                      "MACHINERY"], dtype=object)
+PRIORITIES = np.array(["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED",
+                       "5-LOW"], dtype=object)
+SHIPMODES = np.array(["REG AIR", "AIR", "RAIL", "SHIP", "TRUCK", "MAIL",
+                      "FOB"], dtype=object)
+SHIPINSTRUCT = np.array(["DELIVER IN PERSON", "COLLECT COD", "NONE",
+                         "TAKE BACK RETURN"], dtype=object)
+# p_type = one syllable from each grid (spec 4.2.2.13)
+TYPE_S1 = ["STANDARD", "SMALL", "MEDIUM", "LARGE", "ECONOMY", "PROMO"]
+TYPE_S2 = ["ANODIZED", "BURNISHED", "PLATED", "POLISHED", "BRUSHED"]
+TYPE_S3 = ["TIN", "NICKEL", "BRASS", "STEEL", "COPPER"]
+CONTAINER_S1 = ["SM", "LG", "MED", "JUMBO", "WRAP"]
+CONTAINER_S2 = ["CASE", "BOX", "BAG", "JAR", "PKG", "PACK", "CAN", "DRUM"]
 
 
 def date_int(year: int, month: int, day: int) -> int:
@@ -48,7 +63,7 @@ _END = date_int(1998, 8, 2)
 
 
 def generate(sf: float = 0.01, seed: int = 0) -> Mapping[str, dict]:
-    """Generate all six tables as ``{name: {column: np.ndarray}}``.
+    """Generate all eight tables as ``{name: {column: np.ndarray}}``.
 
     ``sf`` is the TPC-H scale factor (1.0 => 6M-row lineitem); fractional
     values scale every table proportionally (min 1 row), so tests run at
@@ -58,6 +73,7 @@ def generate(sf: float = 0.01, seed: int = 0) -> Mapping[str, dict]:
     n_cust = max(int(150_000 * sf), 10)
     n_supp = max(int(10_000 * sf), 5)
     n_ord = max(int(1_500_000 * sf), 20)
+    n_part = max(int(200_000 * sf), 8)
 
     region = {
         "r_regionkey": np.arange(5, dtype=np.int64),
@@ -79,11 +95,45 @@ def generate(sf: float = 0.01, seed: int = 0) -> Mapping[str, dict]:
         "s_nationkey": rng.integers(0, len(NATIONS), n_supp).astype(np.int64),
         "s_acctbal": np.round(rng.uniform(-999.99, 9999.99, n_supp), 2),
     }
+    p_type = np.array(
+        [f"{a} {b} {c}" for a in TYPE_S1 for b in TYPE_S2 for c in TYPE_S3],
+        dtype=object)
+    p_container = np.array(
+        [f"{a} {b}" for a in CONTAINER_S1 for b in CONTAINER_S2],
+        dtype=object)
+    brands = np.array([f"Brand#{m}{n}" for m in range(1, 6)
+                       for n in range(1, 6)], dtype=object)
+    part = {
+        "p_partkey": np.arange(1, n_part + 1, dtype=np.int64),
+        "p_brand": brands[rng.integers(0, len(brands), n_part)],
+        "p_type": p_type[rng.integers(0, len(p_type), n_part)],
+        "p_size": rng.integers(1, 51, n_part).astype(np.int64),
+        "p_container": p_container[rng.integers(0, len(p_container), n_part)],
+        "p_retailprice": np.round(rng.uniform(900.0, 2000.0, n_part), 2),
+    }
+    # partsupp: 4 DISTINCT suppliers per part (spec primary key is
+    # (ps_partkey, ps_suppkey)). base + i*step mod S is duplicate-free
+    # for i in 0..3 whenever 0 < step <= (S-1)/3, mirroring dbgen's
+    # arithmetic-progression supplier assignment.
+    ps_partkey = np.repeat(part["p_partkey"], 4)
+    n_ps = len(ps_partkey)
+    base = rng.integers(0, n_supp, n_part)
+    step = rng.integers(1, max((n_supp - 1) // 3, 1) + 1, n_part)
+    ps_suppkey = ((base[:, None] + np.arange(4)[None, :] * step[:, None])
+                  % n_supp + 1).reshape(-1).astype(np.int64)
+    partsupp = {
+        "ps_partkey": ps_partkey,
+        "ps_suppkey": ps_suppkey,
+        "ps_availqty": rng.integers(1, 10_000, n_ps).astype(np.int64),
+        "ps_supplycost": np.round(rng.uniform(1.0, 1000.0, n_ps), 2),
+    }
     o_orderdate = rng.integers(_START, _END + 1, n_ord).astype(np.int32)
     orders = {
         "o_orderkey": np.arange(1, n_ord + 1, dtype=np.int64),
         "o_custkey": rng.integers(1, n_cust + 1, n_ord).astype(np.int64),
         "o_orderdate": o_orderdate,
+        "o_orderpriority": PRIORITIES[rng.integers(0, len(PRIORITIES),
+                                                   n_ord)],
         "o_shippriority": np.zeros(n_ord, dtype=np.int64),
         "o_totalprice": np.round(rng.uniform(800.0, 500_000.0, n_ord), 2),
     }
@@ -92,8 +142,10 @@ def generate(sf: float = 0.01, seed: int = 0) -> Mapping[str, dict]:
     l_orderkey = np.repeat(orders["o_orderkey"], per_order)
     n_li = len(l_orderkey)
     l_orderdate = np.repeat(o_orderdate, per_order)
+    l_shipdate = (l_orderdate + rng.integers(1, 122, n_li)).astype(np.int32)
     lineitem = {
         "l_orderkey": l_orderkey,
+        "l_partkey": rng.integers(1, n_part + 1, n_li).astype(np.int64),
         "l_suppkey": rng.integers(1, n_supp + 1, n_li).astype(np.int64),
         "l_quantity": rng.integers(1, 51, n_li).astype(np.int64),
         "l_extendedprice": np.round(rng.uniform(900.0, 105_000.0, n_li), 2),
@@ -102,14 +154,22 @@ def generate(sf: float = 0.01, seed: int = 0) -> Mapping[str, dict]:
         "l_returnflag": np.array(["R", "A", "N"])[
             rng.integers(0, 3, n_li)],
         "l_linestatus": np.array(["O", "F"])[rng.integers(0, 2, n_li)],
-        "l_shipdate": (l_orderdate
-                       + rng.integers(1, 122, n_li)).astype(np.int32),
+        "l_shipdate": l_shipdate,
+        "l_commitdate": (l_orderdate
+                         + rng.integers(30, 91, n_li)).astype(np.int32),
+        "l_receiptdate": (l_shipdate
+                          + rng.integers(1, 31, n_li)).astype(np.int32),
+        "l_shipmode": SHIPMODES[rng.integers(0, len(SHIPMODES), n_li)],
+        "l_shipinstruct": SHIPINSTRUCT[rng.integers(0, len(SHIPINSTRUCT),
+                                                    n_li)],
     }
     return {
         "region": region,
         "nation": nation,
         "customer": customer,
         "supplier": supplier,
+        "part": part,
+        "partsupp": partsupp,
         "orders": orders,
         "lineitem": lineitem,
     }

@@ -1,4 +1,5 @@
-"""TPC-H Q3 / Q5 over the DataFrame surface.
+"""TPC-H queries (Q1, Q3, Q4, Q5, Q6, Q10, Q12, Q14, Q18, Q19) over the
+DataFrame surface.
 
 Each query is the standard multi-way join + groupby pipeline
 (BASELINE.json config 5), written exactly as a PyCylon user would write
@@ -16,6 +17,7 @@ from typing import Mapping
 
 import jax.numpy as jnp
 
+from cylon_tpu import dtypes
 from cylon_tpu.errors import InvalidArgument
 from cylon_tpu.frame import DataFrame
 from cylon_tpu.table import Table
@@ -210,3 +212,240 @@ def q6(data: Mapping, env=None, date_from: int | None = None,
         t2 = li.table.add_column("rev", rev.column)
         return dist_aggregate(env, t2, "rev", "sum")
     return rev.sum()
+
+def q4(data: Mapping, env=None, date_from: int | None = None,
+       date_to: int | None = None) -> DataFrame:
+    """TPC-H Q4 (order priority checking): orders in a quarter with at
+    least one late lineitem. The EXISTS subquery is a semi-join =
+    unique(l_orderkey of late lineitems) ⋈ orders.
+
+    SELECT o_orderpriority, COUNT(*) AS order_count FROM orders
+    WHERE o_orderdate >= :from AND o_orderdate < :from + 3 months
+      AND EXISTS (SELECT * FROM lineitem WHERE l_orderkey = o_orderkey
+                  AND l_commitdate < l_receiptdate)
+    GROUP BY o_orderpriority ORDER BY o_orderpriority
+    """
+    if date_from is None:
+        date_from = date_int(1993, 7, 1)
+    if date_to is None:
+        date_to = date_int(1993, 10, 1)
+    orders, lineitem = _tables(data, ["orders", "lineitem"])
+
+    od = orders.table.column("o_orderdate").data
+    ords = orders[jnp.asarray((od >= jnp.int32(date_from))
+                              & (od < jnp.int32(date_to)))]
+    ords = ords[["o_orderkey", "o_orderpriority"]]
+    late = lineitem[jnp.asarray(
+        lineitem.table.column("l_commitdate").data
+        < lineitem.table.column("l_receiptdate").data)]
+    keys = late[["l_orderkey"]].drop_duplicates(["l_orderkey"], env=env)
+    j = ords.merge(keys, left_on="o_orderkey", right_on="l_orderkey",
+                   how="inner", env=env)
+    g = j.groupby(["o_orderpriority"], env=env).agg(
+        [("o_orderkey", "count", "order_count")])
+    return g.sort_values(["o_orderpriority"])[
+        ["o_orderpriority", "order_count"]]
+
+
+def q10(data: Mapping, env=None, date_from: int | None = None,
+        date_to: int | None = None, limit: int = 20) -> DataFrame:
+    """TPC-H Q10 (returned item reporting): top customers by lost
+    revenue on returned items in a quarter.
+
+    SELECT c_custkey, SUM(l_extendedprice*(1-l_discount)) AS revenue,
+           c_acctbal, n_name
+    FROM customer, orders, lineitem, nation
+    WHERE c_custkey = o_custkey AND l_orderkey = o_orderkey
+      AND o_orderdate IN [:from, :from + 3 months)
+      AND l_returnflag = 'R' AND c_nationkey = n_nationkey
+    GROUP BY c_custkey, c_acctbal, n_name
+    ORDER BY revenue DESC LIMIT :limit
+    """
+    if date_from is None:
+        date_from = date_int(1993, 10, 1)
+    if date_to is None:
+        date_to = date_int(1994, 1, 1)
+    customer, orders, lineitem, nation = _tables(
+        data, ["customer", "orders", "lineitem", "nation"])
+
+    od = orders.table.column("o_orderdate").data
+    ords = orders[jnp.asarray((od >= jnp.int32(date_from))
+                              & (od < jnp.int32(date_to)))]
+    ords = ords[["o_orderkey", "o_custkey"]]
+    li = lineitem[_eq_str(lineitem, "l_returnflag", "R")]
+    li = _with_revenue(li)[["l_orderkey", "revenue"]]
+    cust = customer[["c_custkey", "c_nationkey", "c_acctbal"]]
+    nat = nation[["n_nationkey", "n_name"]]
+
+    j = li.merge(ords, left_on="l_orderkey", right_on="o_orderkey",
+                 how="inner", env=env)
+    j = j.merge(cust, left_on="o_custkey", right_on="c_custkey",
+                how="inner", env=env)
+    j = j.merge(nat, left_on="c_nationkey", right_on="n_nationkey",
+                how="inner", env=env)
+    g = j.groupby(["c_custkey", "c_acctbal", "n_name"], env=env).agg(
+        [("revenue", "sum", "revenue")])
+    out = g.sort_values(["revenue", "c_custkey"], ascending=[False, True])
+    out = out.head(limit)
+    return out[["c_custkey", "revenue", "c_acctbal", "n_name"]]
+
+
+def q12(data: Mapping, env=None, modes=("MAIL", "SHIP"),
+        date_from: int | None = None, date_to: int | None = None
+        ) -> DataFrame:
+    """TPC-H Q12 (shipping modes and order priority): late-shipping
+    counts per mode, split by order priority. The CASE sums become
+    0/1 indicator columns summed by groupby.
+
+    SELECT l_shipmode,
+           SUM(o_orderpriority IN ('1-URGENT','2-HIGH')) AS high_line_count,
+           SUM(NOT ...) AS low_line_count
+    FROM orders JOIN lineitem ON o_orderkey = l_orderkey
+    WHERE l_shipmode IN :modes AND l_commitdate < l_receiptdate
+      AND l_shipdate < l_commitdate AND l_receiptdate IN [:from, :from+1y)
+    GROUP BY l_shipmode ORDER BY l_shipmode
+    """
+    if date_from is None:
+        date_from = date_int(1994, 1, 1)
+    if date_to is None:
+        date_to = date_int(1995, 1, 1)
+    orders, lineitem = _tables(data, ["orders", "lineitem"])
+
+    t = lineitem.table
+    rd = t.column("l_receiptdate").data
+    mask = (lineitem.series("l_shipmode").isin(list(modes)).column.data
+            & (t.column("l_commitdate").data < rd)
+            & (t.column("l_shipdate").data < t.column("l_commitdate").data)
+            & (rd >= jnp.int32(date_from)) & (rd < jnp.int32(date_to)))
+    li = lineitem[jnp.asarray(mask)][["l_orderkey", "l_shipmode"]]
+    j = li.merge(orders[["o_orderkey", "o_orderpriority"]],
+                 left_on="l_orderkey", right_on="o_orderkey",
+                 how="inner", env=env)
+    j = j._materialized()
+    high = j.series("o_orderpriority").isin(["1-URGENT", "2-HIGH"])
+    low = ~high
+    t2 = j.table.add_column("high_line_count",
+                            high.column.astype(dtypes.int64))
+    t2 = t2.add_column("low_line_count", low.column.astype(dtypes.int64))
+    g = DataFrame._wrap(t2).groupby(["l_shipmode"], env=env).agg([
+        ("high_line_count", "sum", "high_line_count"),
+        ("low_line_count", "sum", "low_line_count"),
+    ])
+    return g.sort_values(["l_shipmode"])[
+        ["l_shipmode", "high_line_count", "low_line_count"]]
+
+
+def q14(data: Mapping, env=None, date_from: int | None = None,
+        date_to: int | None = None):
+    """TPC-H Q14 (promotion effect) — a scalar percentage:
+
+    SELECT 100 * SUM(CASE WHEN p_type LIKE 'PROMO%'
+                          THEN l_extendedprice*(1-l_discount) ELSE 0 END)
+               / SUM(l_extendedprice*(1-l_discount))
+    FROM lineitem JOIN part ON l_partkey = p_partkey
+    WHERE l_shipdate IN [:from, :from + 1 month)
+    """
+    if date_from is None:
+        date_from = date_int(1995, 9, 1)
+    if date_to is None:
+        date_to = date_int(1995, 10, 1)
+    lineitem, part = _tables(data, ["lineitem", "part"])
+
+    sd = lineitem.table.column("l_shipdate").data
+    li = lineitem[jnp.asarray((sd >= jnp.int32(date_from))
+                              & (sd < jnp.int32(date_to)))]
+    li = _with_revenue(li)[["l_partkey", "revenue"]]
+    j = li.merge(part[["p_partkey", "p_type"]], left_on="l_partkey",
+                 right_on="p_partkey", how="inner", env=env)
+    j = j._materialized()
+    promo = j.series("p_type").str_startswith("PROMO")
+    rev = j.series("revenue")
+    promo_rev = rev * promo.column.data.astype(rev.column.data.dtype)
+    total = float(rev.sum())
+    return 100.0 * float(promo_rev.sum()) / total if total else 0.0
+
+
+def q18(data: Mapping, env=None, threshold: int = 300,
+        limit: int = 100) -> DataFrame:
+    """TPC-H Q18 (large volume customer): orders whose total quantity
+    exceeds a threshold (the HAVING clause = groupby → filter → join).
+
+    SELECT c_custkey, o_orderkey, o_orderdate, o_totalprice,
+           SUM(l_quantity) AS sum_qty
+    FROM customer, orders, lineitem
+    WHERE o_orderkey IN (SELECT l_orderkey FROM lineitem
+                         GROUP BY l_orderkey
+                         HAVING SUM(l_quantity) > :threshold)
+      AND c_custkey = o_custkey AND o_orderkey = l_orderkey
+    GROUP BY c_custkey, o_orderkey, o_orderdate, o_totalprice
+    ORDER BY o_totalprice DESC, o_orderdate LIMIT :limit
+    """
+    customer, orders, lineitem = _tables(
+        data, ["customer", "orders", "lineitem"])
+
+    g = lineitem.groupby(["l_orderkey"], env=env).agg(
+        [("l_quantity", "sum", "sum_qty")])._materialized()
+    big = g[jnp.asarray(g.table.column("sum_qty").data
+                        > jnp.float64(threshold))]
+    j = big.merge(orders[["o_orderkey", "o_custkey", "o_orderdate",
+                          "o_totalprice"]],
+                  left_on="l_orderkey", right_on="o_orderkey",
+                  how="inner", env=env)
+    j = j.merge(customer[["c_custkey"]], left_on="o_custkey",
+                right_on="c_custkey", how="inner", env=env)
+    out = j.sort_values(["o_totalprice", "o_orderdate"],
+                        ascending=[False, True]).head(limit)
+    return out[["c_custkey", "o_orderkey", "o_orderdate", "o_totalprice",
+                "sum_qty"]]
+
+
+_Q19_CONTAINERS = (("SM CASE", "SM BOX", "SM PACK", "SM PKG"),
+                   ("MED BAG", "MED BOX", "MED PKG", "MED PACK"),
+                   ("LG CASE", "LG BOX", "LG PACK", "LG PKG"))
+_Q19_SIZES = (5, 10, 15)
+
+
+def q19(data: Mapping, env=None,
+        brands=("Brand#12", "Brand#23", "Brand#34"),
+        quantities=(1, 10, 20), containers=_Q19_CONTAINERS,
+        sizes=_Q19_SIZES):
+    """TPC-H Q19 (discounted revenue) — a scalar: revenue from
+    brand/container/quantity/size OR-branches (one branch per entry of
+    the four parallel tuples). Shipmode/instruct predicates push down
+    before the join; the branch predicates mix part and lineitem
+    attributes so they evaluate post-join.
+
+    SELECT SUM(l_extendedprice*(1-l_discount)) FROM lineitem, part
+    WHERE p_partkey = l_partkey AND l_shipinstruct = 'DELIVER IN PERSON'
+      AND l_shipmode IN ('AIR','REG AIR') AND (<branch1> OR ... OR <branchN>)
+    """
+    if not (len(brands) == len(quantities) == len(containers)
+            == len(sizes)):
+        raise InvalidArgument(
+            "q19 branch tuples must have equal length: "
+            f"{len(brands)} brands, {len(quantities)} quantities, "
+            f"{len(containers)} containers, {len(sizes)} sizes")
+    lineitem, part = _tables(data, ["lineitem", "part"])
+
+    pre = (lineitem.series("l_shipmode").isin(["AIR", "REG AIR"]).column.data
+           & _eq_str(lineitem, "l_shipinstruct", "DELIVER IN PERSON"))
+    li = _with_revenue(lineitem[jnp.asarray(pre)])[
+        ["l_partkey", "l_quantity", "revenue"]]
+    j = li.merge(part[["p_partkey", "p_brand", "p_container", "p_size"]],
+                 left_on="l_partkey", right_on="p_partkey",
+                 how="inner", env=env)
+    j = j._materialized()
+
+    qty = j.table.column("l_quantity").data
+    size = j.table.column("p_size").data
+    mask = jnp.zeros(j.table.capacity, bool)
+    for brand, cont, q_lo, s_hi in zip(brands, containers, quantities,
+                                       sizes):
+        branch = (j.series("p_brand").isin([brand]).column.data
+                  & j.series("p_container").isin(cont).column.data
+                  & (qty >= q_lo) & (qty <= q_lo + 10)
+                  & (size >= 1) & (size <= s_hi))
+        mask = mask | branch
+    rev = j.series("revenue")
+    sel = rev * mask.astype(rev.column.data.dtype)
+    return float(sel.sum())

@@ -175,3 +175,231 @@ def test_q1_distributed(env8):
     pd.testing.assert_frame_equal(
         dist.sort_values(["l_returnflag", "l_linestatus"]).reset_index(drop=True),
         local, rtol=1e-9)
+
+
+# ---- Q4 / Q10 / Q12 / Q14 / Q18 / Q19 ------------------------------------
+
+def q4_pandas(pdfs, date_from=None, date_to=None):
+    if date_from is None:
+        date_from = date_int(1993, 7, 1)
+    if date_to is None:
+        date_to = date_int(1993, 10, 1)
+    o = pdfs["orders"]
+    l = pdfs["lineitem"]
+    o = o[(o.o_orderdate >= date_from) & (o.o_orderdate < date_to)]
+    late = l[l.l_commitdate < l.l_receiptdate].l_orderkey.unique()
+    o = o[o.o_orderkey.isin(late)]
+    g = (o.groupby("o_orderpriority", as_index=False)
+         .agg(order_count=("o_orderkey", "count")))
+    return g.sort_values("o_orderpriority").reset_index(drop=True)
+
+
+def q10_pandas(pdfs, date_from=None, date_to=None, limit=20):
+    if date_from is None:
+        date_from = date_int(1993, 10, 1)
+    if date_to is None:
+        date_to = date_int(1994, 1, 1)
+    c, o, l, n = (pdfs["customer"], pdfs["orders"], pdfs["lineitem"],
+                  pdfs["nation"])
+    o = o[(o.o_orderdate >= date_from) & (o.o_orderdate < date_to)]
+    l = l[l.l_returnflag == "R"].copy()
+    l["revenue"] = l.l_extendedprice * (1 - l.l_discount)
+    j = (l.merge(o, left_on="l_orderkey", right_on="o_orderkey")
+          .merge(c, left_on="o_custkey", right_on="c_custkey")
+          .merge(n, left_on="c_nationkey", right_on="n_nationkey"))
+    g = (j.groupby(["c_custkey", "c_acctbal", "n_name"], as_index=False)
+         ["revenue"].sum())
+    g = g.sort_values(["revenue", "c_custkey"],
+                      ascending=[False, True]).head(limit)
+    return g[["c_custkey", "revenue", "c_acctbal", "n_name"]].reset_index(
+        drop=True)
+
+
+def q12_pandas(pdfs, modes=("MAIL", "SHIP"), date_from=None, date_to=None):
+    if date_from is None:
+        date_from = date_int(1994, 1, 1)
+    if date_to is None:
+        date_to = date_int(1995, 1, 1)
+    o = pdfs["orders"]
+    l = pdfs["lineitem"]
+    l = l[l.l_shipmode.isin(modes) & (l.l_commitdate < l.l_receiptdate)
+          & (l.l_shipdate < l.l_commitdate)
+          & (l.l_receiptdate >= date_from) & (l.l_receiptdate < date_to)]
+    j = l.merge(o, left_on="l_orderkey", right_on="o_orderkey").copy()
+    j["high_line_count"] = j.o_orderpriority.isin(
+        ["1-URGENT", "2-HIGH"]).astype(int)
+    j["low_line_count"] = 1 - j.high_line_count
+    g = j.groupby("l_shipmode", as_index=False)[
+        ["high_line_count", "low_line_count"]].sum()
+    return g.sort_values("l_shipmode").reset_index(drop=True)
+
+
+def q14_pandas(pdfs, date_from=None, date_to=None):
+    if date_from is None:
+        date_from = date_int(1995, 9, 1)
+    if date_to is None:
+        date_to = date_int(1995, 10, 1)
+    l = pdfs["lineitem"]
+    p = pdfs["part"]
+    l = l[(l.l_shipdate >= date_from) & (l.l_shipdate < date_to)].copy()
+    l["revenue"] = l.l_extendedprice * (1 - l.l_discount)
+    j = l.merge(p, left_on="l_partkey", right_on="p_partkey")
+    promo = j[j.p_type.str.startswith("PROMO")].revenue.sum()
+    total = j.revenue.sum()
+    return 100.0 * promo / total if total else 0.0
+
+
+def q18_pandas(pdfs, threshold=300, limit=100):
+    c, o, l = pdfs["customer"], pdfs["orders"], pdfs["lineitem"]
+    g = l.groupby("l_orderkey", as_index=False).agg(
+        sum_qty=("l_quantity", "sum"))
+    big = g[g.sum_qty > threshold]
+    j = (big.merge(o, left_on="l_orderkey", right_on="o_orderkey")
+         .merge(c, left_on="o_custkey", right_on="c_custkey"))
+    j = j.sort_values(["o_totalprice", "o_orderdate"],
+                      ascending=[False, True]).head(limit)
+    return j[["c_custkey", "o_orderkey", "o_orderdate", "o_totalprice",
+              "sum_qty"]].reset_index(drop=True)
+
+
+def q19_pandas(pdfs, brands=("Brand#12", "Brand#23", "Brand#34"),
+               quantities=(1, 10, 20)):
+    l = pdfs["lineitem"]
+    p = pdfs["part"]
+    l = l[l.l_shipmode.isin(["AIR", "REG AIR"])
+          & (l.l_shipinstruct == "DELIVER IN PERSON")].copy()
+    l["revenue"] = l.l_extendedprice * (1 - l.l_discount)
+    j = l.merge(p, left_on="l_partkey", right_on="p_partkey")
+    containers = (["SM CASE", "SM BOX", "SM PACK", "SM PKG"],
+                  ["MED BAG", "MED BOX", "MED PKG", "MED PACK"],
+                  ["LG CASE", "LG BOX", "LG PACK", "LG PKG"])
+    sizes = (5, 10, 15)
+    mask = np.zeros(len(j), bool)
+    for brand, cont, q_lo, s_hi in zip(brands, containers, quantities,
+                                       sizes):
+        mask |= ((j.p_brand == brand) & j.p_container.isin(cont)
+                 & (j.l_quantity >= q_lo) & (j.l_quantity <= q_lo + 10)
+                 & (j.p_size >= 1) & (j.p_size <= s_hi)).to_numpy()
+    return float(j.revenue[mask].sum())
+
+
+def _frame_close(got: pd.DataFrame, want: pd.DataFrame, float_cols):
+    assert len(got) == len(want), (len(got), len(want))
+    got = got.reset_index(drop=True)
+    want = want.reset_index(drop=True)
+    for col in want.columns:
+        if col in float_cols:
+            np.testing.assert_allclose(
+                got[col].to_numpy(np.float64),
+                want[col].to_numpy(np.float64), rtol=1e-9)
+        else:
+            assert list(got[col]) == list(want[col]), col
+
+
+from cylon_tpu.tpch.queries import q4, q10, q12, q14, q18, q19  # noqa: E402
+
+
+def test_q4(data, pdfs, env4):
+    want = q4_pandas(pdfs)
+    _frame_close(q4(data).to_pandas(), want, set())
+    _frame_close(q4(data, env=env4).to_pandas(), want, set())
+
+
+def test_q10(data, pdfs, env4):
+    want = q10_pandas(pdfs)
+    _frame_close(q10(data).to_pandas(), want,
+                 {"revenue", "c_acctbal"})
+    _frame_close(q10(data, env=env4).to_pandas(), want,
+                 {"revenue", "c_acctbal"})
+
+
+def test_q12(data, pdfs, env4):
+    want = q12_pandas(pdfs)
+    _frame_close(q12(data).to_pandas(), want, set())
+    _frame_close(q12(data, env=env4).to_pandas(), want, set())
+
+
+def test_q14(data, pdfs, env4):
+    want = q14_pandas(pdfs)
+    np.testing.assert_allclose(q14(data), want, rtol=1e-9)
+    np.testing.assert_allclose(q14(data, env=env4), want, rtol=1e-9)
+
+
+def test_q18(data, pdfs, env4):
+    # tiny sf: lower the threshold so the HAVING clause keeps rows
+    want = q18_pandas(pdfs, threshold=150)
+    assert len(want) > 0
+    _frame_close(q18(data, threshold=150).to_pandas(), want,
+                 {"o_totalprice", "sum_qty"})
+    _frame_close(q18(data, env=env4, threshold=150).to_pandas(), want,
+                 {"o_totalprice", "sum_qty"})
+
+
+def test_q19(data, pdfs, env4):
+    want = q19_pandas(pdfs)
+    np.testing.assert_allclose(q19(data), want, rtol=1e-9)
+    np.testing.assert_allclose(q19(data, env=env4), want, rtol=1e-9)
+
+
+def test_q19_handcrafted(env4):
+    """sf-independent Q19 check: rows engineered to hit each OR-branch
+    plus near-misses on every predicate leg."""
+    part = {
+        "p_partkey": np.arange(1, 9, dtype=np.int64),
+        "p_brand": np.array(["Brand#12", "Brand#23", "Brand#34", "Brand#12",
+                             "Brand#55", "Brand#12", "Brand#23", "Brand#34"],
+                            dtype=object),
+        "p_container": np.array(["SM CASE", "MED BAG", "LG PKG", "JUMBO BOX",
+                                 "SM CASE", "SM BOX", "MED PKG", "LG CASE"],
+                                dtype=object),
+        "p_size": np.array([3, 7, 12, 2, 4, 50, 9, 1], dtype=np.int64),
+        "p_type": np.array(["T"] * 8, dtype=object),
+        "p_retailprice": np.ones(8),
+    }
+    n = 10
+    lineitem = {
+        "l_orderkey": np.arange(1, n + 1, dtype=np.int64),
+        "l_partkey": np.array([1, 2, 3, 4, 5, 6, 7, 8, 1, 2],
+                              dtype=np.int64),
+        "l_suppkey": np.ones(n, dtype=np.int64),
+        "l_quantity": np.array([5, 15, 25, 5, 5, 5, 15, 25, 40, 15],
+                               dtype=np.int64),
+        "l_extendedprice": np.full(n, 100.0),
+        "l_discount": np.zeros(n),
+        "l_tax": np.zeros(n),
+        "l_returnflag": np.array(["N"] * n, dtype=object),
+        "l_linestatus": np.array(["O"] * n, dtype=object),
+        "l_shipdate": np.full(n, 9000, dtype=np.int32),
+        "l_commitdate": np.full(n, 9000, dtype=np.int32),
+        "l_receiptdate": np.full(n, 9001, dtype=np.int32),
+        "l_shipmode": np.array(["AIR", "REG AIR", "AIR", "AIR", "AIR",
+                                "AIR", "REG AIR", "AIR", "AIR", "TRUCK"],
+                               dtype=object),
+        "l_shipinstruct": np.array(
+            ["DELIVER IN PERSON"] * 9 + ["COLLECT COD"], dtype=object),
+    }
+    # hits: row0 (branch1: Brand#12/SM CASE/qty5/size3),
+    #       row1 (branch2: Brand#23/MED BAG/qty15/size7),
+    #       row2 (branch3: Brand#34/LG PKG/qty25/size12),
+    #       row7 (branch3: Brand#34/LG CASE/qty25/size1)
+    # misses: row3 (container JUMBO), row4 (brand 55), row5 (size 50),
+    #         row6 (ok)  -> actually Brand#23/MED PKG/qty15/size9 hits
+    #         row8 (qty 40 out of range), row9 (shipmode TRUCK + instruct)
+    data = {"part": part, "lineitem": lineitem}
+    pdfs = {k: pd.DataFrame(v) for k, v in data.items()}
+    want = q19_pandas(pdfs)
+    assert want == 500.0  # rows 0,1,2,6,7 × $100
+    np.testing.assert_allclose(q19(data), want, rtol=1e-12)
+    np.testing.assert_allclose(q19(data, env=env4), want, rtol=1e-12)
+
+
+def test_partsupp_primary_key(data):
+    ps = data["partsupp"]
+    pairs = set(zip(ps["ps_partkey"].tolist(), ps["ps_suppkey"].tolist()))
+    assert len(pairs) == len(ps["ps_partkey"])  # (partkey, suppkey) unique
+    assert len(ps["ps_partkey"]) == 4 * len(data["part"]["p_partkey"])
+
+
+def test_q19_branch_length_validation(data):
+    with pytest.raises(Exception):
+        q19(data, brands=("Brand#12", "Brand#23"), quantities=(1, 10, 20))
