@@ -31,6 +31,24 @@ import jax as _jax
 if not _os.environ.get("CYLON_TPU_NO_X64"):
     _jax.config.update("jax_enable_x64", True)
 
+# Persistent XLA compilation cache: relational programs are large (a
+# distributed join is one fused shard_map program) and TPU compiles are
+# minutes cold — but byte-identical across processes, so cache them on
+# disk. CYLON_TPU_CACHE_DIR overrides the location; CYLON_TPU_NO_CACHE=1
+# disables (parity note: the reference has no analog — XLA-specific).
+if not _os.environ.get("CYLON_TPU_NO_CACHE"):
+    _cache_dir = _os.environ.get(
+        "CYLON_TPU_CACHE_DIR",
+        _os.path.join(_os.path.expanduser("~"), ".cache", "cylon_tpu",
+                      "xla"))
+    try:
+        _os.makedirs(_cache_dir, exist_ok=True)
+        _jax.config.update("jax_compilation_cache_dir", _cache_dir)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                           1.0)
+    except (OSError, AttributeError):  # read-only fs / very old jax
+        pass
+
 from cylon_tpu.utils.logging import init_logging as _init_logging
 
 # CYLON_LOG_LEVEL -> logger config (parity: pycylon/__init__.py:30-43)

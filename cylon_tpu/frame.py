@@ -44,6 +44,19 @@ from cylon_tpu.parallel import (
 )
 from cylon_tpu.table import Table
 
+import os as _os
+
+_NO_SHRINK = bool(_os.environ.get("CYLON_TPU_NO_SHRINK"))
+
+
+def _shrink(t: Table) -> Table:
+    """Capacity shrink-to-fit after selective local ops (see
+    ``Table.shrink_to_fit``). Distributed tables keep their layout —
+    per-shard counts differ and the shard shape is the mesh contract."""
+    if _NO_SHRINK or is_distributed(t):
+        return t
+    return t.shrink_to_fit()
+
 
 class DataFrame:
     """Columnar dataframe on device (parity: pycylon ``DataFrame``)."""
@@ -201,8 +214,8 @@ class DataFrame:
         if isinstance(key, DataFrame):
             key = key._single_column().data
         if isinstance(key, (jnp.ndarray, np.ndarray)):
-            return DataFrame._wrap(
-                _selection.filter_table(self._gathered(), jnp.asarray(key)))
+            t = _selection.filter_table(self._gathered(), jnp.asarray(key))
+            return DataFrame._wrap(_shrink(t))
         raise KeyError_(f"bad key {key!r}")
 
     def __setitem__(self, name, value):
@@ -244,6 +257,7 @@ class DataFrame:
             t = _join(self._gathered(), right._gathered(), on=on,
                       left_on=left_on, right_on=right_on, how=how,
                       suffixes=suffixes, out_capacity=out_capacity)
+            t = _shrink(t)
         return DataFrame._wrap(t)
 
     def join(self, right: "DataFrame", on=None, how: str = "left",
@@ -280,7 +294,7 @@ class DataFrame:
                 dist_unique(env, self._table, subset,
                             out_capacity=out_capacity, keep=keep))
         return DataFrame._wrap(
-            _setops.unique(self._gathered(), subset, keep=keep))
+            _shrink(_setops.unique(self._gathered(), subset, keep=keep)))
 
     def head(self, n: int = 5) -> "DataFrame":
         return DataFrame._wrap(_selection.head(self._gathered(), n))
@@ -624,6 +638,7 @@ class GroupByDataFrame:
             t = _groupby_mod.groupby_aggregate(self._df._gathered(),
                                                self._by, aggs,
                                                out_capacity=out_capacity)
+            t = _shrink(t)
         return DataFrame._wrap(t)
 
     def _all_value_cols(self, op):

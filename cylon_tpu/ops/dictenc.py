@@ -26,7 +26,10 @@ def unify_dictionaries(cols: list[Column]) -> list[Column]:
         return cols
     dicts = [c.dictionary for c in dict_cols]
     first = dicts[0]
-    if all(d is first for d in dicts):
+    # content equality (Dictionary.__eq__), not identity: independently
+    # ingested tables over the same value set share codes already and
+    # need no remap
+    if first is not None and all(d == first for d in dicts):
         return cols
     merged = np.unique(np.concatenate([d.values for d in dicts]))
     shared = Dictionary(merged)

@@ -15,6 +15,7 @@ makes the same lexsort cheap.
 Group order in the output is key-sorted (== pandas ``sort=True``).
 """
 
+import functools
 import math
 from typing import Sequence
 
@@ -57,8 +58,18 @@ def groupby_aggregate(table: Table, by: Sequence[str],
     key-sorted. Null keys form their own group (they equal each other).
     Nulls/NaNs in value columns are skipped (pandas skipna semantics).
     """
+    out_cap = int(out_capacity if out_capacity is not None
+                  else table.capacity)
+    return _groupby_compiled(table, by=tuple(by),
+                             aggs=tuple(tuple(a) for a in aggs),
+                             out_cap=out_cap, quantile=float(quantile))
+
+
+@functools.partial(jax.jit, static_argnames=("by", "aggs", "out_cap",
+                                             "quantile"))
+def _groupby_compiled(table: Table, *, by, aggs, out_cap,
+                      quantile) -> Table:
     cap = table.capacity
-    out_cap = out_capacity if out_capacity is not None else cap
     keys = [table.column(n).data for n in by]
     kvals = [table.column(n).validity for n in by]
     gid, num_groups, _ = kernels.dense_group_ids(keys, table.nrows, kvals)

@@ -19,6 +19,7 @@ Cost: O((|L|+|R|) log(|L|+|R|)) like the reference's sort join, but with
 no per-row control flow, so the whole join stays inside one jit.
 """
 
+import functools
 from typing import Sequence
 
 import jax
@@ -76,14 +77,30 @@ def join(left: Table, right: Table, config: JoinConfig | None = None, *,
     cl, cr = left.capacity, right.capacity
     out_cap = out_capacity if out_capacity is not None else cl + cr
 
-    left, right, lkeys, rkeys, lvals, rvals = _aligned_keys(
-        left, right, left_on, right_on)
+    # host-side: dictionary unification (string keys) happens before the
+    # traced core — device code only sees codes
+    left, right, _, _, _, _ = _aligned_keys(left, right, left_on, right_on)
 
+    # one compiled program for match + expansion + assembly: the eager
+    # op-by-op path pays a per-primitive dispatch round trip (~ms on a
+    # tunneled device) times hundreds of primitives; jit pays one
+    return _join_compiled(left, right, left_on=tuple(left_on),
+                          right_on=tuple(right_on), how=how,
+                          suffixes=tuple(suffixes), out_cap=int(out_cap))
+
+
+@functools.partial(jax.jit, static_argnames=("left_on", "right_on", "how",
+                                             "suffixes", "out_cap"))
+def _join_compiled(left: Table, right: Table, *, left_on, right_on, how,
+                   suffixes, out_cap) -> Table:
+    lkeys = [left.column(n).data for n in left_on]
+    rkeys = [right.column(n).data for n in right_on]
+    lvals = [left.column(n).validity for n in left_on]
+    rvals = [right.column(n).validity for n in right_on]
     left_idx, right_idx, total = _join_indices(
         lkeys, lvals, left.nrows, rkeys, rvals, right.nrows, how, out_cap)
-
-    return _assemble(left, right, left_on, right_on, suffixes,
-                     left_idx, right_idx, total, how)
+    return _assemble(left, right, list(left_on), list(right_on),
+                     suffixes, left_idx, right_idx, total, how)
 
 
 def _aligned_keys(left, right, left_on, right_on):

@@ -7,6 +7,7 @@ Reference analogs: ``Table::Project/Select`` and friends
 Everything is a gather/scatter over padded arrays; row counts stay traced.
 """
 
+import functools
 from typing import Sequence
 
 import jax
@@ -114,9 +115,11 @@ def take_columns(table: Table, idx: jax.Array, nrows_out,
     return Table(cols, nrows_out)
 
 
+@jax.jit
 def filter_table(table: Table, mask: jax.Array) -> Table:
     """Keep rows where mask is True, preserving order (parity: the
-    filter path of ``python/pycylon/data/compute.pyx:212``)."""
+    filter path of ``python/pycylon/data/compute.pyx:212``). Jitted:
+    one compiled program instead of per-primitive eager dispatch."""
     perm, count = kernels.compact_mask(mask, table.nrows)
     return take_columns(table, perm, count)
 
@@ -128,6 +131,13 @@ def sort_table(table: Table, by: Sequence[str], ascending=True,
     NaN/null keys go last regardless of direction)."""
     if isinstance(ascending, bool):
         ascending = [ascending] * len(by)
+    return _sort_compiled(table, by=tuple(by), ascending=tuple(ascending),
+                          na_position=na_position)
+
+
+@functools.partial(jax.jit, static_argnames=("by", "ascending",
+                                             "na_position"))
+def _sort_compiled(table: Table, *, by, ascending, na_position) -> Table:
     keys = []
     dirs = []
     for name, asc in zip(by, ascending):

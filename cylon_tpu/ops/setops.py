@@ -11,6 +11,7 @@ collision handling. First-occurrence order of the left/a table is
 preserved (pandas drop_duplicates semantics for unique).
 """
 
+import functools
 from typing import Sequence
 
 import jax
@@ -40,8 +41,17 @@ def unique(table: Table, cols: Sequence[str] | None = None,
     kept as ``nrows`` so overflow surfaces via ``Table.num_rows``."""
     if keep not in ("first", "last"):
         raise InvalidArgument(f"keep={keep!r}")
+    return _unique_compiled(table,
+                            cols=None if cols is None else tuple(cols),
+                            keep=keep,
+                            out_cap=int(out_capacity
+                                        if out_capacity is not None
+                                        else table.capacity))
+
+
+@functools.partial(jax.jit, static_argnames=("cols", "keep", "out_cap"))
+def _unique_compiled(table: Table, *, cols, keep, out_cap) -> Table:
     cap = table.capacity
-    out_cap = out_capacity if out_capacity is not None else cap
     gid, num_groups, _ = _row_gids(table, cols)
     iota = jnp.arange(cap, dtype=jnp.int32)
     if keep == "first":

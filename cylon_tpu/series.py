@@ -221,14 +221,27 @@ class Series:
         return self.isin([v for v in vals if pred(v)])
 
     def str_startswith(self, prefix: str) -> "Series":
+        """Rows whose value starts with ``prefix`` (pandas
+        ``Series.str.startswith``; always literal)."""
         return self._dict_pred(lambda v: v is not None
                                and str(v).startswith(prefix))
 
     def str_endswith(self, suffix: str) -> "Series":
+        """Rows whose value ends with ``suffix`` (pandas
+        ``Series.str.endswith``; always literal)."""
         return self._dict_pred(lambda v: v is not None
                                and str(v).endswith(suffix))
 
-    def str_contains(self, pat: str) -> "Series":
+    def str_contains(self, pat: str, regex: bool = True) -> "Series":
+        """Rows whose value contains ``pat`` — a regex by default, same
+        as pandas ``Series.str.contains``; pass ``regex=False`` for
+        literal substring matching."""
+        if regex:
+            import re
+
+            rx = re.compile(pat)
+            return self._dict_pred(lambda v: v is not None
+                                   and rx.search(str(v)) is not None)
         return self._dict_pred(lambda v: v is not None and pat in str(v))
 
     def map(self, fn: Callable) -> "Series":

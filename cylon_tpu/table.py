@@ -63,12 +63,7 @@ class Table:
             return 0
         return next(iter(self._columns.values())).capacity
 
-    @property
-    def num_rows(self) -> int:
-        """Concrete row count (syncs device->host; not usable under trace).
-        Parity: ``table.hpp`` Rows(). Raises OutOfCapacity if a
-        capacity-bounded kernel overflowed its static result bound."""
-        n = int(self.nrows)
+    def _check_overflow(self, n: int) -> int:
         if n > self.capacity:
             from cylon_tpu.errors import OutOfCapacity
 
@@ -76,6 +71,13 @@ class Table:
                 f"result has {n} rows but static capacity is "
                 f"{self.capacity}; re-run with a larger out_capacity")
         return n
+
+    @property
+    def num_rows(self) -> int:
+        """Concrete row count (syncs device->host; not usable under trace).
+        Parity: ``table.hpp`` Rows(). Raises OutOfCapacity if a
+        capacity-bounded kernel overflowed its static result bound."""
+        return self._check_overflow(int(self.nrows))
 
     @property
     def num_columns(self) -> int:
@@ -150,6 +152,41 @@ class Table:
                 validity = None if c.validity is None else c.validity[:capacity]
             cols[n] = Column(data, validity, c.dtype, c.dictionary)
         return Table(cols, jnp.minimum(self.nrows, capacity))
+
+    def shrink_to_fit(self, min_capacity: int = 1024,
+                      only_above: int = 1 << 16) -> "Table":
+        """Trim static capacity to a power-of-2 bucket of the concrete
+        row count (local-eager optimisation: selective filters/joins
+        leave the buffer mostly padding, and downstream sort-based
+        kernels cost O(capacity log capacity) regardless of real rows).
+        Power-of-2 buckets bound the number of distinct compiled shapes.
+
+        Reading the row count is a host sync — a fixed ~100 ms round
+        trip on a tunneled device — so tables at or below ``only_above``
+        capacity are left alone: the sync would cost more than any
+        downstream sort saves.
+
+        No-op when the row count is abstract (under jit trace), when the
+        table overflowed its bound (the OutOfCapacity poison must keep
+        propagating to the host materialisation that reports it), or
+        when the bucket wouldn't shrink anything.
+        """
+        if self.capacity <= only_above:
+            return self
+        from cylon_tpu.errors import OutOfCapacity
+
+        try:
+            n = self.num_rows
+        except OutOfCapacity:  # poison must propagate, not be trimmed
+            return self
+        except (jax.errors.TracerIntegerConversionError,
+                jax.errors.ConcretizationTypeError,
+                TypeError):  # abstract nrows (under trace) / vector nrows
+            return self
+        bucket = max(min_capacity, 1 << max(n - 1, 0).bit_length())
+        if bucket < self.capacity:
+            return self.with_capacity(bucket)
+        return self
 
     # -- host bridges ----------------------------------------------------
     @staticmethod
@@ -341,27 +378,43 @@ class Table:
                     for m in mats]
             yield Row(names, vals)
 
+    def _host_columns(self) -> "collections.OrderedDict[str, np.ndarray]":
+        """All columns as decoded host arrays via ONE device->host
+        transfer (row count + every data/validity buffer batched into a
+        single ``jax.device_get``). Per-column fetches each pay a fixed
+        ~100 ms round trip on a tunneled device; batching pays it once.
+        Raises OutOfCapacity like :attr:`num_rows`."""
+        payload = [self.nrows]
+        for c in self._columns.values():
+            payload.append(c.data)
+            if c.validity is not None:
+                payload.append(c.validity)
+        fetched = jax.device_get(payload)
+        n = self._check_overflow(int(fetched[0]))
+        out = collections.OrderedDict()
+        it = iter(fetched[1:])
+        for name, c in self._columns.items():
+            data = next(it)[:n]
+            validity = next(it)[:n] if c.validity is not None else None
+            out[name] = c.decode_host(data, validity)
+        return out
+
     def to_pydict(self) -> dict:
-        n = self.num_rows
-        return {name: c.to_numpy(n).tolist() for name, c in self._columns.items()}
+        return {name: a.tolist() for name, a in self._host_columns().items()}
 
     def to_pandas(self):
         import pandas as pd
 
-        n = self.num_rows
-        return pd.DataFrame({name: c.to_numpy(n)
-                             for name, c in self._columns.items()})
+        return pd.DataFrame(self._host_columns())
 
     def to_arrow(self):
         import pyarrow as pa
 
-        n = self.num_rows
-        return pa.table({name: c.to_numpy(n) for name, c in self._columns.items()})
+        return pa.table(dict(self._host_columns()))
 
     def to_numpy(self) -> np.ndarray:
         """[nrows, ncols] host matrix (parity: table.pyx to_numpy)."""
-        n = self.num_rows
-        return np.stack([c.to_numpy(n) for c in self._columns.values()], axis=1)
+        return np.stack(list(self._host_columns().values()), axis=1)
 
     def __repr__(self):
         from cylon_tpu.errors import OutOfCapacity

@@ -18,6 +18,7 @@ from typing import Mapping
 import jax.numpy as jnp
 
 from cylon_tpu import dtypes
+from cylon_tpu.column import Column
 from cylon_tpu.errors import InvalidArgument
 from cylon_tpu.frame import DataFrame
 from cylon_tpu.table import Table
@@ -45,6 +46,24 @@ def _eq_str(df: DataFrame, col: str, value: str) -> jnp.ndarray:
     """Boolean row mask ``col == value`` for a string column (rides
     ``Series.isin``, which handles dictionary codes and null masking)."""
     return df.series(col).isin([value]).column.data
+
+
+def _dict_mask(col, values=None, pred=None) -> jnp.ndarray:
+    """[capacity] bool mask from a membership list or host predicate over
+    a dictionary column. Layout-agnostic: the dictionary is host-side and
+    shared by every shard, codes compare on device — so the same mask
+    builds on a local OR a mesh-distributed column (no gather)."""
+    vals = [] if col.dictionary is None else list(col.dictionary.values)
+    if pred is not None:
+        codes = [i for i, v in enumerate(vals) if pred(v)]
+    else:
+        lut = {v: i for i, v in enumerate(vals)}
+        codes = [lut[v] for v in values if v in lut]
+    probe = jnp.asarray(codes or [-1], jnp.int32)
+    m = (col.data[:, None] == probe[None, :]).any(axis=1)
+    if col.validity is not None:
+        m = m & col.validity
+    return m
 
 
 def _with_revenue(li: DataFrame) -> DataFrame:
@@ -357,12 +376,27 @@ def q14(data: Mapping, env=None, date_from: int | None = None,
     li = _with_revenue(li)[["l_partkey", "revenue"]]
     j = li.merge(part[["p_partkey", "p_type"]], left_on="l_partkey",
                  right_on="p_partkey", how="inner", env=env)
-    j = j._materialized()
-    promo = j.series("p_type").str_startswith("PROMO")
-    rev = j.series("revenue")
-    promo_rev = rev * promo.column.data.astype(rev.column.data.dtype)
-    total = float(rev.sum())
-    return 100.0 * float(promo_rev.sum()) / total if total else 0.0
+    # CASE folds into a masked-revenue column built in place on the
+    # (possibly distributed) joined table; both sums then reduce
+    # shard-local + psum (the q6 dist_aggregate pattern) — no gather
+    t = j.table
+    promo = _dict_mask(t.column("p_type"),
+                       pred=lambda v: v is not None
+                       and str(v).startswith("PROMO"))
+    rev = t.column("revenue")
+    sel = Column(jnp.where(promo, rev.data, jnp.zeros((), rev.data.dtype)),
+                 rev.validity, rev.dtype)
+    t2 = t.add_column("promo_rev", sel)
+    if env is not None:
+        from cylon_tpu.parallel import dist_aggregate
+
+        total = float(dist_aggregate(env, t2, "revenue", "sum"))
+        promo_sum = float(dist_aggregate(env, t2, "promo_rev", "sum"))
+    else:
+        df2 = DataFrame._wrap(t2)
+        total = float(df2.series("revenue").sum())
+        promo_sum = float(df2.series("promo_rev").sum())
+    return 100.0 * promo_sum / total if total else 0.0
 
 
 def q18(data: Mapping, env=None, threshold: int = 300,
@@ -434,18 +468,27 @@ def q19(data: Mapping, env=None,
     j = li.merge(part[["p_partkey", "p_brand", "p_container", "p_size"]],
                  left_on="l_partkey", right_on="p_partkey",
                  how="inner", env=env)
-    j = j._materialized()
 
-    qty = j.table.column("l_quantity").data
-    size = j.table.column("p_size").data
-    mask = jnp.zeros(j.table.capacity, bool)
+    # OR-branch mask builds directly on the (possibly distributed)
+    # joined table — dictionary probes are layout-agnostic — and the
+    # scalar reduces shard-local + psum (q6's dist_aggregate pattern)
+    t = j.table
+    qty = t.column("l_quantity").data
+    size = t.column("p_size").data
+    mask = jnp.zeros(t.capacity, bool)
     for brand, cont, q_lo, s_hi in zip(brands, containers, quantities,
                                        sizes):
-        branch = (j.series("p_brand").isin([brand]).column.data
-                  & j.series("p_container").isin(cont).column.data
+        branch = (_dict_mask(t.column("p_brand"), values=[brand])
+                  & _dict_mask(t.column("p_container"), values=list(cont))
                   & (qty >= q_lo) & (qty <= q_lo + 10)
                   & (size >= 1) & (size <= s_hi))
         mask = mask | branch
-    rev = j.series("revenue")
-    sel = rev * mask.astype(rev.column.data.dtype)
-    return float(sel.sum())
+    rev = t.column("revenue")
+    sel = Column(jnp.where(mask, rev.data, jnp.zeros((), rev.data.dtype)),
+                 rev.validity, rev.dtype)
+    t2 = t.add_column("sel_rev", sel)
+    if env is not None:
+        from cylon_tpu.parallel import dist_aggregate
+
+        return float(dist_aggregate(env, t2, "sel_rev", "sum"))
+    return float(DataFrame._wrap(t2).series("sel_rev").sum())

@@ -299,3 +299,29 @@ def test_frame_median_quantile(env8, rng):
     np.testing.assert_allclose(df.median()["x"], np.median(x), rtol=1e-12)
     np.testing.assert_allclose(df.quantile(0.3)["x"],
                                pd.Series(x).quantile(0.3), rtol=1e-12)
+
+
+def test_str_predicates():
+    s = Series(np.array(["PROMO X", "STANDARD Y", "ECONOMY Z", None],
+                        object), "t")
+    assert s.str_startswith("PROMO").to_numpy().tolist() == [
+        True, False, False, False]
+    assert s.str_endswith("Z").to_numpy().tolist() == [
+        False, False, True, False]
+    # regex default (pandas str.contains semantics)
+    assert s.str_contains("PROMO|ECONOMY").to_numpy().tolist() == [
+        True, False, True, False]
+    assert s.str_contains("PROMO|ECONOMY", regex=False).to_numpy().tolist() \
+        == [False, False, False, False]
+
+
+def test_unify_content_equal_dictionaries_no_remap():
+    from cylon_tpu.ops.dictenc import unify_dictionaries
+    from cylon_tpu import Table
+
+    a = Table.from_pydict({"s": ["x", "y", "x"]}).column("s")
+    b = Table.from_pydict({"s": ["y", "x", "y"]}).column("s")
+    assert a.dictionary is not b.dictionary
+    out = unify_dictionaries([a, b])
+    # content-equal dictionaries pass through without a device remap
+    assert out[0] is a and out[1] is b
