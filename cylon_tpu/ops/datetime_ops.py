@@ -1,0 +1,54 @@
+"""Device-side calendar decode for ordinal dates.
+
+Dates live on device as int32 days-since-epoch (the TPU-native
+representation — fixed-width, order-preserving; see ``tpch/dbgen.py``).
+TPC-H Q7/Q8/Q9 group by EXTRACT(YEAR ...), so the decode must run on
+device, vectorised, inside the same program as the groupby. This is the
+standard civil-from-days algorithm (Howard Hinnant's ``civil_from_days``,
+public domain): pure integer arithmetic — floor divisions and one
+select — which XLA maps straight onto the VPU; no table lookups, no
+host round trip.
+
+Reference parity note: the reference keeps dates as Arrow date32 and
+relies on Arrow compute for calendar ops (``arrow/arrow_types.cpp``);
+this is the TPU equivalent.
+"""
+
+import jax.numpy as jnp
+
+
+def civil_from_days(days):
+    """days-since-1970 -> (year, month, day), elementwise.
+
+    Exact for the proleptic Gregorian calendar over +/- ~5.8M years;
+    inputs may be any signed integer dtype (computed in int32).
+    """
+    z = days.astype(jnp.int32) + 719468
+    era = jnp.floor_divide(z, 146097)
+    doe = z - era * 146097                              # [0, 146096]
+    yoe = jnp.floor_divide(
+        doe - doe // 1460 + doe // 36524 - doe // 146096, 365)
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)     # [0, 365]
+    mp = (5 * doy + 2) // 153                           # [0, 11]
+    d = doy - (153 * mp + 2) // 5 + 1                   # [1, 31]
+    m = jnp.where(mp < 10, mp + 3, mp - 9)              # [1, 12]
+    return jnp.where(m <= 2, y + 1, y), m, d
+
+
+def year_of(days):
+    """EXTRACT(YEAR FROM date) for ordinal-int dates, elementwise."""
+    y, _, _ = civil_from_days(days)
+    return y
+
+
+def month_of(days):
+    """EXTRACT(MONTH FROM date) for ordinal-int dates, elementwise."""
+    _, m, _ = civil_from_days(days)
+    return m
+
+
+def day_of(days):
+    """EXTRACT(DAY FROM date) for ordinal-int dates, elementwise."""
+    _, _, d = civil_from_days(days)
+    return d

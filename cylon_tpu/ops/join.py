@@ -256,7 +256,9 @@ def _coalesce(a: Column, b: Column) -> Column:
     bv = jnp.ones(b.capacity, bool) if b.validity is None else b.validity
     data = jnp.where(av, a.data, b.data)
     validity = av | bv
-    if a.dtype.is_dictionary and a.dictionary is not b.dictionary:
+    # content equality, matching unify_dictionaries' pass-through for
+    # equal-content dictionaries (independently ingested same-value sets)
+    if a.dtype.is_dictionary and a.dictionary != b.dictionary:
         raise InvalidArgument("coalesce across different dictionaries")
     return Column(data, validity, a.dtype, a.dictionary)
 

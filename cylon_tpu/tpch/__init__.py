@@ -1,5 +1,4 @@
-"""TPC-H workload: dbgen-style generator + ten query pipelines
-(Q1, Q3, Q4, Q5, Q6, Q10, Q12, Q14, Q18, Q19).
+"""TPC-H workload: dbgen-style generator + the full 22-query suite.
 
 BASELINE.json config 5 ("TPC-H SF100 Q3/Q5 multi-way join + groupby
 pipeline") names TPC-H as a headline benchmark of the rebuild; the
@@ -11,8 +10,9 @@ locally or distributed over the mesh (``env=``).
 """
 
 from cylon_tpu.tpch.dbgen import date_int, generate, generate_pandas
-from cylon_tpu.tpch.queries import (q1, q3, q4, q5, q6, q10, q12,
-                                    q14, q18, q19)
+from cylon_tpu.tpch.queries import (q1, q2, q3, q4, q5, q6, q7, q8, q9,
+                                    q10, q11, q12, q13, q14, q15, q16,
+                                    q17, q18, q19, q20, q21, q22)
 
-__all__ = ["generate", "generate_pandas", "date_int", "q1", "q3",
-           "q4", "q5", "q6", "q10", "q12", "q14", "q18", "q19"]
+__all__ = ["generate", "generate_pandas", "date_int"] + [
+    f"q{i}" for i in range(1, 23)]

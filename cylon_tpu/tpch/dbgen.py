@@ -51,6 +51,38 @@ TYPE_S2 = ["ANODIZED", "BURNISHED", "PLATED", "POLISHED", "BRUSHED"]
 TYPE_S3 = ["TIN", "NICKEL", "BRASS", "STEEL", "COPPER"]
 CONTAINER_S1 = ["SM", "LG", "MED", "JUMBO", "WRAP"]
 CONTAINER_S2 = ["CASE", "BOX", "BAG", "JAR", "PKG", "PACK", "CAN", "DRUM"]
+# p_name = concatenation of color words (spec 4.2.3: 5 of 92 colors;
+# a 2-word draw keeps cardinality useful at small sf)
+COLORS = ["almond", "antique", "aquamarine", "azure", "beige", "bisque",
+          "black", "blanched", "blue", "blush", "brown", "burlywood",
+          "chartreuse", "chiffon", "chocolate", "coral", "cornflower",
+          "cream", "cyan", "dark", "deep", "dim", "dodger", "drab",
+          "firebrick", "floral", "forest", "frosted", "gainsboro",
+          "ghost", "goldenrod", "green", "grey", "honeydew", "hot",
+          "indian", "ivory", "khaki", "lace", "lavender", "lawn",
+          "lemon", "light", "lime", "linen", "magenta", "maroon",
+          "medium", "midnight", "mint", "misty", "moccasin", "navajo",
+          "navy", "olive", "orange", "orchid", "pale", "papaya", "peach",
+          "peru", "pink", "plum", "powder", "puff", "purple", "red",
+          "rose", "rosy", "royal", "saddle", "salmon", "sandy",
+          "seashell", "sienna", "sky", "slate", "smoke", "snow",
+          "spring", "steel", "tan", "thistle", "tomato", "turquoise",
+          "violet", "wheat", "white", "yellow"]
+# comment templates: mostly anodyne, a spec-relevant fraction carrying
+# the phrases Q13/Q16 filter on
+ORDER_COMMENTS = (["quickly final deposits nag", "furiously even asymptotes",
+                   "carefully ironic pinto beans wake", "slyly regular ideas",
+                   "pending packages haggle blithely",
+                   "express foxes boost above the theodolites",
+                   "bold accounts cajole", "dogged warhorses sleep"]
+                  + ["special packages wake. requests integrate",
+                     "silent special pearls. requests detect furiously"])
+SUPP_COMMENTS = (["blithely ironic packages sleep", "regular requests haggle",
+                  "carefully final accounts nod", "quiet excuses boost",
+                  "daring deposits detect slyly", "even theodolites engage",
+                  "ruthless ideas use fluffily"]
+                 + ["Customer insults wake slyly. Complaints nag",
+                    "Customer accounts breach furious Complaints"])
 
 
 def date_int(year: int, month: int, day: int) -> int:
@@ -84,16 +116,27 @@ def generate(sf: float = 0.01, seed: int = 0) -> Mapping[str, dict]:
         "n_name": np.array([n for n, _ in NATIONS], dtype=object),
         "n_regionkey": np.array([r for _, r in NATIONS], dtype=np.int64),
     }
+    c_nationkey = rng.integers(0, len(NATIONS), n_cust).astype(np.int64)
+    # spec 4.2.2.9: phone country code = nationkey + 10; Q22 slices it
+    phone_tail = rng.integers(0, 10_000_000, n_cust)
     customer = {
         "c_custkey": np.arange(1, n_cust + 1, dtype=np.int64),
-        "c_nationkey": rng.integers(0, len(NATIONS), n_cust).astype(np.int64),
+        "c_nationkey": c_nationkey,
         "c_mktsegment": SEGMENTS[rng.integers(0, len(SEGMENTS), n_cust)],
         "c_acctbal": np.round(rng.uniform(-999.99, 9999.99, n_cust), 2),
+        "c_phone": np.array(
+            [f"{nk + 10}-{t % 1000:03d}-{(t // 1000) % 1000:03d}-"
+             f"{t // 1_000_000:04d}"
+             for nk, t in zip(c_nationkey, phone_tail)], dtype=object),
     }
     supplier = {
         "s_suppkey": np.arange(1, n_supp + 1, dtype=np.int64),
+        "s_name": np.array([f"Supplier#{i:09d}" for i in
+                            range(1, n_supp + 1)], dtype=object),
         "s_nationkey": rng.integers(0, len(NATIONS), n_supp).astype(np.int64),
         "s_acctbal": np.round(rng.uniform(-999.99, 9999.99, n_supp), 2),
+        "s_comment": np.array(SUPP_COMMENTS, dtype=object)[
+            rng.integers(0, len(SUPP_COMMENTS), n_supp)],
     }
     p_type = np.array(
         [f"{a} {b} {c}" for a in TYPE_S1 for b in TYPE_S2 for c in TYPE_S3],
@@ -103,8 +146,15 @@ def generate(sf: float = 0.01, seed: int = 0) -> Mapping[str, dict]:
         dtype=object)
     brands = np.array([f"Brand#{m}{n}" for m in range(1, 6)
                        for n in range(1, 6)], dtype=object)
+    colors = np.array(COLORS, dtype=object)
+    name_a = colors[rng.integers(0, len(colors), n_part)]
+    name_b = colors[rng.integers(0, len(colors), n_part)]
     part = {
         "p_partkey": np.arange(1, n_part + 1, dtype=np.int64),
+        "p_name": np.array([f"{a} {b}" for a, b in zip(name_a, name_b)],
+                           dtype=object),
+        "p_mfgr": np.array([f"Manufacturer#{m}" for m in
+                            rng.integers(1, 6, n_part)], dtype=object),
         "p_brand": brands[rng.integers(0, len(brands), n_part)],
         "p_type": p_type[rng.integers(0, len(p_type), n_part)],
         "p_size": rng.integers(1, 51, n_part).astype(np.int64),
@@ -128,14 +178,24 @@ def generate(sf: float = 0.01, seed: int = 0) -> Mapping[str, dict]:
         "ps_supplycost": np.round(rng.uniform(1.0, 1000.0, n_ps), 2),
     }
     o_orderdate = rng.integers(_START, _END + 1, n_ord).astype(np.int32)
+    # spec: status F when every lineitem shipped (old orders), O when
+    # none (recent), P in between — date-driven like real dbgen
+    cut_f = date_int(1995, 6, 1)
+    cut_o = date_int(1995, 6, 30)
+    o_orderstatus = np.where(o_orderdate < cut_f, "F",
+                             np.where(o_orderdate > cut_o, "O", "P")
+                             ).astype(object)
     orders = {
         "o_orderkey": np.arange(1, n_ord + 1, dtype=np.int64),
         "o_custkey": rng.integers(1, n_cust + 1, n_ord).astype(np.int64),
+        "o_orderstatus": o_orderstatus,
         "o_orderdate": o_orderdate,
         "o_orderpriority": PRIORITIES[rng.integers(0, len(PRIORITIES),
                                                    n_ord)],
         "o_shippriority": np.zeros(n_ord, dtype=np.int64),
         "o_totalprice": np.round(rng.uniform(800.0, 500_000.0, n_ord), 2),
+        "o_comment": np.array(ORDER_COMMENTS, dtype=object)[
+            rng.integers(0, len(ORDER_COMMENTS), n_ord)],
     }
     # 1..7 lineitems per order (TPC-H mean 4)
     per_order = rng.integers(1, 8, n_ord)

@@ -362,3 +362,20 @@ def test_f64_bits_matches_bitcast(rng):
     got = np.asarray(f64_bits(subs))
     np.testing.assert_array_equal(
         got, np.array([0, 1 << 63, 0, 1 << 63], np.uint64))
+
+
+def test_fullouter_join_content_equal_dictionaries():
+    """Independently ingested tables over the same string value set have
+    content-equal but distinct Dictionary objects; outer-join key
+    coalescing must accept them (content equality, not identity)."""
+    from cylon_tpu import Table
+    from cylon_tpu.ops.join import join
+
+    a = Table.from_pydict({"k": ["x", "y"], "v": [1, 2]})
+    b = Table.from_pydict({"k": ["y", "x"], "w": [3, 4]})
+    d1, d2 = a.column("k").dictionary, b.column("k").dictionary
+    assert d1 is not d2 and d1 == d2  # the content-equal pass-through
+    out = join(a, b, on="k", how="fullouter", out_capacity=8).to_pandas()
+    got = out.sort_values("k").reset_index(drop=True)
+    assert got["k"].tolist() == ["x", "y"]
+    assert got["v"].tolist() == [1, 2] and got["w"].tolist() == [4, 3]

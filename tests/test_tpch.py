@@ -403,3 +403,370 @@ def test_partsupp_primary_key(data):
 def test_q19_branch_length_validation(data):
     with pytest.raises(Exception):
         q19(data, brands=("Brand#12", "Brand#23"), quantities=(1, 10, 20))
+
+
+# ---- Q7 / Q8 / Q9 / Q11 ---------------------------------------------------
+
+def q7_pandas(pdfs, nation1="FRANCE", nation2="GERMANY"):
+    d0, d1 = date_int(1995, 1, 1), date_int(1996, 12, 31)
+    s, l, o, c, n = (pdfs["supplier"], pdfs["lineitem"], pdfs["orders"],
+                     pdfs["customer"], pdfs["nation"])
+    l = l[(l.l_shipdate >= d0) & (l.l_shipdate <= d1)].copy()
+    l["revenue"] = l.l_extendedprice * (1 - l.l_discount)
+    import datetime
+    epoch = datetime.date(1970, 1, 1).toordinal()
+    l["l_year"] = [datetime.date.fromordinal(int(x) + epoch).year
+                   for x in l.l_shipdate]
+    j = (l.merge(o, left_on="l_orderkey", right_on="o_orderkey")
+          .merge(c, left_on="o_custkey", right_on="c_custkey")
+          .merge(n.rename(columns={"n_name": "cust_nation",
+                                   "n_nationkey": "c_nk"}),
+                 left_on="c_nationkey", right_on="c_nk")
+          .merge(s, left_on="l_suppkey", right_on="s_suppkey")
+          .merge(n.rename(columns={"n_name": "supp_nation",
+                                   "n_nationkey": "s_nk"}),
+                 left_on="s_nationkey", right_on="s_nk"))
+    j = j[((j.supp_nation == nation1) & (j.cust_nation == nation2))
+          | ((j.supp_nation == nation2) & (j.cust_nation == nation1))]
+    g = (j.groupby(["supp_nation", "cust_nation", "l_year"],
+                   as_index=False)["revenue"].sum())
+    return g.sort_values(["supp_nation", "cust_nation",
+                          "l_year"]).reset_index(drop=True)
+
+
+def q8_pandas(pdfs, nation="BRAZIL", region="AMERICA",
+              ptype="ECONOMY ANODIZED STEEL"):
+    import datetime
+    epoch = datetime.date(1970, 1, 1).toordinal()
+    p, s, l, o, c, n, r = (pdfs["part"], pdfs["supplier"],
+                           pdfs["lineitem"], pdfs["orders"],
+                           pdfs["customer"], pdfs["nation"],
+                           pdfs["region"])
+    p = p[p.p_type == ptype]
+    o = o[(o.o_orderdate >= date_int(1995, 1, 1))
+          & (o.o_orderdate <= date_int(1996, 12, 31))].copy()
+    o["o_year"] = [datetime.date.fromordinal(int(x) + epoch).year
+                   for x in o.o_orderdate]
+    r = r[r.r_name == region]
+    n1 = n.merge(r, left_on="n_regionkey", right_on="r_regionkey")
+    c = c[c.c_nationkey.isin(n1.n_nationkey)]
+    l = l.copy()
+    l["revenue"] = l.l_extendedprice * (1 - l.l_discount)
+    j = (l.merge(p, left_on="l_partkey", right_on="p_partkey")
+          .merge(o, left_on="l_orderkey", right_on="o_orderkey")
+          .merge(c, left_on="o_custkey", right_on="c_custkey")
+          .merge(s, left_on="l_suppkey", right_on="s_suppkey")
+          .merge(n.rename(columns={"n_name": "supp_nation",
+                                   "n_nationkey": "s_nk"}),
+                 left_on="s_nationkey", right_on="s_nk"))
+    j["nation_rev"] = np.where(j.supp_nation == nation, j.revenue, 0.0)
+    g = j.groupby("o_year", as_index=False)[["revenue", "nation_rev"]].sum()
+    g["mkt_share"] = g.nation_rev / g.revenue
+    return g.sort_values("o_year")[["o_year", "mkt_share"]].reset_index(
+        drop=True)
+
+
+def q9_pandas(pdfs, color="green"):
+    import datetime
+    epoch = datetime.date(1970, 1, 1).toordinal()
+    p, s, l, ps, o, n = (pdfs["part"], pdfs["supplier"], pdfs["lineitem"],
+                         pdfs["partsupp"], pdfs["orders"], pdfs["nation"])
+    p = p[p.p_name.str.contains(color)]
+    o = o.copy()
+    o["o_year"] = [datetime.date.fromordinal(int(x) + epoch).year
+                   for x in o.o_orderdate]
+    j = (l.merge(p, left_on="l_partkey", right_on="p_partkey")
+          .merge(ps, left_on=["l_partkey", "l_suppkey"],
+                 right_on=["ps_partkey", "ps_suppkey"])
+          .merge(o, left_on="l_orderkey", right_on="o_orderkey")
+          .merge(s, left_on="l_suppkey", right_on="s_suppkey")
+          .merge(n.rename(columns={"n_name": "nation"}),
+                 left_on="s_nationkey", right_on="n_nationkey"))
+    j["profit"] = (j.l_extendedprice * (1 - j.l_discount)
+                   - j.ps_supplycost * j.l_quantity)
+    g = j.groupby(["nation", "o_year"], as_index=False)["profit"].sum()
+    return g.sort_values(["nation", "o_year"],
+                         ascending=[True, False]).reset_index(drop=True)
+
+
+def q11_pandas(pdfs, nation="GERMANY", fraction=0.0001):
+    ps, s, n = pdfs["partsupp"], pdfs["supplier"], pdfs["nation"]
+    n = n[n.n_name == nation]
+    j = (ps.merge(s, left_on="ps_suppkey", right_on="s_suppkey")
+           .merge(n, left_on="s_nationkey", right_on="n_nationkey")).copy()
+    j["value"] = j.ps_supplycost * j.ps_availqty
+    g = j.groupby("ps_partkey", as_index=False)["value"].sum()
+    total = g.value.sum()
+    g = g[g.value > fraction * total]
+    return g.sort_values("value", ascending=False).reset_index(drop=True)
+
+
+from cylon_tpu.tpch.queries import q7, q8, q9, q11  # noqa: E402
+
+
+def test_q7(data, pdfs, env4):
+    want = q7_pandas(pdfs)
+    assert len(want) > 0
+    _frame_close(q7(data).to_pandas(), want, {"revenue"})
+    _frame_close(q7(data, env=env4).to_pandas(), want, {"revenue"})
+
+
+def test_q8(data, pdfs, env4):
+    # tiny sf: the spec's single part type may select zero parts; use
+    # the most frequent generated type so the share is well-defined
+    ptype = pdfs["part"].p_type.mode()[0]
+    want = q8_pandas(pdfs, ptype=ptype)
+    assert len(want) > 0
+    _frame_close(q8(data, ptype=ptype).to_pandas(), want, {"mkt_share"})
+    _frame_close(q8(data, env=env4, ptype=ptype).to_pandas(), want,
+                 {"mkt_share"})
+
+
+def test_q9(data, pdfs, env4):
+    want = q9_pandas(pdfs)
+    assert len(want) > 0
+    _frame_close(q9(data).to_pandas(), want, {"profit"})
+    _frame_close(q9(data, env=env4).to_pandas(), want, {"profit"})
+
+
+def test_q11(data, pdfs, env4):
+    want = q11_pandas(pdfs, fraction=0.001)
+    assert len(want) > 0
+    got = q11(data, fraction=0.001).to_pandas()
+    got_d = q11(data, env=env4, fraction=0.001).to_pandas()
+    # ties in value may permute partkeys; compare sorted by (value, key)
+    for g in (got, got_d):
+        assert len(g) == len(want)
+        np.testing.assert_allclose(
+            np.sort(g.value.to_numpy()), np.sort(want.value.to_numpy()),
+            rtol=1e-9)
+        assert sorted(g.ps_partkey.tolist()) == sorted(
+            want.ps_partkey.tolist())
+
+
+# ---- Q2 / Q13 / Q15 / Q16 / Q17 / Q20 / Q21 / Q22 -------------------------
+
+def q2_pandas(pdfs, size=15, type_suffix="BRASS", region="EUROPE",
+              limit=100):
+    p, s, ps, n, r = (pdfs["part"], pdfs["supplier"], pdfs["partsupp"],
+                      pdfs["nation"], pdfs["region"])
+    r = r[r.r_name == region]
+    n = n.merge(r, left_on="n_regionkey", right_on="r_regionkey")
+    s = s.merge(n, left_on="s_nationkey", right_on="n_nationkey")
+    p = p[(p.p_size == size) & p.p_type.str.endswith(type_suffix)]
+    j = ps.merge(s, left_on="ps_suppkey", right_on="s_suppkey").merge(
+        p, left_on="ps_partkey", right_on="p_partkey")
+    mn = j.groupby("ps_partkey")["ps_supplycost"].transform("min")
+    j = j[j.ps_supplycost == mn]
+    j = j.sort_values(["s_acctbal", "n_name", "s_name", "ps_partkey"],
+                      ascending=[False, True, True, True]).head(limit)
+    return j[["s_acctbal", "s_name", "n_name", "ps_partkey",
+              "p_mfgr"]].reset_index(drop=True)
+
+
+def q13_pandas(pdfs, word1="special", word2="requests"):
+    c, o = pdfs["customer"], pdfs["orders"]
+    import re
+    pat = re.compile(f".*{word1}.*{word2}.*")
+    o = o[~o.o_comment.str.match(pat)]
+    j = c[["c_custkey"]].merge(o, left_on="c_custkey",
+                               right_on="o_custkey", how="left")
+    g = j.groupby("c_custkey", as_index=False).agg(
+        c_count=("o_orderkey", "count"))
+    g2 = g.groupby("c_count", as_index=False).agg(
+        custdist=("c_custkey", "count"))
+    return g2.sort_values(["custdist", "c_count"],
+                          ascending=[False, False]).reset_index(drop=True)
+
+
+def q15_pandas(pdfs):
+    s, l = pdfs["supplier"], pdfs["lineitem"]
+    d0, d1 = date_int(1996, 1, 1), date_int(1996, 4, 1)
+    l = l[(l.l_shipdate >= d0) & (l.l_shipdate < d1)].copy()
+    l["revenue"] = l.l_extendedprice * (1 - l.l_discount)
+    g = l.groupby("l_suppkey", as_index=False).agg(
+        total_revenue=("revenue", "sum"))
+    g = g[g.total_revenue >= g.total_revenue.max()]
+    out = g.merge(s, left_on="l_suppkey", right_on="s_suppkey")
+    return out.sort_values("s_suppkey")[
+        ["s_suppkey", "s_name", "total_revenue"]].reset_index(drop=True)
+
+
+def q16_pandas(pdfs, brand="Brand#45", type_prefix="MEDIUM POLISHED",
+               sizes=(49, 14, 23, 45, 19, 3, 36, 9)):
+    import re
+    p, ps, s = pdfs["part"], pdfs["partsupp"], pdfs["supplier"]
+    bad = s[s.s_comment.str.match(re.compile(".*Customer.*Complaints.*"))]
+    p = p[(p.p_brand != brand) & ~p.p_type.str.startswith(type_prefix)
+          & p.p_size.isin(sizes)]
+    j = ps.merge(p, left_on="ps_partkey", right_on="p_partkey")
+    j = j[~j.ps_suppkey.isin(bad.s_suppkey)]
+    g = j.groupby(["p_brand", "p_type", "p_size"], as_index=False).agg(
+        supplier_cnt=("ps_suppkey", "nunique"))
+    return g.sort_values(["supplier_cnt", "p_brand", "p_type", "p_size"],
+                         ascending=[False, True, True, True]).reset_index(
+        drop=True)
+
+
+def q17_pandas(pdfs, brand="Brand#23", container="MED BOX"):
+    p, l = pdfs["part"], pdfs["lineitem"]
+    p = p[(p.p_brand == brand) & (p.p_container == container)]
+    j = l.merge(p, left_on="l_partkey", right_on="p_partkey")
+    avg = j.groupby("l_partkey")["l_quantity"].transform("mean")
+    return float(j[j.l_quantity < 0.2 * avg].l_extendedprice.sum()) / 7.0
+
+
+def q20_pandas(pdfs, color="forest", nation="CANADA"):
+    p, ps, l, s, n = (pdfs["part"], pdfs["partsupp"], pdfs["lineitem"],
+                      pdfs["supplier"], pdfs["nation"])
+    d0, d1 = date_int(1994, 1, 1), date_int(1995, 1, 1)
+    p = p[p.p_name.str.startswith(color)]
+    l = l[(l.l_shipdate >= d0) & (l.l_shipdate < d1)]
+    g = l.groupby(["l_partkey", "l_suppkey"], as_index=False).agg(
+        qty_sum=("l_quantity", "sum"))
+    j = (ps.merge(p, left_on="ps_partkey", right_on="p_partkey")
+           .merge(g, left_on=["ps_partkey", "ps_suppkey"],
+                  right_on=["l_partkey", "l_suppkey"]))
+    j = j[j.ps_availqty > 0.5 * j.qty_sum]
+    n = n[n.n_name == nation]
+    sup = s.merge(n, left_on="s_nationkey", right_on="n_nationkey")
+    out = sup[sup.s_suppkey.isin(j.ps_suppkey.unique())]
+    return out.sort_values("s_name")[["s_name"]].reset_index(drop=True)
+
+
+def q21_pandas(pdfs, nation="SAUDI ARABIA", limit=100):
+    s, l, o, n = (pdfs["supplier"], pdfs["lineitem"], pdfs["orders"],
+                  pdfs["nation"])
+    late = l[l.l_receiptdate > l.l_commitdate]
+    pairs = l[["l_orderkey", "l_suppkey"]].drop_duplicates()
+    nsupp = pairs.groupby("l_orderkey").size().rename("nsupp")
+    lpairs = late[["l_orderkey", "l_suppkey"]].drop_duplicates()
+    nlate = lpairs.groupby("l_orderkey").size().rename("nlate")
+    of = o[o.o_orderstatus == "F"][["o_orderkey"]]
+    # spec COUNT(*): qualifying late ROWS, not deduped pairs
+    j = (late[["l_orderkey", "l_suppkey"]]
+         .merge(of, left_on="l_orderkey", right_on="o_orderkey")
+         .join(nsupp, on="l_orderkey").join(nlate, on="l_orderkey"))
+    j = j[(j.nsupp >= 2) & (j.nlate == 1)]
+    n = n[n.n_name == nation]
+    sup = s.merge(n, left_on="s_nationkey", right_on="n_nationkey")
+    j = j.merge(sup, left_on="l_suppkey", right_on="s_suppkey")
+    g = j.groupby("s_name", as_index=False).agg(
+        numwait=("l_orderkey", "count"))
+    return g.sort_values(["numwait", "s_name"],
+                         ascending=[False, True]).head(limit).reset_index(
+        drop=True)
+
+
+def q22_pandas(pdfs, codes=("13", "31", "23", "29", "30", "18", "17")):
+    c, o = pdfs["customer"], pdfs["orders"]
+    c = c.copy()
+    c["cntrycode"] = c.c_phone.str[:2]
+    c = c[c.cntrycode.isin(codes)]
+    avg = c[c.c_acctbal > 0.0].c_acctbal.mean()
+    cand = c[c.c_acctbal > avg]
+    cand = cand[~cand.c_custkey.isin(o.o_custkey.unique())]
+    g = cand.groupby("cntrycode", as_index=False).agg(
+        numcust=("c_custkey", "count"), totacctbal=("c_acctbal", "sum"))
+    return g.sort_values("cntrycode").reset_index(drop=True)
+
+
+from cylon_tpu.tpch.queries import (  # noqa: E402
+    q2, q13, q15, q16, q17, q20, q21, q22)
+
+
+def test_q2(data, pdfs, env4):
+    # tiny sf: widen the size/type filter so rows survive
+    want = q2_pandas(pdfs, size=int(pdfs["part"].p_size.iloc[0]),
+                     type_suffix="")
+    assert len(want) > 0
+    got = q2(data, size=int(pdfs["part"].p_size.iloc[0]),
+             type_suffix="").to_pandas()
+    got_d = q2(data, env=env4, size=int(pdfs["part"].p_size.iloc[0]),
+               type_suffix="").to_pandas()
+    _frame_close(got, want, {"s_acctbal"})
+    _frame_close(got_d, want, {"s_acctbal"})
+
+
+def test_q13(data, pdfs, env4):
+    want = q13_pandas(pdfs)
+    assert len(want) > 1
+    _frame_close(q13(data).to_pandas(), want, set())
+    _frame_close(q13(data, env=env4).to_pandas(), want, set())
+
+
+def test_q15(data, pdfs, env4):
+    want = q15_pandas(pdfs)
+    assert len(want) > 0
+    _frame_close(q15(data).to_pandas(), want, {"total_revenue"})
+    _frame_close(q15(data, env=env4).to_pandas(), want,
+                 {"total_revenue"})
+
+
+def test_q16(data, pdfs, env4):
+    sizes = tuple(int(x) for x in
+                  pdfs["part"].p_size.drop_duplicates().head(8))
+    want = q16_pandas(pdfs, sizes=sizes)
+    assert len(want) > 0
+
+    def _norm(df):
+        return df.sort_values(
+            ["supplier_cnt", "p_brand", "p_type", "p_size"],
+            ascending=[False, True, True, True]).reset_index(drop=True)
+
+    for got in (q16(data, sizes=sizes).to_pandas(),
+                q16(data, env=env4, sizes=sizes).to_pandas()):
+        got = _norm(got)
+        w = _norm(want)
+        assert got.supplier_cnt.tolist() == w.supplier_cnt.tolist()
+        # ties among equal counts may permute; compare as row sets
+        assert (set(map(tuple, got.itertuples(index=False)))
+                == set(map(tuple, w.itertuples(index=False))))
+
+
+def test_q17(data, pdfs, env4):
+    brand = pdfs["part"].p_brand.mode()[0]
+    container = pdfs["part"].p_container.iloc[0]
+    want = q17_pandas(pdfs, brand=brand, container=container)
+    np.testing.assert_allclose(
+        q17(data, brand=brand, container=container), want, rtol=1e-9)
+    np.testing.assert_allclose(
+        q17(data, env=env4, brand=brand, container=container), want,
+        rtol=1e-9)
+
+
+def test_q20(data, pdfs, env4):
+    # tiny sf: any color prefix keeps rows; use the generated mode
+    color = pdfs["part"].p_name.str.split().str[0].mode()[0]
+    want = q20_pandas(pdfs, color=color)
+    _frame_close(q20(data, color=color).to_pandas(), want, set())
+    _frame_close(q20(data, env=env4, color=color).to_pandas(), want,
+                 set())
+
+
+def test_q21(data, pdfs, env4):
+    # tiny sf: pick the modal supplier nation so the filter keeps rows
+    nk = pdfs["supplier"].s_nationkey.mode()[0]
+    nat = pdfs["nation"].set_index("n_nationkey").n_name[nk]
+    want = q21_pandas(pdfs, nation=nat)
+    assert len(want) > 0
+    _frame_close(q21(data, nation=nat).to_pandas(), want, set())
+    _frame_close(q21(data, env=env4, nation=nat).to_pandas(), want,
+                 set())
+
+
+def test_q22(data, pdfs, env4):
+    # tiny sf: every customer has orders, so the anti-join is empty —
+    # trim orders to 5% so idle customers exist
+    n_keep = max(len(pdfs["orders"]) // 20, 1)
+    pdfs2 = dict(pdfs)
+    pdfs2["orders"] = pdfs["orders"].head(n_keep)
+    data2 = dict(data)
+    data2["orders"] = {k: v[:n_keep] for k, v in data["orders"].items()}
+    codes = tuple(sorted(pdfs["customer"].c_phone.str[:2].unique()))
+    want = q22_pandas(pdfs2, codes=codes)
+    assert len(want) > 0
+    _frame_close(q22(data2, codes=codes).to_pandas(), want,
+                 {"totacctbal"})
+    _frame_close(q22(data2, env=env4, codes=codes).to_pandas(), want,
+                 {"totacctbal"})
