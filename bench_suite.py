@@ -43,14 +43,14 @@ def _emit(metric, value, unit, baseline=None):
 def main():
     import jax
 
-    # persistent compile cache: the package already points jax at
+    # persistent compile cache: the package points jax at
     # ~/.cache/cylon_tpu/xla on import (shared with every other run);
-    # CYLON_COMPILE_CACHE overrides for an isolated cache
+    # CYLON_COMPILE_CACHE reroutes it for an isolated cache — it must be
+    # mapped onto the package knob BEFORE the import, which would
+    # otherwise override it
     cache = os.environ.get("CYLON_COMPILE_CACHE")
     if cache:
-        jax.config.update("jax_compilation_cache_dir", cache)
-        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        os.environ["CYLON_TPU_CACHE_DIR"] = cache
 
     import cylon_tpu as ct
     from cylon_tpu import Table

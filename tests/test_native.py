@@ -277,3 +277,36 @@ def test_catalog_day_unit_timestamp(native_catalog):
     assert t2.column("d").dtype == t.column("d").dtype
     got = t2.to_pandas()["d"]
     assert str(got.iloc[1])[:10] == "2026-07-30"
+
+
+def test_header_matches_abi():
+    """cylon_host.h must declare exactly the extern-C surface of
+    cylon_host.cpp (an external binder compiles against the header)."""
+    import re
+    from pathlib import Path
+
+    base = Path(__file__).resolve().parent.parent / "cylon_tpu" / "native"
+
+    def sigs(text):
+        out = {}
+        for m in re.finditer(
+                r"(?:^|\n)\s*((?:const\s+)?[\w*]+\**)\s+(cylon_\w+)"
+                r"\s*\(([^)]*)\)", text):
+            args = re.sub(r"\s+", " ", m.group(3)).strip()
+            parts = []
+            for a in args.split(","):
+                a = a.strip()
+                if not a or a == "void":
+                    continue
+                toks = a.split(" ")
+                if len(toks) > 1 and not toks[-1].startswith("*"):
+                    a = " ".join(toks[:-1]) + "*" * toks[-1].count("*")
+                parts.append(a.replace(" *", "*").replace("* ", "*"))
+            out[m.group(2)] = (m.group(1), tuple(parts))
+        return out
+
+    cpp = sigs((base / "cylon_host.cpp").read_text())
+    hdr = sigs((base / "cylon_host.h").read_text())
+    assert cpp, "no extern-C symbols found in cpp"
+    mismatched = {n for n in set(cpp) | set(hdr) if cpp.get(n) != hdr.get(n)}
+    assert not mismatched, mismatched
