@@ -37,14 +37,16 @@ AGG_OPS = ("sum", "count", "size", "min", "max", "mean", "var", "std",
 
 def _segment_sum(vals, gid, num_segments: int):
     """f32 sums ride the MXU one-hot Pallas kernel on TPU (scatter-add
-    is the slow path there); everything else stays on XLA's lowering."""
+    is the slow path there); everything else stays on XLA's lowering.
+    Callers pass GROUP-SORTED gid (monotone), hence the sorted flag."""
     from cylon_tpu.ops import pallas_kernels
 
     if (vals.dtype == jnp.float32
             and pallas_kernels.segment_sum_ok(num_segments)
             and pallas_kernels.usable_for(vals)):
         return pallas_kernels.segment_sum(vals, gid, num_segments)
-    return jax.ops.segment_sum(vals, gid, num_segments=num_segments)
+    return jax.ops.segment_sum(vals, gid, num_segments=num_segments,
+                               indices_are_sorted=True)
 
 
 def groupby_aggregate(table: Table, by: Sequence[str],
@@ -72,99 +74,151 @@ def _groupby_compiled(table: Table, *, by, aggs, out_cap,
     cap = table.capacity
     keys = [table.column(n).data for n in by]
     kvals = [table.column(n).validity for n in by]
-    gid, num_groups, _ = kernels.dense_group_ids(keys, table.nrows, kvals)
 
+    # aggregate on the GROUP-SORTED layout, with the value columns
+    # carried through the ONE sort as payload operands (random gathers
+    # are ~10x the sort's own cost at 10M rows on TPU — see
+    # kernels.group_sort). Monotone segment ids then let every
+    # reduction run with indices_are_sorted=True. The stable sort
+    # preserves original order within each group (pandas first/last).
+    src_names = []
+    for spec in aggs:
+        src = spec[0]
+        if src not in src_names:
+            src_names.append(src)
     iota = jnp.arange(cap, dtype=jnp.int32)
+    payloads = [iota]                       # original row index
+    slots = {}
+    for sname in src_names:
+        c = table.column(sname)
+        if c.data.ndim == 1:
+            slots[sname] = ("payload", len(payloads))
+            payloads.append(c.data)
+        else:                               # rare: gather after the sort
+            slots[sname] = ("gather", None)
+        if c.validity is not None:
+            slots[sname + "\0v"] = ("payload", len(payloads))
+            payloads.append(c.validity)
+
+    gid_s, num_groups, sorted_pl = kernels.group_sort(
+        keys, table.nrows, kvals, payloads)
+    orig_idx = sorted_pl[0]
+
+    def sorted_column(sname) -> Column:
+        c = table.column(sname)
+        kind, slot = slots[sname]
+        data = (sorted_pl[slot] if kind == "payload"
+                else c.data[orig_idx])
+        vslot = slots.get(sname + "\0v")
+        validity = sorted_pl[vslot[1]] if vslot is not None else None
+        return Column(data, validity, c.dtype, c.dictionary)
+
     big = jnp.int32(cap)
-    first_idx = jax.ops.segment_min(jnp.where(gid < big, iota, big), gid,
-                                    num_segments=out_cap)
-    first_idx = jnp.clip(first_idx, 0, max(cap - 1, 0))
+    first_pos = jax.ops.segment_min(jnp.where(gid_s < big, iota, big),
+                                    gid_s, num_segments=out_cap,
+                                    indices_are_sorted=True)
+    first_pos = jnp.clip(first_pos, 0, max(cap - 1, 0))
 
     out = {}
-    keytab = take_columns(table, first_idx, num_groups, names=list(by))
+    # key values: one tiny gather of the group-leader rows from the
+    # ORIGINAL table (out_cap rows, not cap)
+    first_orig = orig_idx[first_pos]
+    keytab = take_columns(table, first_orig, num_groups, names=list(by))
     for n in by:
         out[n] = keytab.column(n)
 
+    stab = Table({s: sorted_column(s) for s in src_names}, table.nrows)
     for spec in aggs:
         src, op, name = spec if len(spec) == 3 else (*spec, None)
         name = name or f"{src}_{op}"
         if op not in AGG_OPS:
             raise InvalidArgument(f"unknown aggregation {op!r}")
-        out[name] = _aggregate_column(table, src, op, gid, num_groups,
+        out[name] = _aggregate_column(stab, src, op, gid_s, num_groups,
                                       out_cap, quantile)
     return Table(out, num_groups)
 
 
 def _aggregate_column(table: Table, src: str, op: str, gid, num_groups,
                       out_cap: int, q: float) -> Column:
+    """Reduce one column. ``table``/``gid`` are in GROUP-SORTED layout
+    (monotone segment ids, padding rows last with id == capacity), so
+    every segment reduction runs with ``indices_are_sorted=True``.
+    Missing values are masked out of the VALUES (zero / sentinel), never
+    the indices — sentinel ids would break monotonicity."""
     c = table.column(src)
     cap = table.capacity
     vmask = kernels.valid_mask(cap, table.nrows)
     nulls = _null_flags(c)
     value_ok = vmask if nulls is None else (vmask & (nulls == 0))
-    # rows with missing values drop out of the reduction entirely
-    gid_v = jnp.where(value_ok, gid, out_cap)
     gslot = jnp.arange(out_cap, dtype=jnp.int32)
     gvalid = gslot < num_groups
 
+    def seg_sum(vals):
+        return jax.ops.segment_sum(vals, gid, num_segments=out_cap,
+                                   indices_are_sorted=True)
+
     if op == "size":
-        gid_all = jnp.where(vmask, gid, out_cap)
-        data = jax.ops.segment_sum(jnp.ones(cap, jnp.int64), gid_all,
-                                   num_segments=out_cap)
-        return Column(data, None, dtypes.int64)
+        # padding contributes zeros (value-masked — robust even when a
+        # caller passes out_capacity > table capacity)
+        return Column(seg_sum(vmask.astype(jnp.int64)), None,
+                      dtypes.int64)
     if op == "count":
-        data = jax.ops.segment_sum(jnp.ones(cap, jnp.int64), gid_v,
-                                   num_segments=out_cap)
-        return Column(data, None, dtypes.int64)
+        return Column(seg_sum(value_ok.astype(jnp.int64)), None,
+                      dtypes.int64)
     if op == "sum":
         acc = kernels._acc_dtype(c.data.dtype)
         vals = jnp.where(value_ok, c.data, jnp.zeros((), c.data.dtype))
-        data = _segment_sum(vals.astype(acc), gid_v, out_cap)
+        data = _segment_sum(vals.astype(acc), gid, out_cap)
         return Column(data, None, dtypes.from_numpy_dtype(acc))
     if op == "sumsq":
         f = jnp.float64 if c.data.dtype.itemsize >= 4 else jnp.float32
         vals = jnp.where(value_ok, c.data.astype(f), 0.0)
-        data = jax.ops.segment_sum(vals * vals, gid_v, num_segments=out_cap)
-        return Column(data, None, dtypes.from_numpy_dtype(f))
+        return Column(seg_sum(vals * vals), None,
+                      dtypes.from_numpy_dtype(f))
     if op in ("min", "max"):
-        if c.dtype.is_dictionary:
-            # codes are order-preserving, so min/max of codes is correct
-            pass
+        # dictionary codes are order-preserving, so min/max of codes is
+        # correct for string columns too
         sent = (dtypes.sentinel_high(c.data.dtype) if op == "min"
                 else dtypes.sentinel_low(c.data.dtype))
         vals = jnp.where(value_ok, c.data, jnp.asarray(sent, c.data.dtype))
         red = jax.ops.segment_min if op == "min" else jax.ops.segment_max
-        data = red(vals, gid_v, num_segments=out_cap)
-        cnt = jax.ops.segment_sum(jnp.ones(cap, jnp.int32), gid_v,
-                                  num_segments=out_cap)
-        validity = gvalid & (cnt > 0)
-        return Column(data, validity, c.dtype, c.dictionary)
+        data = red(vals, gid, num_segments=out_cap,
+                   indices_are_sorted=True)
+        cnt = seg_sum(value_ok.astype(jnp.int32))
+        return Column(data, gvalid & (cnt > 0), c.dtype, c.dictionary)
     if op in ("mean", "var", "std"):
         f = jnp.float64 if c.data.dtype.itemsize >= 4 else jnp.float32
         vals = jnp.where(value_ok, c.data.astype(f), 0.0)
-        s = jax.ops.segment_sum(vals, gid_v, num_segments=out_cap)
-        n = jax.ops.segment_sum(jnp.ones(cap, f), gid_v, num_segments=out_cap)
+        s = seg_sum(vals)
+        n = seg_sum(value_ok.astype(f))
         if op == "mean":
             data = s / jnp.maximum(n, 1.0)
             return Column(data, gvalid & (n > 0), dtypes.from_numpy_dtype(f))
-        sq = jax.ops.segment_sum(vals * vals, gid_v, num_segments=out_cap)
+        sq = seg_sum(vals * vals)
         # ddof=1 (pandas default)
         var = (sq - s * s / jnp.maximum(n, 1.0)) / jnp.maximum(n - 1.0, 1.0)
         var = jnp.maximum(var, 0.0)
         data = jnp.sqrt(var) if op == "std" else var
         return Column(data, gvalid & (n > 1), dtypes.from_numpy_dtype(f))
     if op in ("first", "last"):
+        # stable group-sort preserved original row order within each
+        # group, so positional min/max == pandas first/last
         iota = jnp.arange(cap, dtype=jnp.int32)
         if op == "first":
-            idx = jax.ops.segment_min(jnp.where(value_ok, iota, cap), gid_v,
-                                      num_segments=out_cap)
+            idx = jax.ops.segment_min(jnp.where(value_ok, iota, cap), gid,
+                                      num_segments=out_cap,
+                                      indices_are_sorted=True)
         else:
-            idx = jax.ops.segment_max(jnp.where(value_ok, iota, -1), gid_v,
-                                      num_segments=out_cap)
+            idx = jax.ops.segment_max(jnp.where(value_ok, iota, -1), gid,
+                                      num_segments=out_cap,
+                                      indices_are_sorted=True)
         has = (idx >= 0) & (idx < cap)
         idx = jnp.clip(idx, 0, max(cap - 1, 0))
         data = c.data[idx]
         return Column(data, gvalid & has, c.dtype, c.dictionary)
+    # nunique/median re-sort by (gid, value) internally; they take the
+    # sentinel-id form (monotonicity not required there)
+    gid_v = jnp.where(value_ok, gid, out_cap)
     if op == "nunique":
         return _nunique(c, gid_v, gvalid, out_cap)
     if op in ("median", "quantile"):
