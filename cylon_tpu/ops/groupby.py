@@ -159,12 +159,15 @@ def _aggregate_column(table: Table, src: str, op: str, gid, num_groups,
 
     if op == "size":
         # padding contributes zeros (value-masked — robust even when a
-        # caller passes out_capacity > table capacity)
-        return Column(seg_sum(vmask.astype(jnp.int64)), None,
-                      dtypes.int64)
+        # caller passes out_capacity > table capacity). Accumulate in
+        # int32 (counts <= capacity < 2^31): 64-bit integer segment
+        # reductions run ~5x slower under the TPU x64 emulation.
+        return Column(seg_sum(vmask.astype(jnp.int32)).astype(jnp.int64),
+                      None, dtypes.int64)
     if op == "count":
-        return Column(seg_sum(value_ok.astype(jnp.int64)), None,
-                      dtypes.int64)
+        return Column(
+            seg_sum(value_ok.astype(jnp.int32)).astype(jnp.int64),
+            None, dtypes.int64)
     if op == "sum":
         acc = kernels._acc_dtype(c.data.dtype)
         vals = jnp.where(value_ok, c.data, jnp.zeros((), c.data.dtype))
@@ -230,20 +233,21 @@ def _aggregate_column(table: Table, src: str, op: str, gid, num_groups,
 def _nunique(c: Column, gid_v, gvalid, out_cap: int) -> Column:
     """Distinct non-null values per group: sort rows by (gid, value) and
     count run boundaries per group (parity: NUNIQUE kernel,
-    ``aggregate_kernels.hpp``)."""
+    ``aggregate_kernels.hpp``). The (gid, value-order-key) pairs ARE the
+    sort operands — no permutation, no gather; order-key equality ==
+    value equality (canonical NaN / -0.0)."""
     cap = c.data.shape[0]
-    perm = kernels.sort_perm([gid_v, c.data], gid_v < out_cap)
-    g_s = gid_v[perm]
-    v_s = c.data[perm]
+    g_s, v_s = jax.lax.sort((gid_v, kernels.order_key(c.data)),
+                            num_keys=2, is_stable=False)
     iota = jnp.arange(cap, dtype=jnp.int32)
     new_grp = g_s != jnp.roll(g_s, 1)
     new_val = v_s != jnp.roll(v_s, 1)
     boundary = (jnp.where(iota == 0, True, new_grp | new_val)
                 & (g_s < out_cap))
-    data = jax.ops.segment_sum(boundary.astype(jnp.int64),
+    data = jax.ops.segment_sum(boundary.astype(jnp.int32),
                                jnp.where(g_s < out_cap, g_s, out_cap),
                                num_segments=out_cap)
-    return Column(data, None, dtypes.int64)
+    return Column(data.astype(jnp.int64), None, dtypes.int64)
 
 
 def _quantile(c: Column, gid_v, gvalid, out_cap: int, q: float) -> Column:
@@ -252,9 +256,11 @@ def _quantile(c: Column, gid_v, gvalid, out_cap: int, q: float) -> Column:
     group's run at q*(n-1)."""
     cap = c.data.shape[0]
     f = jnp.float64 if c.data.dtype.itemsize >= 4 else jnp.float32
-    perm = kernels.sort_perm([gid_v, c.data], gid_v < out_cap)
-    g_s = gid_v[perm]
-    v_s = c.data[perm].astype(f)
+    # values ride the (gid, value-key) sort as payload — no perm/gather
+    g_s, _, v_raw = jax.lax.sort(
+        (gid_v, kernels.order_key(c.data), c.data), num_keys=2,
+        is_stable=False)
+    v_s = v_raw.astype(f)
     n = jax.ops.segment_sum(jnp.ones(cap, jnp.int32),
                             jnp.where(g_s < out_cap, g_s, out_cap),
                             num_segments=out_cap)

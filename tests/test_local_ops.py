@@ -379,3 +379,24 @@ def test_fullouter_join_content_equal_dictionaries():
     got = out.sort_values("k").reset_index(drop=True)
     assert got["k"].tolist() == ["x", "y"]
     assert got["v"].tolist() == [1, 2] and got["w"].tolist() == [4, 3]
+
+
+def test_sort_nulls_keep_original_order():
+    """pandas sort_values keeps null rows in ORIGINAL order (stable);
+    null slots carry arbitrary payload bytes, so the sort key must be
+    zeroed under nulls — ordering by garbage would be nondeterministic."""
+    import jax.numpy as jnp
+
+    from cylon_tpu import Table, dtypes
+    from cylon_tpu.column import Column
+    from cylon_tpu.ops.selection import sort_table
+
+    data = jnp.asarray([5, 9, 1, 7, 3, 2], jnp.int64)
+    validity = jnp.asarray([False, True, False, True, False, True])
+    k = jnp.arange(6, dtype=jnp.int64)
+    t = Table({"v": Column(data, validity, dtypes.int64),
+               "k": Column(k, None, dtypes.int64)}, 6)
+    out = sort_table(t, ["v"]).to_pandas()
+    # valid ascending first (2, 7, 9 -> k 5,3,1), then nulls in
+    # original row order (k 0,2,4)
+    assert out["k"].tolist() == [5, 3, 1, 0, 2, 4]
