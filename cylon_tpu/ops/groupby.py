@@ -26,7 +26,8 @@ from cylon_tpu.column import Column
 from cylon_tpu import dtypes
 from cylon_tpu.errors import InvalidArgument
 from cylon_tpu.ops import kernels
-from cylon_tpu.ops.selection import _null_flags, take_columns
+from cylon_tpu.ops.selection import (_null_flags, columns_to_payloads,
+                                     payloads_to_columns, take_columns)
 from cylon_tpu.table import Table
 
 #: ops supported (parity: aggregate_kernels.hpp:40-52 + pandas extras).
@@ -87,31 +88,15 @@ def _groupby_compiled(table: Table, *, by, aggs, out_cap,
         if src not in src_names:
             src_names.append(src)
     iota = jnp.arange(cap, dtype=jnp.int32)
-    payloads = [iota]                       # original row index
-    slots = {}
-    for sname in src_names:
-        c = table.column(sname)
-        if c.data.ndim == 1:
-            slots[sname] = ("payload", len(payloads))
-            payloads.append(c.data)
-        else:                               # rare: gather after the sort
-            slots[sname] = ("gather", None)
-        if c.validity is not None:
-            slots[sname + "\0v"] = ("payload", len(payloads))
-            payloads.append(c.validity)
+    src_cols = {s: table.column(s) for s in src_names}
+    # original row index leads the payloads (keytab + first/last);
+    # multi-dim columns fall back to a post-sort gather via that index
+    payloads, pack = columns_to_payloads(src_cols, cap, lead=[iota])
 
     gid_s, num_groups, sorted_pl = kernels.group_sort(
         keys, table.nrows, kvals, payloads)
     orig_idx = sorted_pl[0]
-
-    def sorted_column(sname) -> Column:
-        c = table.column(sname)
-        kind, slot = slots[sname]
-        data = (sorted_pl[slot] if kind == "payload"
-                else c.data[orig_idx])
-        vslot = slots.get(sname + "\0v")
-        validity = sorted_pl[vslot[1]] if vslot is not None else None
-        return Column(data, validity, c.dtype, c.dictionary)
+    sorted_cols = payloads_to_columns(src_cols, sorted_pl, pack)
 
     big = jnp.int32(cap)
     first_pos = jax.ops.segment_min(jnp.where(gid_s < big, iota, big),
@@ -127,7 +112,7 @@ def _groupby_compiled(table: Table, *, by, aggs, out_cap,
     for n in by:
         out[n] = keytab.column(n)
 
-    stab = Table({s: sorted_column(s) for s in src_names}, table.nrows)
+    stab = Table(sorted_cols, table.nrows)
     for spec in aggs:
         src, op, name = spec if len(spec) == 3 else (*spec, None)
         name = name or f"{src}_{op}"
@@ -246,7 +231,8 @@ def _nunique(c: Column, gid_v, gvalid, out_cap: int) -> Column:
                 & (g_s < out_cap))
     data = jax.ops.segment_sum(boundary.astype(jnp.int32),
                                jnp.where(g_s < out_cap, g_s, out_cap),
-                               num_segments=out_cap)
+                               num_segments=out_cap,
+                               indices_are_sorted=True)
     return Column(data.astype(jnp.int64), None, dtypes.int64)
 
 
@@ -263,7 +249,8 @@ def _quantile(c: Column, gid_v, gvalid, out_cap: int, q: float) -> Column:
     v_s = v_raw.astype(f)
     n = jax.ops.segment_sum(jnp.ones(cap, jnp.int32),
                             jnp.where(g_s < out_cap, g_s, out_cap),
-                            num_segments=out_cap)
+                            num_segments=out_cap,
+                            indices_are_sorted=True)
     start = kernels.exclusive_cumsum(n)
     pos = q * jnp.maximum(n - 1, 0).astype(f)
     lo = jnp.floor(pos).astype(jnp.int32)

@@ -115,41 +115,62 @@ def take_columns(table: Table, idx: jax.Array, nrows_out,
     return Table(cols, nrows_out)
 
 
+def columns_to_payloads(columns, capacity: int,
+                        lead: Sequence[jax.Array] = ()):
+    """Flatten ``{name: Column}`` into ``lax.sort`` payload operands.
+
+    Returns ``(payloads, spec)``: 1-D data and validity arrays become
+    payload slots; multi-dim columns (rare) are marked for a post-sort
+    gather through an original-index payload, which is appended
+    automatically when needed. ``lead`` payloads occupy the first slots
+    (callers that want the original row index pass ``[iota]``).
+    The inverse is :func:`payloads_to_columns`."""
+    payloads = list(lead)
+    spec = {}
+    need_iota = False
+    for name, c in columns.items():
+        if c.data.ndim == 1:
+            spec[name] = len(payloads)
+            payloads.append(c.data)
+        else:
+            spec[name] = None
+            need_iota = True
+        if c.validity is not None:
+            spec[name + "\0v"] = len(payloads)
+            payloads.append(c.validity)
+    iota_slot = None
+    if need_iota:
+        iota_slot = len(payloads)
+        payloads.append(jnp.arange(capacity, dtype=jnp.int32))
+    return payloads, (spec, iota_slot)
+
+
+def payloads_to_columns(columns, sorted_payloads, pack) -> dict:
+    """Rebuild ``{name: Column}`` from sorted payload slots (see
+    :func:`columns_to_payloads`)."""
+    spec, iota_slot = pack
+    cols = {}
+    for name, c in columns.items():
+        slot = spec[name]
+        data = (sorted_payloads[slot] if slot is not None
+                else c.data[sorted_payloads[iota_slot]])
+        vslot = spec.get(name + "\0v")
+        validity = sorted_payloads[vslot] if vslot is not None else None
+        cols[name] = Column(data, validity, c.dtype, c.dictionary)
+    return cols
+
+
 def permute_by_sort(table: Table, operands, nrows_out) -> Table:
     """Reorder a table by a stable sort on ``operands`` (pre-built
     unsigned order keys), carrying every column through ``lax.sort`` as
     payload. Random gathers are ~10x the cost of the sort itself on TPU
     at 10M rows, so moving the bytes through the comparator network
-    beats materialising a permutation and gathering. Multi-dim columns
-    (rare) ride an original-index payload + gather."""
-    payloads = []
-    spec = []
-    need_iota = False
-    for name, c in table.columns.items():
-        if c.data.ndim == 1:
-            spec.append((name, len(payloads)))
-            payloads.append(c.data)
-        else:
-            spec.append((name, None))
-            need_iota = True
-        if c.validity is not None:
-            spec.append((name + "\0v", len(payloads)))
-            payloads.append(c.validity)
-    iota_slot = None
-    if need_iota:
-        iota_slot = len(payloads)
-        payloads.append(jnp.arange(table.capacity, dtype=jnp.int32))
+    beats materialising a permutation and gathering."""
+    payloads, pack = columns_to_payloads(table.columns, table.capacity)
     out = jax.lax.sort(tuple(operands) + tuple(payloads),
                        num_keys=len(operands), is_stable=True)
-    sp = out[len(operands):]
-    cols = {}
-    entries = dict(spec)
-    for name, c in table.columns.items():
-        slot = entries[name]
-        data = sp[slot] if slot is not None else c.data[sp[iota_slot]]
-        vslot = entries.get(name + "\0v")
-        validity = sp[vslot] if vslot is not None else None
-        cols[name] = Column(data, validity, c.dtype, c.dictionary)
+    cols = payloads_to_columns(table.columns, list(out[len(operands):]),
+                               pack)
     return Table(cols, nrows_out)
 
 
