@@ -91,7 +91,8 @@ def _groupby_compiled(table: Table, *, by, aggs, out_cap,
     src_cols = {s: table.column(s) for s in src_names}
     # original row index leads the payloads (keytab + first/last);
     # multi-dim columns fall back to a post-sort gather via that index
-    payloads, pack = columns_to_payloads(src_cols, cap, lead=[iota])
+    payloads, pack = columns_to_payloads(src_cols, cap, lead=[iota],
+                                        index_slot=0)
 
     gid_s, num_groups, sorted_pl = kernels.group_sort(
         keys, table.nrows, kvals, payloads)

@@ -116,15 +116,16 @@ def take_columns(table: Table, idx: jax.Array, nrows_out,
 
 
 def columns_to_payloads(columns, capacity: int,
-                        lead: Sequence[jax.Array] = ()):
+                        lead: Sequence[jax.Array] = (),
+                        index_slot: int | None = None):
     """Flatten ``{name: Column}`` into ``lax.sort`` payload operands.
 
     Returns ``(payloads, spec)``: 1-D data and validity arrays become
     payload slots; multi-dim columns (rare) are marked for a post-sort
-    gather through an original-index payload, which is appended
-    automatically when needed. ``lead`` payloads occupy the first slots
-    (callers that want the original row index pass ``[iota]``).
-    The inverse is :func:`payloads_to_columns`."""
+    gather through an original-index payload. ``lead`` payloads occupy
+    the first slots; a caller whose lead already carries the original
+    row index passes its slot as ``index_slot`` so no duplicate iota
+    rides the sort. The inverse is :func:`payloads_to_columns`."""
     payloads = list(lead)
     spec = {}
     need_iota = False
@@ -138,8 +139,8 @@ def columns_to_payloads(columns, capacity: int,
         if c.validity is not None:
             spec[name + "\0v"] = len(payloads)
             payloads.append(c.validity)
-    iota_slot = None
-    if need_iota:
+    iota_slot = index_slot
+    if need_iota and iota_slot is None:
         iota_slot = len(payloads)
         payloads.append(jnp.arange(capacity, dtype=jnp.int32))
     return payloads, (spec, iota_slot)

@@ -20,15 +20,22 @@ import jax.numpy as jnp
 from cylon_tpu.errors import InvalidArgument
 from cylon_tpu.ops import kernels
 from cylon_tpu.ops.dictenc import unify_table_dictionaries
-from cylon_tpu.ops.selection import take_columns
+from cylon_tpu.column import Column
+from cylon_tpu.ops.selection import (columns_to_payloads, payloads_to_columns,
+                                     permute_by_sort, take_columns)
 from cylon_tpu.table import Table
 
 
-def _row_gids(table: Table, cols: Sequence[str] | None = None):
-    names = cols if cols is not None else table.column_names
-    keys = [table.column(n).data for n in names]
-    vals = [table.column(n).validity for n in names]
-    return kernels.dense_group_ids(keys, table.nrows, vals)
+def _trim_capacity(t: Table, out_cap: int, nrows) -> Table:
+    """Slice the static buffer to ``out_cap`` WITHOUT clamping nrows —
+    an overflowed true count must keep poisoning ``Table.num_rows``."""
+    if out_cap >= t.capacity:
+        return t
+    cols = {n: Column(c.data[:out_cap],
+                      None if c.validity is None else c.validity[:out_cap],
+                      c.dtype, c.dictionary)
+            for n, c in t.columns.items()}
+    return Table(cols, nrows)
 
 
 def unique(table: Table, cols: Sequence[str] | None = None,
@@ -51,22 +58,33 @@ def unique(table: Table, cols: Sequence[str] | None = None,
 
 @functools.partial(jax.jit, static_argnames=("cols", "keep", "out_cap"))
 def _unique_compiled(table: Table, *, cols, keep, out_cap) -> Table:
+    """Two payload-carrying sorts, no random gathers (those cost ~10x a
+    sort on TPU): (1) group-sort all columns, where each group's
+    representative is its run boundary (stable sort => within-group
+    original order, so the first/last position IS the first/last
+    occurrence); (2) re-sort by (not-representative, original index) to
+    emit representatives in original row order."""
     cap = table.capacity
-    gid, num_groups, _ = _row_gids(table, cols)
+    names = cols if cols is not None else tuple(table.column_names)
+    keys = [table.column(n).data for n in names]
+    vals = [table.column(n).validity for n in names]
     iota = jnp.arange(cap, dtype=jnp.int32)
+    payloads, pack = columns_to_payloads(table.columns, cap,
+                                        lead=[iota], index_slot=0)
+    gid_s, num_groups, sorted_pl = kernels.group_sort(
+        keys, table.nrows, vals, payloads)
+    orig_s = sorted_pl[0]
     if keep == "first":
-        occ = jax.ops.segment_min(jnp.where(gid < cap, iota, cap), gid,
-                                  num_segments=cap)
+        is_rep = (gid_s != jnp.roll(gid_s, 1)) | (iota == 0)
     else:
-        occ = jax.ops.segment_max(jnp.where(gid < cap, iota, -1), gid,
-                                  num_segments=cap)
-    # occ[g] = representative row of group g; emit groups in original row
-    # order by sorting groups on their representative index
-    occ = jnp.clip(occ, 0, max(cap - 1, 0))
-    rep_valid = jnp.arange(cap, dtype=jnp.int32) < num_groups
-    order = kernels.sort_perm([jnp.where(rep_valid, occ, cap)], rep_valid)
-    idx = occ[order][:out_cap]
-    return take_columns(table, idx, num_groups)
+        is_rep = (gid_s != jnp.roll(gid_s, -1)) | (iota == cap - 1)
+    is_rep = is_rep & (gid_s < cap)       # padding has the sentinel id
+    sorted_cols = payloads_to_columns(table.columns, sorted_pl, pack)
+    operands = kernels.pack_order_keys(
+        [(~is_rep).astype(jnp.uint8), orig_s.astype(jnp.uint32)])
+    out = permute_by_sort(Table(sorted_cols, num_groups), operands,
+                          num_groups)
+    return _trim_capacity(out, out_cap, num_groups)
 
 
 def _two_table_gids(a: Table, b: Table, cols: Sequence[str] | None):
@@ -110,11 +128,12 @@ def _select_a_groups(a: Table, gid_a, group_keep, ncomb, out_capacity=None):
                                 num_segments=ncomb)
     is_first = first[jnp.clip(gid_a, 0, ncomb - 1)] == iota
     mask = keep_row & is_first
-    perm, count = kernels.compact_mask(mask, a.nrows)
-    if out_capacity is not None:
-        # keep the true count as nrows so overflow raises at num_rows
-        perm = perm[:out_capacity]
-    return take_columns(a, perm, count)
+    keep = mask & (iota < a.nrows)
+    count = keep.sum(dtype=jnp.int32)
+    out = permute_by_sort(a, ((~keep).astype(jnp.uint8),), count)
+    if out_capacity is None:
+        return out
+    return _trim_capacity(out, out_capacity, count)
 
 
 def union(a: Table, b: Table, out_capacity: int | None = None) -> Table:
