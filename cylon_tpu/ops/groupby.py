@@ -42,7 +42,7 @@ def _segment_sum(vals, gid, num_segments: int):
     Callers pass GROUP-SORTED gid (monotone), hence the sorted flag."""
     from cylon_tpu.ops import pallas_kernels
 
-    if (vals.dtype == jnp.float32
+    if (vals.dtype == jnp.float32 and vals.ndim == 1
             and pallas_kernels.segment_sum_ok(num_segments)
             and pallas_kernels.usable_for(vals)):
         return pallas_kernels.segment_sum(vals, gid, num_segments)
@@ -136,6 +136,8 @@ def _aggregate_column(table: Table, src: str, op: str, gid, num_groups,
     vmask = kernels.valid_mask(cap, table.nrows)
     nulls = _null_flags(c)
     value_ok = vmask if nulls is None else (vmask & (nulls == 0))
+    # broadcast the row mask over trailing dims of multi-dim columns
+    ok_b = value_ok.reshape((cap,) + (1,) * (c.data.ndim - 1))
     gslot = jnp.arange(out_cap, dtype=jnp.int32)
     gvalid = gslot < num_groups
 
@@ -156,12 +158,12 @@ def _aggregate_column(table: Table, src: str, op: str, gid, num_groups,
             None, dtypes.int64)
     if op == "sum":
         acc = kernels._acc_dtype(c.data.dtype)
-        vals = jnp.where(value_ok, c.data, jnp.zeros((), c.data.dtype))
+        vals = jnp.where(ok_b, c.data, jnp.zeros((), c.data.dtype))
         data = _segment_sum(vals.astype(acc), gid, out_cap)
         return Column(data, None, dtypes.from_numpy_dtype(acc))
     if op == "sumsq":
         f = jnp.float64 if c.data.dtype.itemsize >= 4 else jnp.float32
-        vals = jnp.where(value_ok, c.data.astype(f), 0.0)
+        vals = jnp.where(ok_b, c.data.astype(f), 0.0)
         return Column(seg_sum(vals * vals), None,
                       dtypes.from_numpy_dtype(f))
     if op in ("min", "max"):
@@ -169,7 +171,7 @@ def _aggregate_column(table: Table, src: str, op: str, gid, num_groups,
         # correct for string columns too
         sent = (dtypes.sentinel_high(c.data.dtype) if op == "min"
                 else dtypes.sentinel_low(c.data.dtype))
-        vals = jnp.where(value_ok, c.data, jnp.asarray(sent, c.data.dtype))
+        vals = jnp.where(ok_b, c.data, jnp.asarray(sent, c.data.dtype))
         red = jax.ops.segment_min if op == "min" else jax.ops.segment_max
         data = red(vals, gid, num_segments=out_cap,
                    indices_are_sorted=True)
@@ -177,15 +179,18 @@ def _aggregate_column(table: Table, src: str, op: str, gid, num_groups,
         return Column(data, gvalid & (cnt > 0), c.dtype, c.dictionary)
     if op in ("mean", "var", "std"):
         f = jnp.float64 if c.data.dtype.itemsize >= 4 else jnp.float32
-        vals = jnp.where(value_ok, c.data.astype(f), 0.0)
+        vals = jnp.where(ok_b, c.data.astype(f), 0.0)
         s = seg_sum(vals)
         n = seg_sum(value_ok.astype(f))
+        # counts are per group; broadcast over trailing dims of the sums
+        n_b = n.reshape(n.shape + (1,) * (s.ndim - 1))
         if op == "mean":
-            data = s / jnp.maximum(n, 1.0)
+            data = s / jnp.maximum(n_b, 1.0)
             return Column(data, gvalid & (n > 0), dtypes.from_numpy_dtype(f))
         sq = seg_sum(vals * vals)
         # ddof=1 (pandas default)
-        var = (sq - s * s / jnp.maximum(n, 1.0)) / jnp.maximum(n - 1.0, 1.0)
+        var = ((sq - s * s / jnp.maximum(n_b, 1.0))
+               / jnp.maximum(n_b - 1.0, 1.0))
         var = jnp.maximum(var, 0.0)
         data = jnp.sqrt(var) if op == "std" else var
         return Column(data, gvalid & (n > 1), dtypes.from_numpy_dtype(f))

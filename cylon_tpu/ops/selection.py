@@ -222,11 +222,14 @@ def _sort_compiled(table: Table, *, by, ascending, na_position) -> Table:
 
 
 def _null_flags(c: Column) -> jax.Array | None:
-    """uint8 1 where the value is missing (validity or float NaN)."""
+    """[capacity] uint8, 1 where the row's value is missing (validity or
+    float NaN). NaN-as-null is a scalar-column concept: multi-dim
+    (embedding-like) columns are only null by validity — a NaN element
+    inside a vector does not void the row."""
     flags = None
     if c.validity is not None:
         flags = (~c.validity).astype(jnp.uint8)
-    if jnp.issubdtype(c.data.dtype, jnp.floating):
+    if jnp.issubdtype(c.data.dtype, jnp.floating) and c.data.ndim == 1:
         nan = jnp.isnan(c.data).astype(jnp.uint8)
         flags = nan if flags is None else flags | nan
     return flags

@@ -400,3 +400,64 @@ def test_sort_nulls_keep_original_order():
     # valid ascending first (2, 7, 9 -> k 5,3,1), then nulls in
     # original row order (k 0,2,4)
     assert out["k"].tolist() == [5, 3, 1, 0, 2, 4]
+
+
+def test_multidim_columns_through_payload_paths():
+    """2-D (embedding-like) columns can't ride lax.sort payloads; they
+    take the original-index gather fallback in columns_to_payloads —
+    exercise filter, sort, unique and groupby over such a table."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from cylon_tpu import Table, dtypes
+    from cylon_tpu.column import Column
+    from cylon_tpu.ops.groupby import groupby_aggregate
+    from cylon_tpu.ops.selection import filter_table, sort_table
+    from cylon_tpu.ops.setops import unique
+
+    k = jnp.asarray([3, 1, 3, 2, 1, 2], jnp.int64)
+    emb = jnp.asarray(np.arange(12, dtype=np.float32).reshape(6, 2))
+    t = Table({"k": Column(k, None, dtypes.int64),
+               "e": Column(emb, None, dtypes.float32)}, 6)
+
+    f = filter_table(t, jnp.asarray([True, False, True, True, False,
+                                     True]))
+    assert f.num_rows == 4
+    np.testing.assert_array_equal(np.asarray(f.column("e").data[:4]),
+                                  np.asarray(emb)[[0, 2, 3, 5]])
+
+    s = sort_table(t, ["k"])
+    np.testing.assert_array_equal(np.asarray(s.column("k").data[:6]),
+                                  [1, 1, 2, 2, 3, 3])
+    # stable: equal keys keep original order, embeddings follow rows
+    np.testing.assert_array_equal(np.asarray(s.column("e").data[:6]),
+                                  np.asarray(emb)[[1, 4, 3, 5, 0, 2]])
+
+    u = unique(t, ["k"])
+    assert u.num_rows == 3
+    np.testing.assert_array_equal(np.asarray(u.column("k").data[:3]),
+                                  [3, 1, 2])  # first occurrences, row order
+    np.testing.assert_array_equal(np.asarray(u.column("e").data[:3]),
+                                  np.asarray(emb)[[0, 1, 3]])
+
+    g = groupby_aggregate(t, ["k"], [("e", "first", "e0"),
+                                     ("e", "sum", "es")],
+                          out_capacity=4)
+    assert g.num_rows == 3
+    np.testing.assert_array_equal(np.asarray(g.column("e0").data[:3]),
+                                  np.asarray(emb)[[1, 3, 0]])  # key-sorted
+    want = np.stack([np.asarray(emb)[[1, 4]].sum(0),
+                     np.asarray(emb)[[3, 5]].sum(0),
+                     np.asarray(emb)[[0, 2]].sum(0)])
+    np.testing.assert_allclose(np.asarray(g.column("es").data[:3]), want)
+
+    g2 = groupby_aggregate(t, ["k"], [("e", "mean", "em")],
+                           out_capacity=4)
+    np.testing.assert_allclose(np.asarray(g2.column("em").data[:3]),
+                               want / 2.0)
+    # out_capacity == trailing dim: the shapes coincide, the axis must
+    # not (regression for a silent wrong-axis broadcast)
+    g3 = groupby_aggregate(t, ["k"], [("e", "mean", "em")],
+                           out_capacity=2)
+    np.testing.assert_allclose(np.asarray(g3.column("em").data[:2]),
+                               (want / 2.0)[:2])
