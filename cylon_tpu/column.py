@@ -37,6 +37,10 @@ class Dictionary:
 
     def __init__(self, values: np.ndarray):
         self.values = np.asarray(values, dtype=object)
+        # content hashing requires immutable content: mutation after the
+        # first hash would silently corrupt jit-cache keys and
+        # unify_dictionaries' equal-content pass-through
+        self.values.flags.writeable = False
         self._key = None
         self._hash = None
 

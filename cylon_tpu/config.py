@@ -23,9 +23,11 @@ class JoinType(enum.Enum):
 class JoinAlgorithm(enum.Enum):
     """Parity: ``join_config.hpp`` JoinAlgorithm {SORT, HASH}.
 
-    On TPU both lower to vectorised sorted probes; SORT is the
-    merge-on-sorted path, HASH keeps API parity and routes to the same
-    sorted probe (a Pallas hash-table build/probe is an optimisation slot).
+    SORT groups rows by lexicographic key rank; HASH by murmur bucket
+    with the key words as collision tiebreakers
+    (``kernels.group_sort(hash_first=True)``) — the TPU rendition of the
+    reference's flat_hash_map build/probe (``join/hash_join.cpp:22-31``).
+    Both are exact and produce identical row sets.
     """
 
     SORT = "sort"
@@ -57,13 +59,17 @@ class JoinConfig:
 class SortOptions:
     """Parity: ``table.hpp:378-383`` SortOptions{num_bins, num_samples}.
 
-    Controls distributed sample-sort range partitioning: each shard
-    contributes ``num_samples`` samples; split points come from a
-    ``num_bins``-bucket global histogram (psum-reduced).
+    Controls distributed range partitioning (``dist_sort``):
+    ``num_bins == 0`` (default) uses strided-sample splitters (each
+    shard contributes ``num_samples`` sorted samples, one all_gather);
+    ``num_bins > 0`` uses the reference's histogram scheme instead —
+    distributed min/max, a ``num_bins``-bucket fixed-width histogram
+    psum-reduced across shards, split points at count quantiles
+    (``arrow_partition_kernels.cpp:334-421``).
     """
 
-    num_bins: int = 0        # 0 -> world_size * 128
-    num_samples: int = 0     # 0 -> min(local_rows, 1024)
+    num_bins: int = 0        # 0 -> sample splitters; >0 -> histogram
+    num_samples: int = 0     # 0 -> 1024
     ascending: bool = True
 
 

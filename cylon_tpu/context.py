@@ -54,6 +54,11 @@ class TPUConfig(CommConfig):
     devices: Optional[Sequence] = None
     n_devices: Optional[int] = None
     multihost: bool = False
+    #: explicit jax.distributed.initialize parameters (None = rely on
+    #: the cluster environment's auto-detection, e.g. TPU pod metadata)
+    coordinator_address: Optional[str] = None
+    num_processes: Optional[int] = None
+    process_id: Optional[int] = None
 
 
 # MPIConfig name kept as an alias so PyCylon scripts port mechanically.
@@ -70,7 +75,12 @@ class CylonEnv:
         config = config if config is not None else TPUConfig()
         self._config = config
         if isinstance(config, TPUConfig) and config.multihost:
-            jax.distributed.initialize()
+            kw = {}
+            if config.coordinator_address is not None:
+                kw.update(coordinator_address=config.coordinator_address,
+                          num_processes=config.num_processes,
+                          process_id=config.process_id)
+            jax.distributed.initialize(**kw)
 
         if isinstance(config, LocalConfig) or not distributed:
             devices = [jax.devices()[0]]
@@ -104,6 +114,12 @@ class CylonEnv:
     @property
     def mesh(self) -> Mesh:
         return self._mesh
+
+    @property
+    def platform(self) -> str:
+        """Platform of the mesh's devices ("tpu"/"cpu"/...) — the thing
+        Pallas dispatch must key on, not the process default backend."""
+        return self._mesh.devices.flat[0].platform
 
     @property
     def world_size(self) -> int:

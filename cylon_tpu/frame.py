@@ -247,16 +247,18 @@ class DataFrame:
               out_capacity: int | None = None,
               algorithm: str = "sort") -> "DataFrame":
         """Parity: ``DataFrame.merge`` (frame.py:1516). ``algorithm``
-        mirrors pycylon's sort/hash choice (both lower to the dense-rank
-        join on TPU)."""
+        mirrors pycylon's sort/hash choice ("hash" = murmur-bucket
+        grouping, see ``ops.join.join``)."""
         if env is not None:
             t = dist_join(env, self._table, right._table, on=on,
                           left_on=left_on, right_on=right_on, how=how,
-                          suffixes=suffixes, out_capacity=out_capacity)
+                          suffixes=suffixes, out_capacity=out_capacity,
+                          algorithm=algorithm)
         else:
             t = _join(self._gathered(), right._gathered(), on=on,
                       left_on=left_on, right_on=right_on, how=how,
-                      suffixes=suffixes, out_capacity=out_capacity)
+                      suffixes=suffixes, out_capacity=out_capacity,
+                      algorithm=algorithm)
             t = _shrink(t)
         return DataFrame._wrap(t)
 
@@ -661,9 +663,32 @@ def merge(left: DataFrame, right: DataFrame, **kw) -> DataFrame:
     return left.merge(right, **kw)
 
 
+# DataFrame rides jit boundaries as a pytree (whole-query compilation,
+# cylon_tpu.plan): the wrapped Table is the traced child; the index is
+# treedef metadata (value indexes are host-built and rarely cross a
+# compiled query).
+import jax as _jax  # noqa: E402
+
+_jax.tree_util.register_pytree_node(
+    DataFrame,
+    lambda df: ((df._table,), df._index),
+    lambda idx, children: DataFrame._wrap(children[0], idx),
+)
+
+
 def concat(frames: Sequence[DataFrame], env: CylonEnv | None = None,
            out_capacity: int | None = None) -> DataFrame:
-    """Parity: pycylon ``concat`` (frame.py:1956) / ``distributed_concat``."""
+    """Parity: pycylon ``concat`` (frame.py:1956) / ``distributed_concat``
+    (``table.pyx:2398``). With an ``env``, every shard concatenates its
+    local blocks in place — no gather, no shuffle (rank-local order,
+    like the reference's distributed_concat); locally, frame-major
+    pandas order."""
+    if env is not None and out_capacity is None:
+        from cylon_tpu.parallel import dist_concat
+
+        return DataFrame._wrap(dist_concat(env, [f._table for f in frames]))
+    # an explicit out_capacity needs one global buffer of that size —
+    # concatenate locally at that capacity, then lay out on the mesh
     tables = [f._gathered() for f in frames]
     t = _selection.concat_tables(tables, capacity=out_capacity)
     if env is not None:

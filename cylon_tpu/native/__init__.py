@@ -116,6 +116,10 @@ def _declare(lib):
     lib.cylon_catalog_col_read.restype = c.c_int32
     lib.cylon_catalog_col_read.argtypes = [
         c.c_char_p, c.c_int32, c.c_void_p, c.c_int64, c.c_void_p]
+    lib.cylon_catalog_join.restype = c.c_int32
+    lib.cylon_catalog_join.argtypes = [
+        c.c_char_p, c.c_char_p, c.c_char_p, c.c_int32,
+        c.POINTER(c.c_int32), c.POINTER(c.c_int32), c.c_int32]
     lib.cylon_catalog_remove.restype = c.c_int32
     lib.cylon_catalog_remove.argtypes = [c.c_char_p]
     lib.cylon_catalog_size.restype = c.c_int32

@@ -26,6 +26,8 @@
 #include <fstream>
 #include <unordered_map>
 #include <functional>
+#include <algorithm>
+#include <limits>
 #include <map>
 #include <mutex>
 #include <queue>
@@ -722,6 +724,224 @@ int64_t cylon_catalog_ids(char* buf, int64_t cap) {
 void cylon_catalog_clear() {
   std::lock_guard<std::mutex> lk(g_catalog_mu);
   catalog().clear();
+}
+
+}  // extern "C"
+
+// ------------------------------------------------------------------
+// Native host join over catalog tables.
+//
+// Parity: the reference's string-id join surface used by the Java
+// binding — `table_api` JoinTables (`table_api.hpp:38-90`) behind
+// `Table.java:289-307` nativeJoin. This is the HOST runtime's join
+// (hash build + probe, like `join/hash_join.cpp:22-31`): a foreign
+// runtime (C/JNI/Go) can put tables, join, and read results with no
+// Python in the process. The TPU path (`cylon_tpu.ops.join`) remains
+// the compute engine for device-resident tables; this covers the
+// catalog/FFI surface with the same null==null, pandas-suffix
+// semantics so results agree with the device join.
+// ------------------------------------------------------------------
+
+namespace {
+
+// canonical 64-bit cell image: int64/f64 as 8 bytes (f64 canonicalises
+// -0.0 and NaN so bit-equality == value-equality, matching
+// kernels.order_key), int32 codes sign-extended.
+inline int64_t cell_bits(const CatColumn& c, int64_t i) {
+  if (c.dtype == 2) {
+    int32_t v;
+    std::memcpy(&v, c.data.data() + i * 4, 4);
+    return v;
+  }
+  if (c.dtype == 1) {
+    double d;
+    std::memcpy(&d, c.data.data() + i * 8, 8);
+    if (d == 0.0) d = 0.0;                      // -0.0 -> +0.0
+    if (d != d) d = std::numeric_limits<double>::quiet_NaN();
+    int64_t v;
+    std::memcpy(&v, &d, 8);
+    return v;
+  }
+  int64_t v;
+  std::memcpy(&v, c.data.data() + i * 8, 8);
+  return v;
+}
+
+inline bool cell_valid(const CatColumn& c, int64_t i) {
+  return c.validity.empty() || c.validity[i] != 0;
+}
+
+inline int64_t cell_width(const CatColumn& c) {
+  return c.dtype == 2 ? 4 : 8;
+}
+
+// composite row-key hash over the key columns (null == null: validity
+// folds in as its own word, like ops/hash._row_words)
+inline uint64_t row_key_hash(const CatTable& t,
+                             const std::vector<int32_t>& keys, int64_t i) {
+  uint64_t h = 0x9E3779B97F4A7C15ull;
+  for (int32_t k : keys) {
+    const CatColumn& c = t.cols[k];
+    bool valid = cell_valid(c, i);
+    uint64_t w = valid ? static_cast<uint64_t>(cell_bits(c, i)) : 0ull;
+    h ^= w + 0x9E3779B97F4A7C15ull + (h << 6) + (h >> 2);
+    h ^= (valid ? 0x517CC1B727220A95ull : 0x2545F4914F6CDD1Dull)
+         + (h << 6) + (h >> 2);
+  }
+  return h;
+}
+
+inline bool rows_key_equal(const CatTable& a,
+                           const std::vector<int32_t>& ka, int64_t i,
+                           const CatTable& b,
+                           const std::vector<int32_t>& kb, int64_t j) {
+  for (size_t f = 0; f < ka.size(); ++f) {
+    const CatColumn& ca = a.cols[ka[f]];
+    const CatColumn& cb = b.cols[kb[f]];
+    bool va = cell_valid(ca, i), vb = cell_valid(cb, j);
+    if (va != vb) return false;
+    if (va && cell_bits(ca, i) != cell_bits(cb, j)) return false;
+  }
+  return true;
+}
+
+// gather `rows` (with -1 = null slot) from `src` into a fresh column
+CatColumn gather_col(const CatColumn& src, const std::vector<int64_t>& rows) {
+  CatColumn out;
+  out.name = src.name;
+  out.dtype = src.dtype;
+  const int64_t w = cell_width(src);
+  out.data.assign(rows.size() * w, 0);
+  bool any_null = false;
+  out.validity.assign(rows.size(), 1);
+  for (size_t r = 0; r < rows.size(); ++r) {
+    int64_t i = rows[r];
+    if (i < 0 || !cell_valid(src, i)) {
+      out.validity[r] = 0;
+      any_null = true;
+      continue;
+    }
+    std::memcpy(out.data.data() + r * w, src.data.data() + i * w, w);
+  }
+  if (!any_null) out.validity.clear();
+  return out;
+}
+
+}  // namespace
+
+extern "C" {
+
+int32_t cylon_catalog_join(const char* left_id, const char* right_id,
+                           const char* out_id, int32_t n_keys,
+                           const int32_t* left_keys,
+                           const int32_t* right_keys,
+                           int32_t join_type) {
+  if (!left_id || !right_id || !out_id || n_keys <= 0 || !left_keys ||
+      !right_keys || join_type < 0 || join_type > 3)
+    return -1;
+  std::lock_guard<std::mutex> lk(g_catalog_mu);
+  auto lit = catalog().find(left_id);
+  auto rit = catalog().find(right_id);
+  if (lit == catalog().end() || rit == catalog().end()) return -2;
+  const CatTable& L = lit->second;
+  const CatTable& R = rit->second;
+  std::vector<int32_t> lk_(left_keys, left_keys + n_keys);
+  std::vector<int32_t> rk_(right_keys, right_keys + n_keys);
+  for (int32_t i = 0; i < n_keys; ++i) {
+    if (lk_[i] < 0 || lk_[i] >= (int32_t)L.cols.size() || rk_[i] < 0 ||
+        rk_[i] >= (int32_t)R.cols.size())
+      return -3;
+    if (L.cols[lk_[i]].dtype != R.cols[rk_[i]].dtype) return -4;
+  }
+
+  // build on the right, probe from the left (hash_join.cpp builds on
+  // the smaller side; catalog joins are host-sized, simplicity wins)
+  std::unordered_map<uint64_t, std::vector<int64_t>> buckets;
+  buckets.reserve(R.n_rows * 2);
+  for (int64_t j = 0; j < R.n_rows; ++j)
+    buckets[row_key_hash(R, rk_, j)].push_back(j);
+
+  std::vector<int64_t> li_out, ri_out;
+  std::vector<uint8_t> r_matched(R.n_rows, 0);
+  const bool emit_left = join_type == 1 || join_type == 3;   // left/full
+  const bool emit_right = join_type == 2 || join_type == 3;  // right/full
+  for (int64_t i = 0; i < L.n_rows; ++i) {
+    auto it = buckets.find(row_key_hash(L, lk_, i));
+    bool any = false;
+    if (it != buckets.end()) {
+      for (int64_t j : it->second) {
+        if (rows_key_equal(L, lk_, i, R, rk_, j)) {
+          li_out.push_back(i);
+          ri_out.push_back(j);
+          r_matched[j] = 1;
+          any = true;
+        }
+      }
+    }
+    if (!any && emit_left) {
+      li_out.push_back(i);
+      ri_out.push_back(-1);
+    }
+  }
+  if (emit_right) {
+    for (int64_t j = 0; j < R.n_rows; ++j) {
+      if (!r_matched[j]) {
+        li_out.push_back(-1);
+        ri_out.push_back(j);
+      }
+    }
+  }
+
+  // assemble, matching the device join's naming (_assemble in
+  // ops/join.py, itself pandas-merge semantics): a key pair is SHARED
+  // only when the two columns have the same name — shared keys emit one
+  // coalesced column and the right copy is dropped; differently-named
+  // keys stay separate columns (left side null for right-only rows).
+  // Remaining name collisions get the pandas _x/_y suffixes.
+  CatTable out;
+  out.n_rows = static_cast<int64_t>(li_out.size());
+  std::unordered_map<std::string, int> name_count;
+  std::vector<uint8_t> drop_r(R.cols.size(), 0);   // shared (same-name) keys
+  std::vector<int32_t> coalesce_r(L.cols.size(), -1);
+  for (int32_t f = 0; f < n_keys; ++f) {
+    if (L.cols[lk_[f]].name == R.cols[rk_[f]].name) {
+      drop_r[rk_[f]] = 1;
+      coalesce_r[lk_[f]] = rk_[f];
+    }
+  }
+  for (const auto& c : L.cols) name_count[c.name]++;
+  for (size_t j = 0; j < R.cols.size(); ++j)
+    if (!drop_r[j]) name_count[R.cols[j].name]++;
+
+  for (size_t ci = 0; ci < L.cols.size(); ++ci) {
+    CatColumn col = gather_col(L.cols[ci], li_out);
+    if (coalesce_r[ci] >= 0 && !col.validity.empty()) {
+      // shared key: fill right-only rows from the right key column
+      const CatColumn& rc = R.cols[coalesce_r[ci]];
+      const int64_t w = cell_width(rc);
+      for (size_t r = 0; r < li_out.size(); ++r) {
+        if (li_out[r] >= 0 || ri_out[r] < 0) continue;
+        if (!cell_valid(rc, ri_out[r])) continue;
+        std::memcpy(col.data.data() + r * w,
+                    rc.data.data() + ri_out[r] * w, w);
+        col.validity[r] = 1;
+      }
+      if (std::find(col.validity.begin(), col.validity.end(), 0) ==
+          col.validity.end())
+        col.validity.clear();
+    }
+    bool shared_key = coalesce_r[ci] >= 0;
+    if (!shared_key && name_count[col.name] > 1) col.name += "_x";
+    out.cols.push_back(std::move(col));
+  }
+  for (size_t cj = 0; cj < R.cols.size(); ++cj) {
+    if (drop_r[cj]) continue;
+    CatColumn col = gather_col(R.cols[cj], ri_out);
+    if (name_count[col.name] > 1) col.name += "_y";
+    out.cols.push_back(std::move(col));
+  }
+  catalog()[out_id] = std::move(out);
+  return 0;
 }
 
 }  // extern "C"

@@ -83,6 +83,22 @@ int32_t  cylon_catalog_col_info(const char* id, int32_t i,
 int32_t  cylon_catalog_col_read(const char* id, int32_t i,
                                 void* data_out, int64_t data_cap,
                                 uint8_t* validity_out);
+/* Native host hash join (parity: table_api JoinTables behind the JNI
+ * nativeJoin surface, Table.java:289-307; build/probe like
+ * join/hash_join.cpp:22-31). Joins catalog tables left_id and right_id
+ * on n_keys column-index pairs and stores the result under out_id.
+ * join_type: 0 inner, 1 left, 2 right, 3 full outer. Null keys match
+ * null keys (pandas merge semantics). Output columns follow the device
+ * join (ops/join.py _assemble): same-NAME key pairs emit one coalesced
+ * column (right copy dropped); differently-named keys stay separate;
+ * remaining name collisions get the _x/_y suffixes.
+ * Returns 0, or negative on error (-2 missing id, -3 bad key index,
+ * -4 key dtype mismatch). */
+int32_t  cylon_catalog_join(const char* left_id, const char* right_id,
+                            const char* out_id, int32_t n_keys,
+                            const int32_t* left_keys,
+                            const int32_t* right_keys,
+                            int32_t join_type);
 int32_t  cylon_catalog_remove(const char* id);
 int32_t  cylon_catalog_size(void);
 void     cylon_catalog_clear(void);

@@ -56,33 +56,48 @@ def table_aggregate(table: Table, col: str, op: str, quantile: float = 0.5):
     vmask = kernels.valid_mask(cap, table.nrows)
     nulls = _null_flags(c)
     ok = vmask if nulls is None else vmask & (nulls == 0)
+    # overflow poison folds into the scalar on-device (NaN for float
+    # results, -1 for integer ones): a truncated upstream op must never
+    # yield a silently-wrong aggregate, including under whole-query
+    # tracing where no host check can run (same convention as
+    # dist_aggregate)
+    nr = table.nrows
+    bad = ((nr > cap) if getattr(nr, "ndim", 0) == 0
+           else jnp.zeros((), bool))
+
+    def _guard(val):
+        val = jnp.asarray(val)
+        if jnp.issubdtype(val.dtype, jnp.floating):
+            return jnp.where(bad, jnp.full((), jnp.nan, val.dtype), val)
+        return jnp.where(bad, jnp.asarray(-1, val.dtype), val)
 
     data = c.data
     if op == "count":
-        return ok.sum(dtype=jnp.int64)
+        return _guard(ok.sum(dtype=jnp.int64))
     if op == "nunique":
         gid, num_groups, _ = kernels.dense_group_ids(
             [data], ok, [None])
-        return num_groups.astype(jnp.int64)
+        return _guard(num_groups.astype(jnp.int64))
     if op in ("median", "quantile"):
         q = 0.5 if op == "median" else quantile
-        return _masked_quantile(data, ok, q)
+        return _guard(_masked_quantile(data, ok, q))
     if op == "sum":
         acc = kernels._acc_dtype(data.dtype)
-        return jnp.where(ok, data, jnp.zeros((), data.dtype)).astype(acc).sum()
+        return _guard(
+            jnp.where(ok, data, jnp.zeros((), data.dtype)).astype(acc).sum())
     if op == "min":
         sent = dtypes.sentinel_high(data.dtype)
-        return jnp.where(ok, data, jnp.asarray(sent, data.dtype)).min()
+        return _guard(jnp.where(ok, data, jnp.asarray(sent, data.dtype)).min())
     if op == "max":
         sent = dtypes.sentinel_low(data.dtype)
-        return jnp.where(ok, data, jnp.asarray(sent, data.dtype)).max()
+        return _guard(jnp.where(ok, data, jnp.asarray(sent, data.dtype)).max())
     f = jnp.float64 if data.dtype.itemsize >= 4 else jnp.float32
     vals = jnp.where(ok, data.astype(f), 0.0)
     n = ok.sum(dtype=f)
     s = vals.sum()
     if op == "mean":
-        return s / jnp.maximum(n, 1.0)
+        return _guard(s / jnp.maximum(n, 1.0))
     sq = (vals * vals).sum()
     var = (sq - s * s / jnp.maximum(n, 1.0)) / jnp.maximum(n - 1.0, 1.0)
     var = jnp.maximum(var, 0.0)
-    return jnp.sqrt(var) if op == "std" else var
+    return _guard(jnp.sqrt(var) if op == "std" else var)

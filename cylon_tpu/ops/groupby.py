@@ -28,6 +28,7 @@ from cylon_tpu.errors import InvalidArgument
 from cylon_tpu.ops import kernels
 from cylon_tpu.ops.selection import (_null_flags, columns_to_payloads,
                                      payloads_to_columns, take_columns)
+from cylon_tpu.platform import platform_jit
 from cylon_tpu.table import Table
 
 #: ops supported (parity: aggregate_kernels.hpp:40-52 + pandas extras).
@@ -68,8 +69,8 @@ def groupby_aggregate(table: Table, by: Sequence[str],
                              out_cap=out_cap, quantile=float(quantile))
 
 
-@functools.partial(jax.jit, static_argnames=("by", "aggs", "out_cap",
-                                             "quantile"))
+@functools.partial(platform_jit, static_argnames=("by", "aggs", "out_cap",
+                                                  "quantile"))
 def _groupby_compiled(table: Table, *, by, aggs, out_cap,
                       quantile) -> Table:
     cap = table.capacity
@@ -121,7 +122,7 @@ def _groupby_compiled(table: Table, *, by, aggs, out_cap,
             raise InvalidArgument(f"unknown aggregation {op!r}")
         out[name] = _aggregate_column(stab, src, op, gid_s, num_groups,
                                       out_cap, quantile)
-    return Table(out, num_groups)
+    return kernels.carry_overflow(Table(out, num_groups), table)
 
 
 def _aggregate_column(table: Table, src: str, op: str, gid, num_groups,

@@ -31,6 +31,7 @@ from cylon_tpu.errors import InvalidArgument
 from cylon_tpu.ops import kernels
 from cylon_tpu.ops.dictenc import unify_dictionaries
 from cylon_tpu.ops.selection import take_columns
+from cylon_tpu.platform import platform_jit
 from cylon_tpu.table import Table
 
 
@@ -40,7 +41,8 @@ def join(left: Table, right: Table, config: JoinConfig | None = None, *,
          right_on: Sequence[str] | str | None = None,
          how: str = "inner",
          suffixes: tuple[str, str] = ("_x", "_y"),
-         out_capacity: int | None = None) -> Table:
+         out_capacity: int | None = None,
+         algorithm: str = "sort") -> Table:
     """Equi-join two tables (parity: ``join::JoinTables`` +
     ``Table::Join``; semantics follow pandas ``merge`` — the reference's
     own python-test oracle).
@@ -49,12 +51,20 @@ def join(left: Table, right: Table, config: JoinConfig | None = None, *,
     ``left.capacity + right.capacity`` — enough for any 1:N join; raise it
     for N:M key duplication). Overflow is detected host-side via
     ``Table.num_rows``.
+
+    ``algorithm`` (parity: ``JoinAlgorithm`` {SORT, HASH},
+    ``join_config.hpp:25-31``): "sort" groups rows by lexicographic key
+    rank; "hash" by murmur bucket with the keys as collision tiebreakers
+    (``kernels.group_sort(hash_first=True)``) — the TPU rendition of the
+    reference's flat_hash_map build/probe. Both are exact; output row
+    sets are identical.
     """
     if config is not None:
         left_on = list(config.left_on)
         right_on = list(config.right_on)
         how = config.join_type.value
         suffixes = (config.left_suffix, config.right_suffix)
+        algorithm = config.algorithm.value
     else:
         if on is not None:
             left_on = right_on = [on] if isinstance(on, str) else list(on)
@@ -68,14 +78,23 @@ def join(left: Table, right: Table, config: JoinConfig | None = None, *,
         # right join = left join with sides swapped, columns re-ordered
         swapped = join(right, left, left_on=right_on, right_on=left_on,
                        how="left", suffixes=(suffixes[1], suffixes[0]),
-                       out_capacity=out_capacity)
+                       out_capacity=out_capacity, algorithm=algorithm)
         return _reorder_right_join(swapped, left, right, left_on, right_on,
                                    suffixes)
     if how not in ("inner", "left", "fullouter"):
         raise InvalidArgument(f"unknown join type {how!r}")
+    if algorithm not in ("sort", "hash"):
+        raise InvalidArgument(f"unknown join algorithm {algorithm!r}")
 
     cl, cr = left.capacity, right.capacity
-    out_cap = out_capacity if out_capacity is not None else cl + cr
+    if out_capacity is not None:
+        out_cap = out_capacity
+    else:
+        # default: enough for any 1:N join; the ambient capacity scale
+        # (cylon_tpu.plan) grows it when a caller's regrow loop retries
+        from cylon_tpu import plan
+
+        out_cap = (cl + cr) * plan.current_scale()
 
     # host-side: dictionary unification (string keys) happens before the
     # traced core — device code only sees codes
@@ -86,21 +105,25 @@ def join(left: Table, right: Table, config: JoinConfig | None = None, *,
     # tunneled device) times hundreds of primitives; jit pays one
     return _join_compiled(left, right, left_on=tuple(left_on),
                           right_on=tuple(right_on), how=how,
-                          suffixes=tuple(suffixes), out_cap=int(out_cap))
+                          suffixes=tuple(suffixes), out_cap=int(out_cap),
+                          algorithm=algorithm)
 
 
-@functools.partial(jax.jit, static_argnames=("left_on", "right_on", "how",
-                                             "suffixes", "out_cap"))
+@functools.partial(platform_jit, static_argnames=("left_on", "right_on",
+                                                  "how", "suffixes",
+                                                  "out_cap", "algorithm"))
 def _join_compiled(left: Table, right: Table, *, left_on, right_on, how,
-                   suffixes, out_cap) -> Table:
+                   suffixes, out_cap, algorithm="sort") -> Table:
     lkeys = [left.column(n).data for n in left_on]
     rkeys = [right.column(n).data for n in right_on]
     lvals = [left.column(n).validity for n in left_on]
     rvals = [right.column(n).validity for n in right_on]
     left_idx, right_idx, total = _join_indices(
-        lkeys, lvals, left.nrows, rkeys, rvals, right.nrows, how, out_cap)
-    return _assemble(left, right, list(left_on), list(right_on),
-                     suffixes, left_idx, right_idx, total, how)
+        lkeys, lvals, left.nrows, rkeys, rvals, right.nrows, how, out_cap,
+        hash_first=algorithm == "hash")
+    res = _assemble(left, right, list(left_on), list(right_on),
+                    suffixes, left_idx, right_idx, total, how)
+    return kernels.carry_overflow(res, left, right)
 
 
 def _aligned_keys(left, right, left_on, right_on):
@@ -129,11 +152,27 @@ def _aligned_keys(left, right, left_on, right_on):
     return left, right, lkeys, rkeys, lvals, rvals
 
 
-def _join_indices(lkeys, lvals, lrows, rkeys, rvals, rrows, how, out_cap):
+def _join_indices(lkeys, lvals, lrows, rkeys, rvals, rrows, how, out_cap,
+                  hash_first: bool = False):
     """Core: (left_idx, right_idx, total) gather plans of length out_cap.
 
     -1 in either index array marks a null (non-matched) side for that
     output row.
+
+    Everything runs in the COMBINED GROUP-SORTED layout from one
+    ``group_sort`` over both sides' keys (side flag as a sub-order key,
+    so each group's left rows precede its right rows). Per-group values
+    — right-run count, right-run start — broadcast to every row by
+    segmented scans (``forward_fill``/``reverse_fill``: cumsum + cummax
+    encodings), NOT by random gathers: on TPU a same-size gather costs
+    ~10x an elementwise scan, and the previous row-order formulation
+    paid an inverse scatter, a second sort, and two [rows] gathers for
+    what three scans now compute in place. The irreducible gathers that
+    remain are the run expansion itself (``packed[parent]``, the right
+    partner lookup) plus the final ``take_columns``. Output order is
+    restored to pandas' (left-frame order; fullouter extras in
+    right-frame order after) by one stable sort of the [out_cap] index
+    pairs.
     """
     cl = lkeys[0].shape[0]
     cr = rkeys[0].shape[0]
@@ -151,64 +190,80 @@ def _join_indices(lkeys, lvals, lrows, rkeys, rvals, rrows, how, out_cap):
     cvalid = jnp.concatenate([kernels.valid_mask(cl, lrows),
                               kernels.valid_mask(cr, rrows)])
 
-    gid, _, _ = kernels.dense_group_ids(ckeys, cvalid, cvals)
-    gl, gr = gid[:cl], gid[cl:]
+    iota_c = jnp.arange(ncomb, dtype=jnp.int32)
+    side = (iota_c >= cl).astype(jnp.uint8)     # left rows sort first
+    gid_s, _, (orig_s,) = kernels.group_sort(
+        ckeys, cvalid, cvals, payloads=[iota_c], hash_first=hash_first,
+        suborder=[side])
 
-    ones_r = jnp.ones(cr, jnp.int32)
-    counts_r = jax.ops.segment_sum(ones_r, gr, num_segments=ncomb)
-    r_start = kernels.exclusive_cumsum(counts_r)
-    r_order = kernels.sort_perm([gr], kernels.valid_mask(cr, rrows))
+    valid_s = gid_s < ncomb
+    is_r = valid_s & (orig_s >= cl)
+    is_l = valid_s & (orig_s < cl)
+    boundary = valid_s & ((gid_s != jnp.roll(gid_s, 1)) | (iota_c == 0))
+    is_end = valid_s & (jnp.roll(boundary, -1) | ~jnp.roll(valid_s, -1)
+                        | (iota_c == ncomb - 1))
 
-    l_valid = kernels.valid_mask(cl, lrows)
-    gl_safe = jnp.clip(gl, 0, ncomb - 1)
-    match_counts = jnp.where(gl < ncomb, counts_r[gl_safe], 0)
-    match_counts = jnp.where(l_valid, match_counts, 0)
+    cum_r = jnp.cumsum(is_r.astype(jnp.int32))
+    cum_l = jnp.cumsum(is_l.astype(jnp.int32))
+    s_g = kernels.forward_fill(boundary, iota_c)
+    rb = kernels.forward_fill(boundary, cum_r - is_r)
+    lb = kernels.forward_fill(boundary, cum_l - is_l)
+    rcnt = kernels.reverse_fill(is_end, cum_r) - rb    # rights in my group
+    lcnt = kernels.reverse_fill(is_end, cum_l) - lb
+    right_start = s_g + lcnt   # sorted position of the group's first right
 
+    match_counts = jnp.where(is_l, rcnt, 0)
     if how == "inner":
         ecounts = match_counts
     else:  # left / fullouter: unmatched left rows still emit one row
-        ecounts = jnp.where(l_valid, jnp.maximum(match_counts, 1), 0)
+        ecounts = jnp.where(is_l, jnp.maximum(match_counts, 1), 0)
 
     # run-length expansion (row i emits ecounts[i] output slots, the
     # static-shape stand-in for the reference's dynamic index vectors,
-    # join/join_utils.hpp:34): scatter each run's row id at its start
-    # offset, running-max fills the run — O(out_cap) scan, ~20x faster
-    # on TPU than a per-slot searchsorted. The per-parent lookups (run
-    # offset, match count, right-run start) ride ONE packed row-gather
-    # instead of three 1D gathers — gathers are per-index-cost-bound on
-    # TPU regardless of row width
+    # join/join_utils.hpp:34): scatter each run's sorted position at its
+    # start offset, running-max fills the run; the per-parent values
+    # (run offset, match count, right-run start, original row) ride ONE
+    # packed row-gather
     offs = kernels.exclusive_cumsum(ecounts)
-    total = (offs[-1] + ecounts[-1] if cl else jnp.int32(0)).astype(jnp.int32)
-    iold = jnp.arange(cl, dtype=jnp.int32)
+    total = (offs[-1] + ecounts[-1] if ncomb else jnp.int32(0)
+             ).astype(jnp.int32)
     start = jnp.where(ecounts > 0, offs, out_cap).astype(jnp.int32)
-    mark = jnp.full(out_cap, -1, jnp.int32).at[start].max(iold, mode="drop")
-    parent = jnp.clip(jax.lax.cummax(mark), 0, max(cl - 1, 0))
-    r_base = r_start[gl_safe]                       # [cl] gather (cheap)
-    packed = jnp.stack([offs.astype(jnp.int32), match_counts, r_base],
-                       axis=1)                      # [cl, 3]
-    g = packed[parent]                              # one [out_cap, 3] gather
+    mark = jnp.full(out_cap, -1, jnp.int32).at[start].max(iota_c,
+                                                          mode="drop")
+    parent = jnp.clip(jax.lax.cummax(mark), 0, max(ncomb - 1, 0))
+    packed = jnp.stack([offs.astype(jnp.int32), match_counts,
+                        right_start, orig_s], axis=1)   # [ncomb, 4]
+    g = packed[parent]                          # one [out_cap, 4] gather
     j = jnp.arange(out_cap, dtype=jnp.int32)
     within = j - g[:, 0]
     matched = g[:, 1] > 0
-    r_pos = g[:, 2] + within
-    right_idx = jnp.where(matched,
-                          r_order[jnp.clip(r_pos, 0, max(cr - 1, 0))], -1)
-    left_idx = parent
+    r_pos = jnp.clip(g[:, 2] + within, 0, max(ncomb - 1, 0))
+    right_idx = jnp.where(matched, orig_s[r_pos] - cl, -1)
+    left_idx = g[:, 3]
 
     if how == "fullouter":
-        r_valid = kernels.valid_mask(cr, rrows)
-        counts_l = jax.ops.segment_sum(jnp.ones(cl, jnp.int32), gl,
-                                       num_segments=ncomb)
-        gr_safe = jnp.clip(gr, 0, ncomb - 1)
-        r_unmatched = r_valid & (gr < ncomb) & (counts_l[gr_safe] == 0)
-        perm_r, n_extra = kernels.compact_mask(r_unmatched, rrows)
-        j = jnp.arange(out_cap, dtype=jnp.int32)
-        shifted = jnp.clip(j - total, 0, max(cr - 1, 0))
-        extra_right = perm_r[shifted]
+        extra_mask = is_r & (lcnt == 0)
+        perm_s, n_extra = kernels.compact_mask(extra_mask, valid_s)
+        shifted = jnp.clip(j - total, 0, max(ncomb - 1, 0))
+        extra_right = orig_s[perm_s[shifted]] - cl
         in_main = j < total
         left_idx = jnp.where(in_main, left_idx, -1)
         right_idx = jnp.where(in_main, right_idx, extra_right)
         total = total + n_extra
+
+    # restore pandas order — left-frame order for matched/left slots,
+    # right-frame order for fullouter extras after them — with one
+    # stable sort of the index pairs (slots of one left row keep their
+    # right-frame order by stability)
+    valid_slot = j < total
+    extra_key = (jnp.uint32(0x80000000)
+                 + jnp.maximum(right_idx, 0).astype(jnp.uint32))
+    okey = jnp.where(valid_slot,
+                     jnp.where(left_idx >= 0,
+                               left_idx.astype(jnp.uint32), extra_key),
+                     jnp.uint32(0xFFFFFFFF))
+    _, left_idx, right_idx = jax.lax.sort(
+        (okey, left_idx, right_idx), num_keys=1, is_stable=True)
 
     return left_idx, right_idx, total
 

@@ -78,8 +78,10 @@ def f64_bits(data: jax.Array) -> jax.Array:
 def float_bits(data: jax.Array) -> jax.Array:
     """Bit pattern of any float array, routing f64 around the TPU
     bitcast hole."""
+    from cylon_tpu.platform import current_platform
+
     udt = _UINT_OF_WIDTH[data.dtype.itemsize]
-    if data.dtype.itemsize == 8 and jax.default_backend() == "tpu":
+    if data.dtype.itemsize == 8 and current_platform() == "tpu":
         return f64_bits(data)
     return jax.lax.bitcast_convert_type(data, udt)
 
@@ -190,7 +192,7 @@ def compact_mask(mask: jax.Array, nrows) -> tuple[jax.Array, jax.Array]:
     """
     cap = mask.shape[0]
     iota = jnp.arange(cap, dtype=jnp.int32)
-    valid = mask & (iota < nrows)
+    valid = mask & valid_mask(cap, nrows)
     keep = (~valid).astype(jnp.uint8)  # 0 = keep -> sorts first; stable
     _, perm = jax.lax.sort((keep, iota), num_keys=1)
     return perm, valid.sum(dtype=jnp.int32)
@@ -201,7 +203,8 @@ def exclusive_cumsum(x: jax.Array) -> jax.Array:
 
 
 def dense_group_ids(keys: Sequence[jax.Array], nrows,
-                    validities: Sequence[jax.Array | None] | None = None
+                    validities: Sequence[jax.Array | None] | None = None,
+                    hash_first: bool = False
                     ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Assign each valid row a dense id in [0, num_groups) such that two
     rows share an id iff their key tuples are equal; ids are ordered by
@@ -223,14 +226,17 @@ def dense_group_ids(keys: Sequence[jax.Array], nrows,
     cap = keys[0].shape[0]
     iota = jnp.arange(cap, dtype=jnp.int32)
     gid_sorted, num_groups, (perm,) = group_sort(keys, nrows, validities,
-                                                 payloads=[iota])
+                                                 payloads=[iota],
+                                                 hash_first=hash_first)
     gid = jnp.zeros(cap, jnp.int32).at[perm].set(gid_sorted, mode="drop")
     return gid, num_groups, perm
 
 
 def group_sort(keys: Sequence[jax.Array], nrows,
                validities: Sequence[jax.Array | None] | None = None,
-               payloads: Sequence[jax.Array] = ()
+               payloads: Sequence[jax.Array] = (),
+               hash_first: bool = False,
+               suborder: Sequence[jax.Array] = ()
                ) -> tuple[jax.Array, jax.Array, list]:
     """One ``lax.sort`` that groups rows by key AND carries ``payloads``
     into group order as sort values.
@@ -246,9 +252,26 @@ def group_sort(keys: Sequence[jax.Array], nrows,
     normalisation, null==null via validity fields, padding last).
     Returns ``(gid_sorted [cap], num_groups, sorted_payloads)`` with
     ``gid_sorted`` monotone and padding slots set to ``cap``.
+
+    ``hash_first`` orders groups by murmur bucket instead of key rank —
+    the TPU rendition of the reference's HASH algorithms (flat_hash_map
+    build/probe, ``join/hash_join.cpp:22-31``): a 32-bit row hash leads
+    the sort operands and the key words act only as collision
+    tiebreakers, so group identity stays exact. Group ids are then NOT
+    key-ordered — fine for joins, wrong for sorted-output callers.
+
+    ``suborder``: extra unsigned sort-key operands ranked BELOW the key
+    columns and ABOVE stability — they order rows *within* a group
+    without splitting it (group boundaries ignore them). The join uses
+    this to place each group's left-side rows before its right-side
+    rows in one sort.
     """
     cap = keys[0].shape[0]
     full_keys = []
+    if hash_first:
+        from cylon_tpu.ops.hash import hash_columns
+
+        full_keys.append(hash_columns(list(keys), validities))
     for i, k in enumerate(keys):
         v = validities[i] if validities is not None else None
         nk = order_key(k)
@@ -261,11 +284,13 @@ def group_sort(keys: Sequence[jax.Array], nrows,
                 full_keys.append(v.astype(jnp.uint8))
     vmask = valid_mask(cap, nrows)
     total_valid = vmask.sum(dtype=jnp.int32)
-    operands = pack_order_keys([(~vmask).astype(jnp.uint8)] + full_keys)
+    key_ops = pack_order_keys([(~vmask).astype(jnp.uint8)] + full_keys)
+    nb = len(key_ops)                    # boundary-relevant operands
+    operands = key_ops + list(suborder)
     nk = len(operands)
     out = jax.lax.sort(tuple(operands) + tuple(payloads), num_keys=nk,
                        is_stable=True)
-    sorted_keys = out[:nk]
+    sorted_keys = out[:nb]
     sorted_payloads = list(out[nk:])
     iota = jnp.arange(cap, dtype=jnp.int32)
     valid_sorted = iota < total_valid
@@ -280,6 +305,48 @@ def group_sort(keys: Sequence[jax.Array], nrows,
                            0).astype(jnp.int32)
     gid_sorted = jnp.where(valid_sorted, gid_sorted, cap)
     return gid_sorted, num_groups, sorted_payloads
+
+
+def forward_fill(mark: jax.Array, val: jax.Array) -> jax.Array:
+    """Broadcast ``val`` forward from marked positions (the most recent
+    mark wins); positions before the first mark get 0.
+
+    This is the segmented-scan building block that replaces random
+    gathers of per-group values: one ``cummax`` over (position, value)
+    encoded into a u64 — an elementwise scan, ~10x cheaper than a
+    same-size gather on TPU.
+    """
+    cap = val.shape[0]
+    iota = jnp.arange(cap, dtype=jnp.uint64)
+    enc = jnp.where(mark,
+                    (iota << jnp.uint64(32))
+                    | val.astype(jnp.uint32).astype(jnp.uint64),
+                    jnp.uint64(0))
+    filled = jax.lax.cummax(enc)
+    return (filled & jnp.uint64(0xFFFFFFFF)).astype(jnp.int32)
+
+
+def reverse_fill(mark: jax.Array, val: jax.Array) -> jax.Array:
+    """Broadcast ``val`` backward from marked positions (the nearest
+    following mark wins); positions after the last mark get 0."""
+    return forward_fill(mark[::-1], val[::-1])[::-1]
+
+
+def carry_overflow(out, *inputs):
+    """Propagate the overflow poison through a local op: if any input
+    table's ``nrows`` exceeds its capacity (an upstream capacity-bounded
+    kernel truncated), mark the output the same way (``nrows =
+    capacity + 1``) so host-side ``num_rows`` still raises after the
+    ops fused into one program (whole-query compilation,
+    :mod:`cylon_tpu.plan`). The distributed analog is
+    ``parallel.shuffle.poison``."""
+    bad = None
+    for t in inputs:
+        b = t.nrows > t.capacity
+        bad = b if bad is None else (bad | b)
+    return out.with_nrows(
+        jnp.where(bad, jnp.asarray(out.capacity + 1, out.nrows.dtype),
+                  out.nrows))
 
 
 def _acc_dtype(dt):

@@ -25,6 +25,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
 
+# re-exported: dist ops wrap tracing in on_platform(mesh platform)
+from cylon_tpu.platform import current_platform, on_platform
+
 # ---------------------------------------------------------------- dispatch
 
 #: group-count ceiling for the matmul segment-sum: above this the dense
@@ -49,12 +52,12 @@ def enabled() -> bool:
         return False
     if m in ("1", "on", "true", "interpret"):
         return True
-    return jax.default_backend() == "tpu"
+    return current_platform() == "tpu"
 
 
 def _interpret() -> bool:
     """Interpret off-TPU so CPU tests execute the same kernels."""
-    return _mode() == "interpret" or jax.default_backend() != "tpu"
+    return _mode() == "interpret" or current_platform() != "tpu"
 
 
 def _vma_varying(x) -> bool:

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 from cylon_tpu.column import Column
 from cylon_tpu.errors import InvalidArgument
 from cylon_tpu.ops import kernels
+from cylon_tpu.platform import platform_jit
 from cylon_tpu.table import Table
 
 
@@ -185,7 +186,8 @@ def filter_table(table: Table, mask: jax.Array) -> Table:
     iota = jnp.arange(cap, dtype=jnp.int32)
     keep = mask & (iota < table.nrows)
     count = keep.sum(dtype=jnp.int32)
-    return permute_by_sort(table, ((~keep).astype(jnp.uint8),), count)
+    return kernels.carry_overflow(
+        permute_by_sort(table, ((~keep).astype(jnp.uint8),), count), table)
 
 
 def sort_table(table: Table, by: Sequence[str], ascending=True,
@@ -199,8 +201,8 @@ def sort_table(table: Table, by: Sequence[str], ascending=True,
                           na_position=na_position)
 
 
-@functools.partial(jax.jit, static_argnames=("by", "ascending",
-                                             "na_position"))
+@functools.partial(platform_jit, static_argnames=("by", "ascending",
+                                                  "na_position"))
 def _sort_compiled(table: Table, *, by, ascending, na_position) -> Table:
     okeys = []
     for name, asc in zip(by, ascending):
@@ -285,7 +287,7 @@ def concat_tables(tables: Sequence[Table], capacity: int | None = None) -> Table
                      else c.validity)
                 validity = validity.at[dest].set(v, mode="drop")
         cols[name] = Column(data, validity, c0.dtype, c0.dictionary)
-    return Table(cols, total)
+    return kernels.carry_overflow(Table(cols, total), *tables)
 
 
 def head(table: Table, n: int) -> Table:

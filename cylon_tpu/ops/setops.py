@@ -23,6 +23,7 @@ from cylon_tpu.ops.dictenc import unify_table_dictionaries
 from cylon_tpu.column import Column
 from cylon_tpu.ops.selection import (columns_to_payloads, payloads_to_columns,
                                      permute_by_sort, take_columns)
+from cylon_tpu.platform import platform_jit
 from cylon_tpu.table import Table
 
 
@@ -56,7 +57,7 @@ def unique(table: Table, cols: Sequence[str] | None = None,
                                         else table.capacity))
 
 
-@functools.partial(jax.jit, static_argnames=("cols", "keep", "out_cap"))
+@functools.partial(platform_jit, static_argnames=("cols", "keep", "out_cap"))
 def _unique_compiled(table: Table, *, cols, keep, out_cap) -> Table:
     """Two payload-carrying sorts, no random gathers (those cost ~10x a
     sort on TPU): (1) group-sort all columns, where each group's
@@ -84,7 +85,8 @@ def _unique_compiled(table: Table, *, cols, keep, out_cap) -> Table:
         [(~is_rep).astype(jnp.uint8), orig_s.astype(jnp.uint32)])
     out = permute_by_sort(Table(sorted_cols, num_groups), operands,
                           num_groups)
-    return _trim_capacity(out, out_cap, num_groups)
+    return kernels.carry_overflow(_trim_capacity(out, out_cap, num_groups),
+                                  table)
 
 
 def _two_table_gids(a: Table, b: Table, cols: Sequence[str] | None):
@@ -131,9 +133,9 @@ def _select_a_groups(a: Table, gid_a, group_keep, ncomb, out_capacity=None):
     keep = mask & (iota < a.nrows)
     count = keep.sum(dtype=jnp.int32)
     out = permute_by_sort(a, ((~keep).astype(jnp.uint8),), count)
-    if out_capacity is None:
-        return out
-    return _trim_capacity(out, out_capacity, count)
+    if out_capacity is not None:
+        out = _trim_capacity(out, out_capacity, count)
+    return kernels.carry_overflow(out, a)
 
 
 def union(a: Table, b: Table, out_capacity: int | None = None) -> Table:

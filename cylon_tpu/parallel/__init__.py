@@ -30,6 +30,7 @@ from cylon_tpu.parallel.task_plan import (
 )
 from cylon_tpu.parallel.dist_ops import (
     dist_aggregate,
+    dist_concat,
     dist_groupby,
     dist_intersect,
     dist_join,
@@ -45,6 +46,7 @@ __all__ = [
     "ReduceOp",
     "all_reduce",
     "dist_aggregate",
+    "dist_concat",
     "dist_groupby",
     "dist_intersect",
     "dist_join",
@@ -67,6 +69,7 @@ __all__ = [
     "distributed_intersect",
     "distributed_subtract",
     "distributed_unique",
+    "distributed_concat",
 ]
 
 # pycylon-style names (table.pyx distributed_join/...): aliases so
@@ -77,3 +80,4 @@ distributed_union = dist_union
 distributed_intersect = dist_intersect
 distributed_subtract = dist_subtract
 distributed_unique = dist_unique
+distributed_concat = dist_concat

@@ -19,13 +19,14 @@ from typing import Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from cylon_tpu import dtypes
 from cylon_tpu.column import Column
 from cylon_tpu.config import SortOptions
 from cylon_tpu.context import CylonEnv, WORKER_AXIS
-from cylon_tpu.errors import InvalidArgument
+from cylon_tpu.errors import InvalidArgument, OutOfCapacity
 from cylon_tpu.ops import groupby as _groupby
 from cylon_tpu.ops.join import join as _join_fn
 from cylon_tpu.ops import kernels, setops as _setops
@@ -63,11 +64,22 @@ def _shard_view(t: Table) -> Table:
 
 
 def _smap(env: CylonEnv, body, n_tables: int, n_out: int = 1):
+    from cylon_tpu.ops import pallas_kernels
+
     spec = P(WORKER_AXIS)
-    return jax.jit(jax.shard_map(
+    fn = jax.jit(jax.shard_map(
         body, mesh=env.mesh,
         in_specs=tuple([spec] * n_tables),
         out_specs=spec if n_out == 1 else tuple([spec] * n_out)))
+
+    def run(*args):
+        # trace under the MESH's platform: with a TPU visible but the
+        # mesh on CPU (the driver's dryrun config), default-backend
+        # dispatch would compile Pallas kernels onto the CPU mesh
+        with pallas_kernels.on_platform(env.platform):
+            return fn(*args)
+
+    return run
 
 
 def _prep(env: CylonEnv, table: Table) -> Table:
@@ -82,8 +94,77 @@ def _key_data(t: Table, cols):
 def _out_cap_local(env, *tables, out_capacity=None, skew=DEFAULT_SKEW):
     if out_capacity is not None:
         return -(-out_capacity // env.world_size)
+    from cylon_tpu import plan
+
     total = sum(dtable.local_capacity(t) for t in tables)
-    return total * skew
+    return total * skew * plan.current_scale()
+
+
+def _shard_cap(t: Table) -> int:
+    """Per-shard capacity of a distributed table — or the full capacity
+    of a local one (the world==1 fast paths feed local tables through
+    ``_adaptive`` too)."""
+    return (dtable.local_capacity(t) if dtable.is_distributed(t)
+            else t.capacity)
+
+
+def _adaptive(build, args, adaptive: bool):
+    """Dispatch ``build()(*args)`` with automatic capacity regrow.
+
+    The reference's exchange allocates receives as counts arrive, so any
+    skew fits (``net/ops/all_to_all.hpp:65-170``). Static XLA shapes
+    force an a-priori bound instead; when every bound was *defaulted*
+    (``adaptive``), overflow triggers a re-dispatch at double the
+    ambient :func:`cylon_tpu.plan.capacity_scale` — power-of-2 buckets
+    keep the shape space (and compile count) small, and the persistent
+    compilation cache makes retries cheap. Explicit caller capacities
+    keep the raise-on-overflow contract.
+
+    ``build`` must read the ambient scale while constructing its
+    capacity bounds (via ``_out_cap_local``). Under an outer trace
+    (whole-query compilation) row counts are tracers — the check is
+    skipped here and :class:`cylon_tpu.plan.CompiledQuery` regrows the
+    whole program instead.
+
+    Cost note: the overflow check is one host fetch of the [W] count
+    vector per eager op (~100 ms on a tunneled chip, microseconds
+    locally). Latency-critical eager chains can pass explicit
+    capacities (no check, classic raise-on-overflow), wrap the chain in
+    :func:`cylon_tpu.plan.compile_query` (one check for the whole
+    query), or set ``CYLON_TPU_ADAPTIVE=0`` to restore round-1
+    fire-and-check-at-materialisation behaviour globally.
+    """
+    import os
+
+    from cylon_tpu import plan
+
+    if os.environ.get("CYLON_TPU_ADAPTIVE", "1") in ("0", "off", "false"):
+        adaptive = False
+    scale = plan.current_scale()
+    while True:
+        with plan.capacity_scale(scale):
+            out = build()(*args)
+        if not adaptive or isinstance(out.nrows, jax.core.Tracer):
+            return out
+        counts = dtable.host_counts(out)         # host sync
+        cap_l = _shard_cap(out)
+        if (counts <= cap_l).all():
+            return out
+        # regrow cannot repair an INPUT that already overflowed some
+        # upstream explicit bound — its data is truncated for good
+        for t in args:
+            tc = dtable.host_counts(t)
+            if (tc > _shard_cap(t)).any():
+                raise OutOfCapacity(
+                    f"input shard row counts {tc.tolist()} exceed its "
+                    f"capacity — an upstream op overflowed an explicit "
+                    f"out_capacity")
+        if scale >= plan.MAX_SCALE:
+            raise OutOfCapacity(
+                f"shard row counts {counts.tolist()} still exceed local "
+                f"capacity {cap_l} at {scale}x the default budget; pass "
+                f"an explicit out_capacity")
+        scale *= 2
 
 
 # ------------------------------------------------------------------ shuffle
@@ -102,21 +183,25 @@ def shuffle(env: CylonEnv, table: Table, key_cols: Sequence[str],
     if partitioning not in ("hash", "modulo"):
         raise InvalidArgument(f"unknown partitioning {partitioning!r}")
     table = _prep(env, table)
-    out_l = _out_cap_local(env, table, out_capacity=out_capacity)
     w = env.world_size
 
-    def body(t):
-        lt, inof = _checked_local(t)
-        keys, vals = _key_data(lt, key_cols)
-        if partitioning == "hash":
-            pid = partition_ids(keys, w, vals)
-        else:
-            pid = modulo_partition_ids(keys, w)
-        res, of = checked_recv(shuffle_local(lt, pid, out_l, bucket_cap),
-                               out_l)
-        return _shard_view(poison(res, inof, of))
+    def build():
+        out_l = _out_cap_local(env, table, out_capacity=out_capacity)
 
-    return _smap(env, body, 1)(table)
+        def body(t):
+            lt, inof = _checked_local(t)
+            keys, vals = _key_data(lt, key_cols)
+            if partitioning == "hash":
+                pid = partition_ids(keys, w, vals)
+            else:
+                pid = modulo_partition_ids(keys, w)
+            res, of = checked_recv(
+                shuffle_local(lt, pid, out_l, bucket_cap), out_l)
+            return _shard_view(poison(res, inof, of))
+
+        return _smap(env, body, 1)
+
+    return _adaptive(build, (table,), out_capacity is None)
 
 
 @traced("repartition")
@@ -125,22 +210,26 @@ def repartition(env: CylonEnv, table: Table,
     """Round-robin row rebalancing (parity: Java ``roundRobinPartition``,
     ``Table.java:191`` / ``ModuloPartitionKernel``)."""
     table = _prep(env, table)
-    out_l = _out_cap_local(env, table, out_capacity=out_capacity)
     w = env.world_size
     cap_l = dtable.local_capacity(table)
 
-    def body(t):
-        lt, inof = _checked_local(t)
-        n = lt.nrows
-        counts = jax.lax.all_gather(n[None], WORKER_AXIS).reshape(-1)
-        me = jax.lax.axis_index(WORKER_AXIS)
-        offset = (jnp.cumsum(counts) - counts)[me]
-        pid = ((offset + jnp.arange(cap_l, dtype=jnp.int32)) % w
-               ).astype(jnp.int32)
-        res, of = checked_recv(shuffle_local(lt, pid, out_l), out_l)
-        return _shard_view(poison(res, inof, of))
+    def build():
+        out_l = _out_cap_local(env, table, out_capacity=out_capacity)
 
-    return _smap(env, body, 1)(table)
+        def body(t):
+            lt, inof = _checked_local(t)
+            n = lt.nrows
+            counts = jax.lax.all_gather(n[None], WORKER_AXIS).reshape(-1)
+            me = jax.lax.axis_index(WORKER_AXIS)
+            offset = (jnp.cumsum(counts) - counts)[me]
+            pid = ((offset + jnp.arange(cap_l, dtype=jnp.int32)) % w
+                   ).astype(jnp.int32)
+            res, of = checked_recv(shuffle_local(lt, pid, out_l), out_l)
+            return _shard_view(poison(res, inof, of))
+
+        return _smap(env, body, 1)
+
+    return _adaptive(build, (table,), out_capacity is None)
 
 
 # -------------------------------------------------------------------- join
@@ -148,7 +237,8 @@ def repartition(env: CylonEnv, table: Table,
 def dist_join(env: CylonEnv, left: Table, right: Table, *,
               on=None, left_on=None, right_on=None, how: str = "inner",
               suffixes=("_x", "_y"), out_capacity: int | None = None,
-              shuffle_capacity: int | None = None) -> Table:
+              shuffle_capacity: int | None = None,
+              algorithm: str = "sort") -> Table:
     """Distributed equi-join (parity: ``DistributedJoin``, table.cpp:476:
     shuffle both tables by key hash, then local join — here a single
     fused XLA program; world==1 short-circuits to the local join like
@@ -161,10 +251,17 @@ def dist_join(env: CylonEnv, left: Table, right: Table, *,
     if env.world_size == 1:
         lt = dtable.gather_table(env, left) if dtable.is_distributed(left) else left
         rt = dtable.gather_table(env, right) if dtable.is_distributed(right) else right
-        res = _join_fn(lt, rt, left_on=left_on, right_on=right_on,
-                         how=how, suffixes=suffixes,
-                         out_capacity=out_capacity)
-        return res.with_nrows(res.nrows.reshape(1))
+
+        def build1():
+            def run(l, r):
+                res = _join_fn(l, r, left_on=left_on, right_on=right_on,
+                               how=how, suffixes=suffixes,
+                               out_capacity=out_capacity,
+                               algorithm=algorithm)
+                return res.with_nrows(res.nrows.reshape(1))
+            return run
+
+        return _adaptive(build1, (lt, rt), out_capacity is None)
 
     left = _prep(env, left)
     right = _prep(env, right)
@@ -181,27 +278,35 @@ def dist_join(env: CylonEnv, left: Table, right: Table, *,
             right = right.add_column(rn, rc2)
 
     w = env.world_size
-    shuf_l = _out_cap_local(env, left, out_capacity=shuffle_capacity)
-    shuf_r = _out_cap_local(env, right, out_capacity=shuffle_capacity)
-    if out_capacity is None:
-        join_l = shuf_l + shuf_r
-    else:
-        join_l = -(-out_capacity // w)
 
-    def body(lt, rt):
-        ltab, liof = _checked_local(lt)
-        rtab, riof = _checked_local(rt)
-        lkeys, lvals = _key_data(ltab, left_on)
-        rkeys, rvals = _key_data(rtab, right_on)
-        lpid = partition_ids(lkeys, w, lvals)
-        rpid = partition_ids(rkeys, w, rvals)
-        lsh, lof = checked_recv(shuffle_local(ltab, lpid, shuf_l), shuf_l)
-        rsh, rof = checked_recv(shuffle_local(rtab, rpid, shuf_r), shuf_r)
-        res = _join_fn(lsh, rsh, left_on=left_on, right_on=right_on,
-                       how=how, suffixes=suffixes, out_capacity=join_l)
-        return _shard_view(poison(res, liof, riof, lof, rof))
+    def build():
+        shuf_l = _out_cap_local(env, left, out_capacity=shuffle_capacity)
+        shuf_r = _out_cap_local(env, right, out_capacity=shuffle_capacity)
+        if out_capacity is None:
+            join_l = shuf_l + shuf_r
+        else:
+            join_l = -(-out_capacity // w)
 
-    return _smap(env, body, 2)(left, right)
+        def body(lt, rt):
+            ltab, liof = _checked_local(lt)
+            rtab, riof = _checked_local(rt)
+            lkeys, lvals = _key_data(ltab, left_on)
+            rkeys, rvals = _key_data(rtab, right_on)
+            lpid = partition_ids(lkeys, w, lvals)
+            rpid = partition_ids(rkeys, w, rvals)
+            lsh, lof = checked_recv(shuffle_local(ltab, lpid, shuf_l),
+                                    shuf_l)
+            rsh, rof = checked_recv(shuffle_local(rtab, rpid, shuf_r),
+                                    shuf_r)
+            res = _join_fn(lsh, rsh, left_on=left_on, right_on=right_on,
+                           how=how, suffixes=suffixes, out_capacity=join_l,
+                           algorithm=algorithm)
+            return _shard_view(poison(res, liof, riof, lof, rof))
+
+        return _smap(env, body, 2)
+
+    return _adaptive(build, (left, right),
+                     out_capacity is None and shuffle_capacity is None)
 
 
 # ----------------------------------------------------------------- groupby
@@ -230,37 +335,50 @@ def dist_groupby(env: CylonEnv, table: Table, by: Sequence[str],
                        for _, op, _ in aggs)
     # the shuffle buffer scales with ROW volume (raw rows, or one partial
     # row per sender per group), never with the caller's group-count bound
-    shuf_l = _out_cap_local(env, table, out_capacity=shuffle_capacity)
     out_l = None if out_capacity is None else -(-out_capacity // w)
+    adaptive = shuffle_capacity is None and out_capacity is None
 
     if not decomposable:
-        def body(t):
-            lt, inof = _checked_local(t)
-            keys, vals = _key_data(lt, by)
-            pid = partition_ids(keys, w, vals)
-            sh, of = checked_recv(shuffle_local(lt, pid, shuf_l), shuf_l)
-            res = _groupby.groupby_aggregate(sh, by, aggs,
-                                             out_capacity=out_l,
-                                             quantile=quantile)
-            return _shard_view(poison(res, inof, of))
+        def build():
+            shuf_l = _out_cap_local(env, table,
+                                    out_capacity=shuffle_capacity)
 
-        return _smap(env, body, 1)(table)
+            def body(t):
+                lt, inof = _checked_local(t)
+                keys, vals = _key_data(lt, by)
+                pid = partition_ids(keys, w, vals)
+                sh, of = checked_recv(shuffle_local(lt, pid, shuf_l),
+                                      shuf_l)
+                res = _groupby.groupby_aggregate(sh, by, aggs,
+                                                 out_capacity=out_l,
+                                                 quantile=quantile)
+                return _shard_view(poison(res, inof, of))
+
+            return _smap(env, body, 1)
+
+        return _adaptive(build, (table,), adaptive)
 
     # pre-combine plan: user agg -> partial columns + final merge + post
     pre, final, post = _combine_plan(aggs)
 
-    def body(t):
-        lt, inof = _checked_local(t)
-        part = _groupby.groupby_aggregate(lt, by, pre)
-        keys, vals = _key_data(part, by)
-        pid = partition_ids(keys, w, vals)
-        # partials are at most cap_local groups; shuffle at same size
-        sh, of = checked_recv(shuffle_local(part, pid, shuf_l), shuf_l)
-        res = _groupby.groupby_aggregate(sh, by, final, out_capacity=out_l)
-        res = post(res)
-        return _shard_view(poison(res, inof, of))
+    def build():
+        shuf_l = _out_cap_local(env, table, out_capacity=shuffle_capacity)
 
-    return _smap(env, body, 1)(table)
+        def body(t):
+            lt, inof = _checked_local(t)
+            part = _groupby.groupby_aggregate(lt, by, pre)
+            keys, vals = _key_data(part, by)
+            pid = partition_ids(keys, w, vals)
+            # partials are at most cap_local groups; shuffle at same size
+            sh, of = checked_recv(shuffle_local(part, pid, shuf_l), shuf_l)
+            res = _groupby.groupby_aggregate(sh, by, final,
+                                             out_capacity=out_l)
+            res = post(res)
+            return _shard_view(poison(res, inof, of))
+
+        return _smap(env, body, 1)
+
+    return _adaptive(build, (table,), adaptive)
 
 
 def _combine_plan(aggs):
@@ -346,40 +464,86 @@ def dist_sort(env: CylonEnv, table: Table, by: Sequence[str] | str,
         asc = list(ascending)
     options = options or SortOptions()
     nsamp = options.num_samples or 1024
+    nbins = options.num_bins or 0
     table = _prep(env, table)
     w = env.world_size
+
+    def build():
+        out_l = _out_cap_local(env, table, out_capacity=out_capacity)
+        return _smap(env, _sort_body(env, table, by, asc0, asc, nsamp,
+                                     nbins, out_l, w), 1)
+
+    return _adaptive(build, (table,), out_capacity is None)
+
+
+def _sort_body(env, table, by, asc0, asc, nsamp, nbins, out_l, w):
     cap_l = dtable.local_capacity(table)
-    out_l = _out_cap_local(env, table, out_capacity=out_capacity)
 
     def body(t):
         lt, inof = _checked_local(t)
         c = t.column(by[0])
         key = kernels.order_key(c.data, asc0)
+        hi_sent = jnp.asarray(dtypes.sentinel_high(key.dtype), key.dtype)
         if c.validity is not None:
             # nulls partition to the top range (they sort last)
-            key = jnp.where(c.validity, key,
-                            jnp.asarray(dtypes.sentinel_high(key.dtype),
-                                        key.dtype))
+            key = jnp.where(c.validity, key, hi_sent)
+        if jnp.issubdtype(c.data.dtype, jnp.floating):
+            # raw NaNs sort last locally (na_position="last") regardless
+            # of direction — the partition key must agree or NaN rows
+            # land on the wrong shard under descending order
+            key = jnp.where(jnp.isnan(c.data), hi_sent, key)
         n = lt.nrows
-        # strided sample of the locally sorted keys
-        perm = kernels.sort_perm([key], n)
-        sk = key[perm]
-        take_i = (jnp.arange(nsamp) * jnp.maximum(n, 1)) // nsamp
-        take_i = jnp.clip(take_i, 0, jnp.maximum(n - 1, 0)).astype(jnp.int32)
-        samples = jnp.where(n > 0, sk[take_i],
-                            jnp.asarray(dtypes.sentinel_high(key.dtype),
-                                        key.dtype))
-        allsamp = jax.lax.all_gather(samples, WORKER_AXIS).reshape(-1)
-        allsamp = jnp.sort(allsamp)
-        tot = allsamp.shape[0]
-        cut = (jnp.arange(1, w, dtype=jnp.int32) * tot) // w
-        splitters = allsamp[cut]
-        pid = jnp.searchsorted(splitters, key, side="left").astype(jnp.int32)
+        if nbins:
+            # histogram splitters (parity: RangePartitionKernel,
+            # arrow_partition_kernels.cpp:334-421 — distributed MinMax,
+            # fixed-width histogram, allreduce of bin counts, quantile
+            # split points; pmin/pmax/psum replace the two
+            # mpi::AllReduce rounds). Equal keys share a bin, so equal
+            # first-key values never straddle shards.
+            vmask = kernels.valid_mask(cap_l, n)
+            hi = jnp.asarray(dtypes.sentinel_high(key.dtype), key.dtype)
+            lo = jnp.asarray(0, key.dtype)
+            kmin = jax.lax.pmin(jnp.where(vmask, key, hi).min(),
+                                WORKER_AXIS)
+            kmax = jax.lax.pmax(jnp.where(vmask, key, lo).max(),
+                                WORKER_AXIS)
+            kf = key.astype(jnp.float64)
+            span = jnp.maximum(kmax.astype(jnp.float64)
+                               - kmin.astype(jnp.float64), 1.0)
+            rel = (kf - kmin.astype(jnp.float64)) / span
+            bins = jnp.clip((rel * nbins).astype(jnp.int32), 0, nbins - 1)
+            hist = jax.ops.segment_sum(vmask.astype(jnp.int32), bins,
+                                       num_segments=nbins)
+            hist = jax.lax.psum(hist, WORKER_AXIS)
+            cum = jnp.cumsum(hist)
+            total = cum[-1]
+            targets = (jnp.arange(1, w) * total) // w
+            split_bin = jnp.searchsorted(cum, targets,
+                                         side="left").astype(jnp.int32)
+            pid = jnp.searchsorted(split_bin, bins,
+                                   side="left").astype(jnp.int32)
+        else:
+            # strided sample of the locally sorted keys
+            perm = kernels.sort_perm([key], n)
+            sk = key[perm]
+            take_i = (jnp.arange(nsamp) * jnp.maximum(n, 1)) // nsamp
+            take_i = jnp.clip(take_i, 0,
+                              jnp.maximum(n - 1, 0)).astype(jnp.int32)
+            samples = jnp.where(n > 0, sk[take_i],
+                                jnp.asarray(dtypes.sentinel_high(key.dtype),
+                                            key.dtype))
+            allsamp = jax.lax.all_gather(samples, WORKER_AXIS).reshape(-1)
+            allsamp = jnp.sort(allsamp)
+            tot = allsamp.shape[0]
+            cut = (jnp.arange(1, w, dtype=jnp.int32) * tot) // w
+            splitters = allsamp[cut]
+            pid = jnp.searchsorted(splitters, key,
+                                   side="left").astype(jnp.int32)
         sh, of = checked_recv(shuffle_local(lt, pid, out_l), out_l)
         return _shard_view(poison(_sort_table(sh, by, ascending=asc),
                                   inof, of))
 
-    return _smap(env, body, 1)(table)
+    return body
 
 
 # ----------------------------------------------------------------- set ops
@@ -389,23 +553,27 @@ def _dist_setop(env, a, b, local_op, out_capacity):
     a, b = unify_table_dictionaries([a, b])
     cols = a.column_names
     w = env.world_size
-    shuf_a = _out_cap_local(env, a, out_capacity=None)
-    shuf_b = _out_cap_local(env, b, out_capacity=None)
     out_l = None if out_capacity is None else -(-out_capacity // w)
 
-    def body(ta, tb):
-        la, ina = _checked_local(ta)
-        lb, inb = _checked_local(tb)
-        ka, va = _key_data(la, cols)
-        kb, vb = _key_data(lb, cols)
-        sa, ofa = checked_recv(
-            shuffle_local(la, partition_ids(ka, w, va), shuf_a), shuf_a)
-        sb, ofb = checked_recv(
-            shuffle_local(lb, partition_ids(kb, w, vb), shuf_b), shuf_b)
-        return _shard_view(poison(local_op(sa, sb, out_l),
-                                  ina, inb, ofa, ofb))
+    def build():
+        shuf_a = _out_cap_local(env, a, out_capacity=None)
+        shuf_b = _out_cap_local(env, b, out_capacity=None)
 
-    return _smap(env, body, 2)(a, b)
+        def body(ta, tb):
+            la, ina = _checked_local(ta)
+            lb, inb = _checked_local(tb)
+            ka, va = _key_data(la, cols)
+            kb, vb = _key_data(lb, cols)
+            sa, ofa = checked_recv(
+                shuffle_local(la, partition_ids(ka, w, va), shuf_a), shuf_a)
+            sb, ofb = checked_recv(
+                shuffle_local(lb, partition_ids(kb, w, vb), shuf_b), shuf_b)
+            return _shard_view(poison(local_op(sa, sb, out_l),
+                                      ina, inb, ofa, ofb))
+
+        return _smap(env, body, 2)
+
+    return _adaptive(build, (a, b), out_capacity is None)
 
 
 @traced("dist_union")
@@ -445,17 +613,53 @@ def dist_unique(env: CylonEnv, table: Table,
     table = _prep(env, table)
     names = cols if cols is not None else table.column_names
     w = env.world_size
-    shuf_l = _out_cap_local(env, table, out_capacity=out_capacity)
 
-    def body(t):
-        lt, inof = _checked_local(t)
-        keys, vals = _key_data(lt, names)
-        pid = partition_ids(keys, w, vals)
-        sh, of = checked_recv(shuffle_local(lt, pid, shuf_l), shuf_l)
-        return _shard_view(poison(_setops.unique(sh, cols, keep=keep),
-                                  inof, of))
+    def build():
+        shuf_l = _out_cap_local(env, table, out_capacity=out_capacity)
 
-    return _smap(env, body, 1)(table)
+        def body(t):
+            lt, inof = _checked_local(t)
+            keys, vals = _key_data(lt, names)
+            pid = partition_ids(keys, w, vals)
+            sh, of = checked_recv(shuffle_local(lt, pid, shuf_l), shuf_l)
+            return _shard_view(poison(_setops.unique(sh, cols, keep=keep),
+                                      inof, of))
+
+        return _smap(env, body, 1)
+
+    return _adaptive(build, (table,), out_capacity is None)
+
+
+# ------------------------------------------------------------------ concat
+@traced("dist_concat")
+def dist_concat(env: CylonEnv, tables: Sequence[Table]) -> Table:
+    """Distributed concatenation (parity: pycylon ``distributed_concat``,
+    ``table.pyx:2398``): every shard concatenates its local blocks —
+    NO rows move between shards or to the host (the reference likewise
+    concatenates per-rank). Global row order is therefore shard-major
+    (shard s holds inputs' s-th blocks back to back), matching the
+    reference's rank-local semantics, not pandas' frame-major order.
+    """
+    if not tables:
+        raise InvalidArgument("concat of no tables")
+    from cylon_tpu.ops.selection import concat_tables
+
+    tables = [_prep(env, t) for t in tables]
+
+    def build():
+        def body(*ts):
+            locs, flags = [], []
+            for t in ts:
+                lt, inof = _checked_local(t)
+                locs.append(lt)
+                flags.append(inof)
+            res = concat_tables(locs)
+            return _shard_view(poison(res, *flags))
+
+        return _smap(env, body, len(tables))
+
+    # output capacity is the sum of input capacities: cannot overflow
+    return _adaptive(build, tuple(tables), False)
 
 
 # -------------------------------------------------------------- aggregates
@@ -469,12 +673,27 @@ def dist_aggregate(env: CylonEnv, table: Table, col: str, op: str,
     from cylon_tpu.ops.selection import _null_flags
 
     table = _prep(env, table)
-    dtable.dist_num_rows(table)  # OutOfCapacity if any shard is poisoned
+    traced_in = isinstance(table.nrows, jax.core.Tracer)
+    if not traced_in:
+        dtable.dist_num_rows(table)  # OutOfCapacity if a shard is poisoned
     w = env.world_size
     cap_l = dtable.local_capacity(table)
 
     def body(t):
         lt = _local_view(t)
+        # input-poison flag, folded into the result on-device: under
+        # whole-query tracing the host check above is impossible, and a
+        # truncated upstream op must not yield a silently-wrong scalar
+        # (NaN for float results, -1 for integer ones)
+        in_bad = jax.lax.psum((lt.nrows > lt.capacity).astype(jnp.int32),
+                              WORKER_AXIS) > 0
+        lt = lt.with_nrows(jnp.minimum(lt.nrows, lt.capacity))
+        val = _agg_value(lt)
+        if jnp.issubdtype(val.dtype, jnp.floating):
+            return jnp.where(in_bad, jnp.full((), jnp.nan, val.dtype), val)
+        return jnp.where(in_bad, jnp.asarray(-1, val.dtype), val)
+
+    def _agg_value(lt):
         c = lt.column(col)
         vmask = kernels.valid_mask(cap_l, lt.nrows)
         nulls = _null_flags(c)
@@ -539,7 +758,10 @@ def dist_aggregate(env: CylonEnv, table: Table, col: str, op: str,
             return jnp.sqrt(var)
         raise InvalidArgument(f"unknown aggregate {op!r}")
 
+    from cylon_tpu.ops import pallas_kernels
+
     fn = jax.jit(jax.shard_map(body, mesh=env.mesh,
                                in_specs=(P(WORKER_AXIS),),
                                out_specs=P()))
-    return fn(table)
+    with pallas_kernels.on_platform(env.platform):
+        return fn(table)

@@ -40,10 +40,24 @@ def local_capacity(table: Table) -> int:
     return cap // w
 
 
+def host_counts(table: Table) -> np.ndarray:
+    """Per-shard row counts on the host. Under multi-controller
+    (``jax.distributed``) the [W] vector is sharded across processes —
+    a plain ``np.asarray`` would die on non-addressable shards, so it
+    rides a process_allgather there (the reference's equivalent is each
+    rank knowing only its own count plus explicit MPI exchanges)."""
+    nrows = table.nrows
+    if getattr(nrows, "is_fully_addressable", True):
+        return np.asarray(nrows)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(nrows, tiled=True))
+
+
 def dist_num_rows(table: Table) -> int:
     """Total valid rows across shards (host sync). Raises OutOfCapacity
     if any shard overflowed its local buffer."""
-    counts = np.asarray(table.nrows)
+    counts = host_counts(table)
     cap_l = local_capacity(table)
     if (counts > cap_l).any():
         from cylon_tpu.errors import OutOfCapacity
@@ -107,9 +121,17 @@ def gather_table(env: "CylonEnv | None", table: Table) -> Table:
     from cylon_tpu.ops import kernels
     from cylon_tpu.ops.selection import take_columns
 
-    dist_num_rows(table)  # raises OutOfCapacity on any poisoned shard
+    if not isinstance(table.nrows, jax.core.Tracer):
+        dist_num_rows(table)  # raises OutOfCapacity on any poisoned shard
+    cap_l = local_capacity(table)
     mask = dist_row_mask(table)
-    total = table.nrows.sum().astype(jnp.int32)
+    counts = jnp.minimum(table.nrows, cap_l)
+    total = counts.sum().astype(jnp.int32)
+    # under whole-query tracing the host check above is skipped — carry
+    # shard poison into the local-table convention (nrows > capacity)
+    # so the final materialisation still raises
+    bad = (table.nrows > cap_l).any()
+    total = jnp.where(bad, jnp.int32(table.capacity + 1), total)
     keep = (~mask).astype(jnp.uint8)
     iota = jnp.arange(table.capacity, dtype=jnp.int32)
     _, perm = jax.lax.sort((keep, iota), num_keys=1)

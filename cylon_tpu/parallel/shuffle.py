@@ -41,12 +41,17 @@ from cylon_tpu.table import Table
 
 
 def _use_ragged() -> bool:
+    # keyed off the EXECUTION platform (the mesh's, pinned by the dist
+    # ops), not jax.default_backend(): XLA:CPU has no ragged-all-to-all
+    # thunk, and a TPU being visible doesn't mean we run on it
+    from cylon_tpu.platform import current_platform
+
     mode = os.environ.get("CYLON_TPU_SHUFFLE", "auto")
     if mode == "ragged":
         return True
     if mode == "padded":
         return False
-    return jax.default_backend() not in ("cpu",)
+    return current_platform() not in ("cpu",)
 
 
 def exchange_arrays(arrays, pid, n_local, out_cap: int,
@@ -92,12 +97,15 @@ def exchange_arrays(arrays, pid, n_local, out_cap: int,
         outs = []
         for a in arrays:
             a_sorted = a[order]
-            transport, restore = _transportable(a_sorted)
-            buf = jnp.zeros((out_cap,) + transport.shape[1:], transport.dtype)
-            res = jax.lax.ragged_all_to_all(
-                transport, buf, in_offs, counts, out_offs, recv_sizes,
-                axis_name=axis_name)
-            outs.append(restore(res))
+            parts, restore = _transportable(a_sorted)
+            got = []
+            for transport in parts:
+                buf = jnp.zeros((out_cap,) + transport.shape[1:],
+                                transport.dtype)
+                got.append(jax.lax.ragged_all_to_all(
+                    transport, buf, in_offs, counts, out_offs, recv_sizes,
+                    axis_name=axis_name))
+            outs.append(restore(got))
         n_recv = jnp.where(n_recv_true > out_cap, out_cap + 1, n_recv_true)
         return outs, n_recv.astype(jnp.int32)
 
@@ -123,23 +131,27 @@ def exchange_arrays(arrays, pid, n_local, out_cap: int,
     compact_perm = None
     for a in arrays:
         a_sorted = a[order]
-        transport, restore = _transportable(a_sorted)
-        buf = jnp.zeros((w * b,) + transport.shape[1:], transport.dtype)
-        buf = buf.at[slot].set(transport, mode="drop")
-        swapped = jax.lax.all_to_all(buf.reshape((w, b) + transport.shape[1:]),
-                                     axis_name, split_axis=0, concat_axis=0)
-        flat = swapped.reshape((w * b,) + transport.shape[1:])
-        if compact_perm is None:
-            _, compact_perm = jax.lax.sort(
-                (keep, jnp.arange(w * b, dtype=jnp.int32)), num_keys=1)
-        compacted = flat[compact_perm]
-        if w * b >= out_cap:
-            compacted = compacted[:out_cap]
-        else:
-            pad = jnp.zeros((out_cap - w * b,) + transport.shape[1:],
-                            transport.dtype)
-            compacted = jnp.concatenate([compacted, pad])
-        outs.append(restore(compacted))
+        parts, restore = _transportable(a_sorted)
+        got = []
+        for transport in parts:
+            buf = jnp.zeros((w * b,) + transport.shape[1:], transport.dtype)
+            buf = buf.at[slot].set(transport, mode="drop")
+            swapped = jax.lax.all_to_all(
+                buf.reshape((w, b) + transport.shape[1:]),
+                axis_name, split_axis=0, concat_axis=0)
+            flat = swapped.reshape((w * b,) + transport.shape[1:])
+            if compact_perm is None:
+                _, compact_perm = jax.lax.sort(
+                    (keep, jnp.arange(w * b, dtype=jnp.int32)), num_keys=1)
+            compacted = flat[compact_perm]
+            if w * b >= out_cap:
+                compacted = compacted[:out_cap]
+            else:
+                pad = jnp.zeros((out_cap - w * b,) + transport.shape[1:],
+                                transport.dtype)
+                compacted = jnp.concatenate([compacted, pad])
+            got.append(compacted)
+        outs.append(restore(got))
 
     # fold all failure modes into an impossible row count:
     # - a (sender,dest) bucket overflowed somewhere (psum of flags)
@@ -175,10 +187,43 @@ def poison(table: Table, *flags):
 
 
 def _transportable(a):
-    """bool arrays ride collectives as uint8."""
+    """Transport-safe operands for one array + restore fn.
+
+    bool rides as uint8. On TPU, 64-bit columns split into two 32-bit
+    words: the x64-emulation rewriter has no lowering for
+    ``ragged-all-to-all`` over s64/f64 ("While rewriting computation to
+    not contain X64 element types ... not implemented"), and the split
+    is lossless — integer lo/hi words exactly, and the f32 (hi, lo)
+    pair IS the precision the emulated f64 carries on this hardware.
+    """
+    from cylon_tpu.platform import current_platform
+
     if a.dtype == jnp.bool_:
-        return a.astype(jnp.uint8), lambda x: x.astype(jnp.bool_)
-    return a, lambda x: x
+        return [a.astype(jnp.uint8)], lambda xs: xs[0].astype(jnp.bool_)
+    if a.dtype.itemsize == 8 and current_platform() == "tpu":
+        if jnp.issubdtype(a.dtype, jnp.floating):
+            # (hi, lo) f32 pair. TPU's emulated f64 already has an
+            # f32-like exponent range, so magnitudes outside it are
+            # inf/0 on-device before they ever reach the wire — the
+            # ±inf/0 degradation below matches hardware semantics.
+            hi = a.astype(jnp.float32)
+            lo = jnp.where(jnp.isfinite(a) & jnp.isfinite(hi),
+                           (a - hi.astype(jnp.float64)).astype(jnp.float32),
+                           jnp.float32(0))
+            return [hi, lo], lambda xs: (xs[0].astype(jnp.float64)
+                                         + xs[1].astype(jnp.float64))
+        dt = a.dtype
+        u = a.astype(jnp.uint64)
+        lo = (u & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+        hi = (u >> jnp.uint64(32)).astype(jnp.uint32)
+
+        def restore(xs):
+            v = ((xs[1].astype(jnp.uint64) << jnp.uint64(32))
+                 | xs[0].astype(jnp.uint64))
+            return v.astype(dt)
+
+        return [lo, hi], restore
+    return [a], lambda xs: xs[0]
 
 
 def shuffle_local(table: Table, pid, out_cap: int,

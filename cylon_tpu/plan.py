@@ -1,0 +1,212 @@
+"""Whole-query compilation: one XLA program per query.
+
+The reference's answer to multi-operator queries is the L7 streaming
+op-graph — ``DisJoinOP`` builds partition→shuffle→split→join chains and
+overlaps their progress on table chunks (``ops/dis_join_op.cpp:21-72``,
+schedulers ``ops/execution/execution.hpp:43-110``). That machinery
+exists because eager C++ operators would otherwise serialise on the
+network.
+
+The TPU-first reimagining: **the query is a traced function**. Every
+operator in this framework is jit-safe (static capacities, no
+data-dependent host control flow), so an entire query —
+filter→join→join→groupby→sort→head — compiles into ONE XLA executable
+in which the compiler overlaps compute and ICI collectives at the
+instruction level (what the reference's RoundRobin/Priority schedulers
+approximate by hand). Host involvement drops to one dispatch plus one
+result fetch — on a tunneled chip (~100 ms/sync) this collapses the
+5-10 per-operator syncs an eager chain pays.
+
+Two pieces:
+
+* :func:`capacity_scale` / :func:`current_scale` — an ambient multiplier
+  applied to every *defaulted* capacity bound chosen while tracing.
+  Powers of two keep the shape space (and hence compile count) bounded.
+* :func:`compile_query` — wrap a query function (Tables/DataFrames in,
+  Table/DataFrame out) into a compiled, capacity-adaptive callable:
+  run at scale 1; if any result shard overflowed its buffer
+  (``OutOfCapacity``), double the scale and re-dispatch. The XLA
+  compilation cache (persistent, see ``cylon_tpu/__init__``) makes the
+  retry cheap; steady-state reruns hit the right scale's executable
+  directly via :class:`CompiledQuery`'s scale memo.
+"""
+
+import contextlib
+import contextvars
+import functools
+
+import jax
+
+from cylon_tpu.errors import OutOfCapacity
+
+__all__ = ["capacity_scale", "current_scale", "compile_query",
+           "CompiledQuery", "MAX_SCALE"]
+
+#: regrow ceiling: 2^10 = 1024x the default budget. Buffers grow only as
+#: far as the retry that fits (geometric, ~10 re-dispatches worst case);
+#: past this the workload is a near-cross-join and the caller should set
+#: an explicit capacity or rethink the keys. Device memory, not this
+#: constant, is the practical bound — the reference behaves the same way
+#: (its dynamically allocated receives simply OOM on a true cross join).
+MAX_SCALE = 1024
+
+_SCALE: contextvars.ContextVar = contextvars.ContextVar(
+    "cylon_capacity_scale", default=1)
+
+
+@contextlib.contextmanager
+def capacity_scale(scale: int):
+    """Ambient multiplier for defaulted capacity bounds (trace-time)."""
+    tok = _SCALE.set(int(scale))
+    try:
+        yield
+    finally:
+        _SCALE.reset(tok)
+
+
+def current_scale() -> int:
+    return _SCALE.get()
+
+
+def _result_tables(out):
+    """Tables reachable in a query result (pytree of Tables/DataFrames)."""
+    from cylon_tpu.table import Table
+
+    found = []
+
+    def visit(x):
+        if isinstance(x, Table):
+            found.append(x)
+            return
+        # DataFrame and containers
+        t = getattr(x, "table", None)
+        if isinstance(t, Table):
+            found.append(t)
+            return
+        if isinstance(x, (list, tuple)):
+            for v in x:
+                visit(v)
+        elif isinstance(x, dict):
+            for v in x.values():
+                visit(v)
+
+    visit(out)
+    return found
+
+
+def _check_overflow(out) -> None:
+    """Host-side: raise OutOfCapacity if any result shard overflowed
+    (poisoned nrows > local capacity — see ``parallel.shuffle.poison``)."""
+    import numpy as np
+
+    from cylon_tpu.parallel import dtable
+
+    for t in _result_tables(out):
+        if dtable.is_distributed(t):
+            dtable.dist_num_rows(t)
+        else:
+            n = int(np.asarray(t.nrows))
+            if n > t.capacity:
+                raise OutOfCapacity(
+                    f"result rows {n} exceed capacity {t.capacity}")
+
+
+class CompiledQuery:
+    """A query function compiled to one XLA program per capacity scale.
+
+    Call it like the original function. Table/DataFrame/array arguments
+    (positional or keyword, possibly nested in dicts/lists) are traced;
+    every other argument must be hashable and becomes part of the
+    compile key.
+    """
+
+    def __init__(self, fn, *, check=True):
+        self._fn = fn
+        self._check = check
+        self._scale_memo: dict = {}  # static key -> known-good scale
+
+        def traced(scale, static_pos, static_kw, dyn_pos, **dyn_kw):
+            n = len(static_pos) + len(dyn_pos)
+            slots = dict(static_pos)
+            dyn_idx = (i for i in range(n) if i not in slots)
+            slots.update(zip(dyn_idx, dyn_pos))
+            with capacity_scale(scale):
+                return fn(*(slots[i] for i in range(n)),
+                          **dict(static_kw), **dyn_kw)
+
+        self._jitted = jax.jit(traced, static_argnums=(0, 1, 2))
+
+    def __call__(self, *args, **kwargs):
+        dyn_pos, static_pos, static_kw, dyn_kw = _split_args(args, kwargs)
+        key = (static_pos, static_kw)
+        scale = self._scale_memo.get(key, 1)
+        while True:
+            out = self._jitted(scale, static_pos, static_kw,
+                               tuple(dyn_pos), **dyn_kw)
+            if not self._check:
+                return out
+            try:
+                _check_overflow(out)
+            except OutOfCapacity:
+                if scale >= MAX_SCALE:
+                    raise
+                scale *= 2
+                continue
+            self._scale_memo[key] = scale
+            return out
+
+
+def _is_dynamic(x) -> bool:
+    import numpy as np
+
+    from cylon_tpu.table import Table
+
+    if isinstance(x, Table) or hasattr(x, "table"):
+        return True
+    if isinstance(x, (list, tuple)):
+        return any(_is_dynamic(v) for v in x)
+    if isinstance(x, dict):
+        return any(_is_dynamic(v) for v in x.values())
+    return isinstance(x, (jax.Array, np.ndarray))
+
+
+def _split_args(args, kwargs):
+    """Partition the call's arguments into traced (Tables/DataFrames/
+    arrays, nested ok) and static (everything else, made hashable).
+    Positional statics are carried as (index, value) pairs so the traced
+    wrapper can reassemble the original argument order."""
+    dyn_pos, static_pos = [], []
+    for i, v in enumerate(args):
+        if _is_dynamic(v):
+            dyn_pos.append(v)
+        else:
+            static_pos.append((i, _hashable(v)))
+    static_kw, dyn_kw = [], {}
+    for k, v in kwargs.items():
+        if _is_dynamic(v):
+            dyn_kw[k] = v
+        else:
+            static_kw.append((k, _hashable(v)))
+    return dyn_pos, tuple(static_pos), tuple(sorted(static_kw)), dyn_kw
+
+
+def _hashable(v):
+    if isinstance(v, list):
+        return tuple(_hashable(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _hashable(x)) for k, x in v.items()))
+    if isinstance(v, set):
+        return frozenset(_hashable(x) for x in v)
+    return v
+
+
+def compile_query(fn=None, *, check: bool = True):
+    """Decorator/wrapper: compile a whole query into one XLA program
+    with automatic capacity regrow (see module docstring).
+
+    ``check=False`` skips the host-side overflow check (and its one
+    device sync) — for callers that inspect ``num_rows`` themselves.
+    """
+    if fn is None:
+        return functools.partial(compile_query, check=check)
+    return functools.wraps(fn)(CompiledQuery(fn, check=check))

@@ -270,12 +270,16 @@ class Series:
 
     # -- reductions ------------------------------------------------------
     def _reduce(self, op: str):
+        import jax
+
         from cylon_tpu.ops import aggregates
         from cylon_tpu.table import Table
 
         t = Table({self.name or "x": self._col}, self._nrows)
-        return np.asarray(
-            aggregates.table_aggregate(t, self.name or "x", op))[()]
+        res = aggregates.table_aggregate(t, self.name or "x", op)
+        if isinstance(res, jax.core.Tracer):
+            return res  # under whole-query trace: stay on device
+        return np.asarray(res)[()]
 
     def sum(self): return self._reduce("sum")
     def count(self): return self._reduce("count")

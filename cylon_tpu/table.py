@@ -173,6 +173,8 @@ class Table:
         """
         if self.capacity <= only_above:
             return self
+        if getattr(self.nrows, "ndim", 0):  # distributed [W] counts
+            return self
         from cylon_tpu.errors import OutOfCapacity
 
         try:
@@ -180,8 +182,7 @@ class Table:
         except OutOfCapacity:  # poison must propagate, not be trimmed
             return self
         except (jax.errors.TracerIntegerConversionError,
-                jax.errors.ConcretizationTypeError,
-                TypeError):  # abstract nrows (under trace) / vector nrows
+                jax.errors.ConcretizationTypeError):  # under jit trace
             return self
         bucket = max(min_capacity, 1 << max(n - 1, 0).bit_length())
         if bucket < self.capacity:

@@ -14,5 +14,43 @@ from cylon_tpu.tpch.queries import (q1, q2, q3, q4, q5, q6, q7, q8, q9,
                                     q10, q11, q12, q13, q14, q15, q16,
                                     q17, q18, q19, q20, q21, q22)
 
-__all__ = ["generate", "generate_pandas", "date_int"] + [
+_COMPILED: dict = {}
+
+
+def compiled(q):
+    """Whole-query-compiled variant of a TPC-H query: the entire
+    multi-operator pipeline traces into ONE XLA program
+    (:mod:`cylon_tpu.plan`) — one dispatch + one result fetch instead of
+    an eager per-operator chain (each host sync costs ~100 ms on a
+    tunneled chip). This is the compiled reimagining of the reference's
+    L7 streaming engine (``ops/dis_join_op.cpp:21-72``).
+
+    ``tpch.compiled("q3")(data, env=env)`` — same signature as the eager
+    query; scalar-returning queries (q6/q14/q17) yield a 0-d device
+    array instead of a float.
+    """
+    import functools
+
+    from cylon_tpu import plan
+    from cylon_tpu.tpch import queries as _q
+
+    fn = getattr(_q, q) if isinstance(q, str) else q
+    if fn not in _COMPILED:
+        _COMPILED[fn] = plan.compile_query(fn)
+    cq = _COMPILED[fn]
+
+    @functools.wraps(fn)
+    def run(data, **kw):
+        # device coercion is a host-side step — it must happen before
+        # tracing (Table.from_pydict can't consume tracers)
+        from cylon_tpu.frame import DataFrame
+
+        data = {k: v if isinstance(v, DataFrame) else DataFrame(v)
+                for k, v in data.items()}
+        return cq(data, **kw)
+
+    return run
+
+
+__all__ = ["generate", "generate_pandas", "date_int", "compiled"] + [
     f"q{i}" for i in range(1, 23)]

@@ -203,10 +203,19 @@ def generate(sf: float = 0.01, seed: int = 0) -> Mapping[str, dict]:
     n_li = len(l_orderkey)
     l_orderdate = np.repeat(o_orderdate, per_order)
     l_shipdate = (l_orderdate + rng.integers(1, 122, n_li)).astype(np.int32)
+    # spec: every (l_partkey, l_suppkey) pair exists in partsupp — the
+    # supplier is one of the part's 4 assigned suppliers (same base/step
+    # arithmetic progression as partsupp above). Q9/Q20 join lineitem to
+    # partsupp on both keys; independent draws would make only ~4/S of
+    # lineitems survive those joins.
+    l_partkey = rng.integers(1, n_part + 1, n_li).astype(np.int64)
+    l_suppkey = ((base[l_partkey - 1]
+                  + rng.integers(0, 4, n_li) * step[l_partkey - 1])
+                 % n_supp + 1).astype(np.int64)
     lineitem = {
         "l_orderkey": l_orderkey,
-        "l_partkey": rng.integers(1, n_part + 1, n_li).astype(np.int64),
-        "l_suppkey": rng.integers(1, n_supp + 1, n_li).astype(np.int64),
+        "l_partkey": l_partkey,
+        "l_suppkey": l_suppkey,
         "l_quantity": rng.integers(1, 51, n_li).astype(np.int64),
         "l_extendedprice": np.round(rng.uniform(900.0, 105_000.0, n_li), 2),
         "l_discount": np.round(rng.integers(0, 11, n_li) / 100.0, 2),

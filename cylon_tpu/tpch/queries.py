@@ -27,6 +27,17 @@ from cylon_tpu.table import Table
 from cylon_tpu.tpch.dbgen import date_int
 
 
+def _scalar(x):
+    """Host float of a device scalar — except under whole-query tracing
+    (:mod:`cylon_tpu.plan`), where it stays a traced 0-d value so the
+    query compiles into one program (the runner converts at the end)."""
+    import jax
+
+    if isinstance(x, jax.core.Tracer):
+        return x
+    return float(x)
+
+
 def _df(x) -> DataFrame:
     if isinstance(x, DataFrame):
         return x
@@ -392,13 +403,16 @@ def q14(data: Mapping, env=None, date_from: int | None = None,
     if env is not None:
         from cylon_tpu.parallel import dist_aggregate
 
-        total = float(dist_aggregate(env, t2, "revenue", "sum"))
-        promo_sum = float(dist_aggregate(env, t2, "promo_rev", "sum"))
+        total = _scalar(dist_aggregate(env, t2, "revenue", "sum"))
+        promo_sum = _scalar(dist_aggregate(env, t2, "promo_rev", "sum"))
     else:
         df2 = DataFrame._wrap(t2)
-        total = float(df2.series("revenue").sum())
-        promo_sum = float(df2.series("promo_rev").sum())
-    return 100.0 * promo_sum / total if total else 0.0
+        total = _scalar(df2.series("revenue").sum())
+        promo_sum = _scalar(df2.series("promo_rev").sum())
+    # trace-safe zero-denominator guard (`if total` would branch on a
+    # traced scalar under whole-query compilation)
+    return jnp.where(total == 0, 0.0, 100.0 * promo_sum
+                     / jnp.where(total == 0, 1.0, total))
 
 
 def q18(data: Mapping, env=None, threshold: int = 300,
@@ -492,8 +506,8 @@ def q19(data: Mapping, env=None,
     if env is not None:
         from cylon_tpu.parallel import dist_aggregate
 
-        return float(dist_aggregate(env, t2, "sel_rev", "sum"))
-    return float(DataFrame._wrap(t2).series("sel_rev").sum())
+        return _scalar(dist_aggregate(env, t2, "sel_rev", "sum"))
+    return _scalar(DataFrame._wrap(t2).series("sel_rev").sum())
 
 
 def q7(data: Mapping, env=None, nation1: str = "FRANCE",
@@ -726,7 +740,7 @@ def q11(data: Mapping, env=None, nation: str = "GERMANY",
                  how="inner", env=env)
     g = j.groupby(["ps_partkey"], env=env).agg(
         [("value", "sum", "value")])._materialized()
-    total = float(g.series("value").sum())
+    total = _scalar(g.series("value").sum())
     keep = g.table.column("value").data > (fraction * total)
     out = g[jnp.asarray(keep)]
     return out.sort_values(["value"], ascending=[False])[
@@ -843,7 +857,7 @@ def q15(data: Mapping, env=None, date_from: int | None = None,
     li = _with_revenue(li)[["l_suppkey", "revenue"]]
     g = li.groupby(["l_suppkey"], env=env).agg(
         [("revenue", "sum", "total_revenue")])._materialized()
-    mx = float(g.series("total_revenue").max())
+    mx = _scalar(g.series("total_revenue").max())
     top = g[jnp.asarray(g.table.column("total_revenue").data
                         >= jnp.float64(mx))]
     out = top.merge(supplier[["s_suppkey", "s_name"]],
@@ -889,8 +903,8 @@ def q17(data: Mapping, env=None, brand: str = "Brand#23",
     if env is not None:
         from cylon_tpu.parallel import dist_aggregate
 
-        return float(dist_aggregate(env, t2, "sel_price", "sum")) / 7.0
-    return float(DataFrame._wrap(t2).series("sel_price").sum()) / 7.0
+        return _scalar(dist_aggregate(env, t2, "sel_price", "sum")) / 7.0
+    return _scalar(DataFrame._wrap(t2).series("sel_price").sum()) / 7.0
 
 
 def q16(data: Mapping, env=None, brand: str = "Brand#45",
@@ -1085,7 +1099,7 @@ def q22(data: Mapping, env=None,
     cust = cust[["c_custkey", "c_acctbal", "cntrycode"]]
     bal = cust.table.column("c_acctbal").data
     pos = cust[jnp.asarray(bal > 0.0)]
-    avg = float(pos.series("c_acctbal").mean())
+    avg = _scalar(pos.series("c_acctbal").mean())
     cand = cust[jnp.asarray(cust.table.column("c_acctbal").data > avg)]
 
     active = orders[["o_custkey"]].drop_duplicates(["o_custkey"],

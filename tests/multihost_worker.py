@@ -1,0 +1,56 @@
+"""Worker process for the 2-process jax.distributed smoke test.
+
+Each process owns 2 virtual CPU devices; the 4-device mesh spans both.
+This is the CPU stand-in for a multi-host TPU pod (DCN-spanning mesh) —
+the reference's analog is every test running under ``mpirun -np {2,4}``
+(``cpp/test/CMakeLists.txt:44-50``).
+"""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np
+import pandas as pd
+
+
+def main():
+    addr, nproc, pid = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+    from cylon_tpu import CylonEnv, Table, TPUConfig
+    from cylon_tpu.parallel import dist_join, dist_num_rows
+
+    env = CylonEnv(TPUConfig(multihost=True, coordinator_address=addr,
+                             num_processes=nproc, process_id=pid))
+    assert env.world_size == 2 * nproc, env.world_size
+    assert env.rank == pid
+
+    # identical data in every process (single-program SPMD: device_put
+    # of the full host array places only this process's shards)
+    rng = np.random.default_rng(9)
+    n = 256
+    lk = rng.integers(0, 40, n).astype(np.int64)
+    rk = rng.integers(0, 40, n).astype(np.int64)
+    a = rng.normal(size=n)
+    b = rng.normal(size=n)
+    left = Table.from_pydict({"k": lk, "a": a})
+    right = Table.from_pydict({"k": rk, "b": b})
+
+    j = dist_join(env, left, right, on="k", how="inner",
+                  out_capacity=64 * n, shuffle_capacity=8 * n)
+    got = dist_num_rows(j)
+    want = len(pd.DataFrame({"k": lk}).merge(pd.DataFrame({"k": rk}),
+                                             on="k"))
+    assert got == want, (got, want)
+    env.barrier()
+    print(f"MULTIHOST-OK rank={pid} world={env.world_size} rows={got}",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

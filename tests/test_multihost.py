@@ -1,0 +1,53 @@
+"""2-process ``jax.distributed`` smoke test on CPU.
+
+Executes the ``TPUConfig.multihost`` path (``context.py`` →
+``jax.distributed.initialize``) for real: two OS processes, each owning
+2 virtual CPU devices, one 4-device mesh spanning both, one dist_join
+over it. The CPU analog of the reference's ``mpirun -np 2`` CI runs
+(``cpp/test/CMakeLists.txt:44-50``; UCX-over-MPI bootstrap
+``net/ucx/ucx_communicator.cpp:50-97``).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_dist_join():
+    port = _free_port()
+    addr = f"127.0.0.1:{port}"
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)   # worker sets its own device count
+    env.pop("JAX_PLATFORMS", None)
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in [REPO, env.get("PYTHONPATH", "")] if p)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, os.path.join(HERE, "multihost_worker.py"),
+             addr, "2", str(i)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True)
+        for i in range(2)
+    ]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append((p.returncode, out, err))
+    for rc, out, err in outs:
+        assert rc == 0, f"rc={rc}\nstderr tail:\n{err[-3000:]}"
+        assert "MULTIHOST-OK" in out

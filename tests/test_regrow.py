@@ -1,0 +1,131 @@
+"""Capacity auto-regrow + whole-query compilation.
+
+The reference handles arbitrary skew by construction — receives are
+allocated as counts arrive (``net/ops/all_to_all.hpp:65-170``). Static
+XLA shapes force an a-priori bound; these tests pin the restored
+contract: any skew succeeds with NO manual capacities, via re-dispatch
+at doubled capacity scale (``parallel.dist_ops._adaptive``,
+``plan.CompiledQuery``).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from cylon_tpu import Table
+from cylon_tpu.errors import OutOfCapacity
+from cylon_tpu.ops.groupby import groupby_aggregate
+from cylon_tpu.ops.join import join
+from cylon_tpu.ops.selection import filter_table, sort_table
+from cylon_tpu.parallel import (dist_join, dist_groupby, dist_sort,
+                                dist_to_pandas, dist_unique)
+from cylon_tpu.plan import compile_query
+
+
+def _sorted(df, by):
+    return df.sort_values(by).reset_index(drop=True)
+
+
+def test_skewed_join_no_manual_capacity(env8, rng):
+    """~40% of rows share one key: an N:M blowup far past the default
+    skew headroom AND a hot shard — both must regrow transparently."""
+    n = 512
+    k1 = np.where(rng.random(n) < 0.4, 7,
+                  rng.integers(0, 10_000, n)).astype(np.int64)
+    k2 = np.where(rng.random(n) < 0.4, 7,
+                  rng.integers(0, 10_000, n)).astype(np.int64)
+    a = rng.normal(size=n)
+    b = rng.normal(size=n)
+    j = dist_join(env8, Table.from_pydict({"k": k1, "a": a}),
+                  Table.from_pydict({"k": k2, "b": b}),
+                  on="k", how="inner")
+    got = dist_to_pandas(env8, j)
+    exp = pd.DataFrame({"k": k1, "a": a}).merge(
+        pd.DataFrame({"k": k2, "b": b}), on="k")
+    assert len(got) == len(exp)
+    pd.testing.assert_frame_equal(_sorted(got, ["k", "a", "b"]),
+                                  _sorted(exp, ["k", "a", "b"]))
+
+
+def test_all_equal_keys_dist_sort_degrades(env8, rng):
+    """Degenerate splitters (all keys equal) route every row to one
+    shard — must succeed via regrow, not raise (VERDICT r1 weak #4)."""
+    n = 512
+    v = rng.normal(size=n)
+    t = Table.from_pydict({"k": np.full(n, 3, np.int64), "v": v})
+    s = dist_sort(env8, t, ["k"])
+    got = dist_to_pandas(env8, s)
+    assert len(got) == n
+    assert (got["k"] == 3).all()
+
+
+def test_skewed_groupby_and_unique(env8, rng):
+    n = 512
+    k = np.where(rng.random(n) < 0.5, 1,
+                 rng.integers(0, 10_000, n)).astype(np.int64)
+    v = rng.normal(size=n)
+    t = Table.from_pydict({"k": k, "v": v})
+    g = dist_to_pandas(env8, dist_groupby(env8, t, ["k"],
+                                          [("v", "sum", "s")]))
+    exp = pd.DataFrame({"k": k, "v": v}).groupby("k")["v"].sum() \
+        .reset_index(name="s")
+    pd.testing.assert_frame_equal(_sorted(g, ["k"]), _sorted(exp, ["k"]))
+
+    u = dist_to_pandas(env8, dist_unique(env8, t, ["k"]))
+    assert len(u) == len(np.unique(k))
+
+
+def test_explicit_capacity_still_raises(env8, rng):
+    """An explicit out_capacity is a contract: no silent regrow."""
+    n = 256
+    k = np.zeros(n, np.int64)  # all-equal keys: join size n*n
+    t = Table.from_pydict({"k": k, "v": rng.normal(size=n)})
+    j = dist_join(env8, t, t, on="k", how="inner", out_capacity=n,
+                  shuffle_capacity=4 * n)
+    with pytest.raises(OutOfCapacity):
+        dist_to_pandas(env8, j)
+
+
+def test_compiled_query_fuses_and_regrows(rng):
+    """filter->join->groupby->sort as ONE jitted program; the join's
+    default capacity overflows (N:M dup keys) and the whole program
+    re-dispatches at a doubled scale (plan.CompiledQuery)."""
+
+    @compile_query
+    def q(l, r, cutoff=None):
+        lf = filter_table(l, l.column("v").data > cutoff)
+        j = join(lf, r, on="k", how="inner")
+        g = groupby_aggregate(j, ["k"], [("v", "sum", "s")])
+        return sort_table(g, ["s"], ascending=False)
+
+    n = 1000
+    k1 = rng.integers(0, 50, n).astype(np.int64)
+    k2 = rng.integers(0, 50, n).astype(np.int64)
+    v = rng.normal(size=n)
+    w = rng.normal(size=n)
+    out = q(Table.from_pydict({"k": k1, "v": v}),
+            Table.from_pydict({"k": k2, "w": w}), cutoff=0.0)
+    got = out.to_pandas().reset_index(drop=True)
+
+    lp = pd.DataFrame({"k": k1, "v": v})
+    exp = (lp[lp.v > 0]
+           .merge(pd.DataFrame({"k": k2, "w": w}), on="k")
+           .groupby("k")["v"].sum().reset_index(name="s")
+           .sort_values("s", ascending=False).reset_index(drop=True))
+    pd.testing.assert_frame_equal(got, exp, check_dtype=False)
+    # the found scale is memoized: later calls skip the regrow probe
+    assert list(q._scale_memo.values()) == [8]
+
+
+def test_local_overflow_poison_propagates(rng):
+    """A truncated local join feeding a groupby must poison the final
+    result (kernels.carry_overflow) — under whole-query fusion there is
+    no host check between ops."""
+    n = 64
+    k = np.zeros(n, np.int64)
+    l = Table.from_pydict({"k": k, "v": rng.normal(size=n)})
+    r = Table.from_pydict({"k": k, "w": rng.normal(size=n)})
+    j = join(l, r, on="k", how="inner", out_capacity=n)  # true size n*n
+    g = groupby_aggregate(j, ["k"], [("v", "sum", "s")])
+    with pytest.raises(OutOfCapacity):
+        g.num_rows

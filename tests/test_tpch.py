@@ -770,3 +770,33 @@ def test_q22(data, pdfs, env4):
                  {"totacctbal"})
     _frame_close(q22(data2, env=env4, codes=codes).to_pandas(), want,
                  {"totacctbal"})
+
+
+# ------------------------------------------------------- compiled queries
+def test_compiled_queries_match_eager(data):
+    """Whole-query compilation (tpch.compiled / cylon_tpu.plan): the
+    fused one-program execution must agree with the eager per-operator
+    chain — including a scalar-returning query (q6) and the regrow
+    path (join capacities default under trace)."""
+    from cylon_tpu import tpch
+    from cylon_tpu.frame import DataFrame
+
+    for qn in ("q3", "q5", "q1"):
+        eager = getattr(tpch, qn)(data).to_pandas()
+        comp = tpch.compiled(qn)(data).to_pandas()
+        assert len(eager) == len(comp)
+        pd.testing.assert_frame_equal(comp.reset_index(drop=True),
+                                      eager.reset_index(drop=True),
+                                      check_dtype=False)
+    assert np.isclose(float(tpch.compiled("q6")(data)),
+                      float(tpch.q6(data)))
+
+
+def test_compiled_query_distributed(data, env4):
+    from cylon_tpu import tpch
+
+    eager = tpch.q3(data, env=env4).to_pandas()
+    comp = tpch.compiled("q3")(data, env=env4).to_pandas()
+    pd.testing.assert_frame_equal(comp.reset_index(drop=True),
+                                  eager.reset_index(drop=True),
+                                  check_dtype=False)
