@@ -30,6 +30,7 @@ from cylon_tpu.ops_graph.graph import (
     GroupByOp,
     JoinOp,
     PartitionOp,
+    ShuffleOp,
     UnionOp,
 )
 
@@ -43,6 +44,7 @@ __all__ = [
     "JoinOp",
     "Op",
     "PartitionOp",
+    "ShuffleOp",
     "PriorityExecution",
     "RootOp",
     "RoundRobinExecution",

@@ -4,10 +4,18 @@ Parity: ``cpp/src/cylon/ops/`` kernels and builders — ``PartitionOp``
 (``ops/partition_op.cpp``), ``JoinOp``/``UnionOp`` (``ops/join_op.cpp``,
 ``ops/union_op.cpp``), and the graph builders ``DisJoinOP``/``DisUnionOp``
 (``ops/dis_join_op.cpp:21-72``: per-relation chain partition → shuffle →
-split → shared join). Here the shuffle/split stages collapse into tag
-routing (a chunk's tag IS its logical partition), since data movement
-between logical partitions inside one host is free — the heavy exchange
-path lives in ``cylon_tpu.parallel.shuffle``.
+split → shared join).
+
+Two execution modes:
+
+* local (``env=None``): the shuffle/split stages collapse into tag
+  routing (a chunk's tag IS its logical partition) — data movement
+  between logical partitions inside one host is free;
+* distributed (``env=CylonEnv``): :class:`ShuffleOp` runs the REAL mesh
+  all-to-all per chunk as it arrives, and the terminal op finishes with
+  shard-local compute on the key-co-located accumulation
+  (``parallel.dist_ops.colocated_join/unique``) — the reference's
+  incremental exchange with its comm/compute overlap, on ICI.
 """
 
 from typing import Callable, Iterable, Sequence
@@ -57,6 +65,39 @@ class PartitionOp(Op):
             yield TableChunk(p, filter_table(table, pid == p))
 
 
+class ShuffleOp(Op):
+    """The mesh exchange stage of the streaming graph: every incoming
+    chunk immediately hash-shuffles over the device mesh
+    (``parallel.dist_ops.shuffle`` — count exchange + ragged/padded
+    all-to-all on ICI), emerging as a key-co-located DISTRIBUTED chunk.
+
+    This is the true analog of the reference's AllToAllOp inside
+    ``DisJoinOP`` (``ops/dis_join_op.cpp:34-71``): communication runs
+    per chunk while the host slices and ingests the next one — the
+    comm/compute overlap the reference's progress loop provides by
+    hand, supplied here by XLA's async dispatch (each chunk's shuffle
+    program is in flight on the mesh while Python prepares its
+    successor; the explicit lossless capacity below keeps the path
+    sync-free).
+    """
+
+    def __init__(self, op_id: int, key_cols: Sequence[str], env):
+        super().__init__(op_id, name="ShuffleOp")
+        self._keys = list(key_cols)
+        self._env = env
+
+    def execute(self, tag: int, table: Table):
+        from cylon_tpu.parallel.dist_ops import shuffle
+
+        keys = self._keys or table.column_names
+        # lossless bound — a chunk can at worst land on one shard, so
+        # out_l == chunk capacity always fits; explicit capacity means
+        # no adaptive host sync and fully asynchronous dispatch
+        out_cap = table.capacity * self._env.world_size
+        yield TableChunk(tag, shuffle(self._env, table, keys,
+                                      out_capacity=out_cap))
+
+
 class _SidePort(Op):
     """Adapter routing chunks into one side of a binary op (the
     reference distinguishes relations by tag ranges in
@@ -78,9 +119,10 @@ class JoinOp(Op):
     ``ops/kernels/join_kernel.cpp`` — the reference also concatenates a
     relation's queued chunks before the local join)."""
 
-    def __init__(self, op_id: int, **join_kw):
+    def __init__(self, op_id: int, env=None, **join_kw):
         super().__init__(op_id, name="JoinOp")
         self._kw = join_kw
+        self._env = env
         self._buf: dict[int, tuple[list, list]] = {}
 
     def left_port(self, op_id: int) -> Op:
@@ -100,6 +142,21 @@ class JoinOp(Op):
                 # per chunk, so a truly absent side means the relation got
                 # no input at all
                 continue
+            if self._env is not None:
+                # chunks are mesh-distributed and key-co-located
+                # (ShuffleOp): concatenate shard-locally, join per shard
+                from cylon_tpu.parallel import colocated_join, dist_concat
+
+                lt = (dist_concat(self._env, lefts)
+                      if len(lefts) > 1 else lefts[0])
+                rt = (dist_concat(self._env, rights)
+                      if len(rights) > 1 else rights[0])
+                # defaulted capacities already regrow + verify inside
+                # colocated_join; explicit overflow poison surfaces at
+                # materialisation like every other dist op
+                res = colocated_join(self._env, lt, rt, **self._kw)
+                yield TableChunk(tag, res)
+                continue
             lt = concat_tables(lefts) if len(lefts) > 1 else lefts[0]
             rt = concat_tables(rights) if len(rights) > 1 else rights[0]
             res = _join(lt, rt, **self._kw)
@@ -111,10 +168,12 @@ class UnionOp(Op):
     """Per-partition set union (parity: ``ops/union_op.cpp`` +
     ``ops/kernels/union_kernel``)."""
 
-    def __init__(self, op_id: int, out_capacity: int | None = None):
+    def __init__(self, op_id: int, out_capacity: int | None = None,
+                 env=None):
         super().__init__(op_id, name="UnionOp")
         self._buf: dict[int, list] = {}
         self._out_capacity = out_capacity
+        self._env = env
 
     def execute(self, tag: int, table: Table):
         self._buf.setdefault(tag, []).append(table)
@@ -123,6 +182,15 @@ class UnionOp(Op):
     def on_finalize(self):
         for tag in sorted(self._buf):
             chunks = self._buf[tag]
+            if self._env is not None:
+                from cylon_tpu.parallel import (colocated_unique,
+                                                dist_concat)
+
+                t = (dist_concat(self._env, chunks)
+                     if len(chunks) > 1 else chunks[0])
+                yield TableChunk(tag, colocated_unique(
+                    self._env, t, out_capacity=self._out_capacity))
+                continue
             t = concat_tables(chunks) if len(chunks) > 1 else chunks[0]
             yield TableChunk(tag, _setops.unique(
                 t, out_capacity=self._out_capacity))
@@ -182,20 +250,28 @@ class DisJoinOp:
     """
 
     def __init__(self, key_cols: Sequence[str] | str, n_partitions: int = 4,
-                 callback: Callable | None = None, **join_kw):
+                 callback: Callable | None = None, env=None, **join_kw):
         keys = [key_cols] if isinstance(key_cols, str) else list(key_cols)
         join_kw.setdefault("on", keys if len(keys) > 1 else keys[0])
         self.root = RootOp(0, callback)
-        self.join = JoinOp(1, **join_kw)
+        self.join = JoinOp(1, env=env, **join_kw)
         self.join.add_child(self.root)
         lport = self.join.left_port(2)
         rport = self.join.right_port(3)
-        self.left_partition = PartitionOp(4, keys, n_partitions)
-        self.right_partition = PartitionOp(5, keys, n_partitions)
+        if env is not None:
+            # distributed graph: the exchange stage is a real mesh
+            # all-to-all per chunk; the mesh IS the partitioning, so
+            # logical sub-partitioning is unnecessary
+            self.left_partition = ShuffleOp(4, keys, env)
+            self.right_partition = ShuffleOp(5, keys, env)
+        else:
+            self.left_partition = PartitionOp(4, keys, n_partitions)
+            self.right_partition = PartitionOp(5, keys, n_partitions)
         self.left_partition.add_child(lport)
         self.right_partition.add_child(rport)
         self.ops = [self.left_partition, self.right_partition, lport, rport,
                     self.join, self.root]
+        self._env = env
 
     def insert_left(self, table: Table, tag: int = 0):
         self.left_partition.insert(tag, table)
@@ -220,7 +296,13 @@ class DisJoinOp:
         tables = [c.table for c in chunks]
         if not tables:
             raise ValueError("join produced no partitions")
-        return concat_tables(tables) if len(tables) > 1 else tables[0]
+        if len(tables) == 1:
+            return tables[0]
+        if self._env is not None:
+            from cylon_tpu.parallel import dist_concat
+
+            return dist_concat(self._env, tables)
+        return concat_tables(tables)
 
 
 class DisUnionOp:
@@ -230,17 +312,21 @@ class DisUnionOp:
     def __init__(self, n_partitions: int = 4,
                  callback: Callable | None = None,
                  out_capacity: int | None = None,
-                 key_cols: Sequence[str] | None = None):
+                 key_cols: Sequence[str] | None = None, env=None):
         self.root = RootOp(0, callback)
-        self.union = UnionOp(1, out_capacity)
+        self.union = UnionOp(1, out_capacity, env=env)
         self.union.add_child(self.root)
         self._keys = key_cols
         self._n = n_partitions
-        self._partitions: list[PartitionOp] = []
+        self._env = env
+        self._partitions: list[Op] = []
 
-    def add_input(self, key_cols: Sequence[str] | None = None) -> PartitionOp:
+    def add_input(self, key_cols: Sequence[str] | None = None) -> Op:
         keys = list(key_cols or self._keys or ())
-        p = PartitionOp(10 + len(self._partitions), keys, self._n)
+        if self._env is not None:
+            p = ShuffleOp(10 + len(self._partitions), keys, self._env)
+        else:
+            p = PartitionOp(10 + len(self._partitions), keys, self._n)
         p.add_child(self.union)
         self._partitions.append(p)
         return p
@@ -260,4 +346,10 @@ class DisUnionOp:
         tables = [c.table for c in chunks]
         if not tables:
             raise ValueError("union produced no partitions")
-        return concat_tables(tables) if len(tables) > 1 else tables[0]
+        if len(tables) == 1:
+            return tables[0]
+        if self._env is not None:
+            from cylon_tpu.parallel import dist_concat
+
+            return dist_concat(self._env, tables)
+        return concat_tables(tables)

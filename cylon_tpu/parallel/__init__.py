@@ -29,6 +29,8 @@ from cylon_tpu.parallel.task_plan import (
     task_view,
 )
 from cylon_tpu.parallel.dist_ops import (
+    colocated_join,
+    colocated_unique,
     dist_aggregate,
     dist_concat,
     dist_groupby,
@@ -45,6 +47,8 @@ from cylon_tpu.parallel.dist_ops import (
 __all__ = [
     "ReduceOp",
     "all_reduce",
+    "colocated_join",
+    "colocated_unique",
     "dist_aggregate",
     "dist_concat",
     "dist_groupby",

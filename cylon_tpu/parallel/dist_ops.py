@@ -91,6 +91,43 @@ def _key_data(t: Table, cols):
             [t.column(c).validity for c in cols])
 
 
+def _value_hash_tables(table: Table, cols) -> dict:
+    """Host-side per-dictionary value-hash tables for dictionary-encoded
+    key columns: codes are TABLE-LOCAL (independently ingested relations
+    assign different codes to the same string), so partitioning must
+    hash the VALUE, not the code, or equal keys land on different
+    shards. One tiny device gather maps codes -> stable value hashes.
+    dist_join avoids this by unifying dictionaries up front; the generic
+    shuffle (and the streaming graph feeding several relations through
+    it) cannot, because future chunks may extend the dictionary."""
+    import zlib
+
+    vh = {}
+    for c in cols:
+        col = table.column(c)
+        if col.dtype.is_dictionary and col.dictionary is not None:
+            hv = np.array([zlib.crc32(str(v).encode())
+                           for v in col.dictionary.values], np.uint32)
+            vh[c] = jnp.asarray(hv)
+    return vh
+
+
+def _partition_keys(lt: Table, cols, vh: dict):
+    """Key arrays for partition hashing, dictionary codes mapped through
+    their value-hash tables (see :func:`_value_hash_tables`)."""
+    keys, vals = [], []
+    for c in cols:
+        col = lt.column(c)
+        if c in vh:
+            tab = vh[c]
+            hi = max(tab.shape[0] - 1, 0)
+            keys.append(tab[jnp.clip(col.data, 0, hi)])
+        else:
+            keys.append(col.data)
+        vals.append(col.validity)
+    return keys, vals
+
+
 def _out_cap_local(env, *tables, out_capacity=None, skew=DEFAULT_SKEW):
     if out_capacity is not None:
         return -(-out_capacity // env.world_size)
@@ -167,6 +204,17 @@ def _adaptive(build, args, adaptive: bool):
         scale *= 2
 
 
+def _normalize_join_keys(on, left_on, right_on):
+    """Shared on/left_on/right_on normalization for the join entry
+    points (pandas-merge conventions)."""
+    if on is not None:
+        left_on = right_on = [on] if isinstance(on, str) else list(on)
+    else:
+        left_on = [left_on] if isinstance(left_on, str) else list(left_on or ())
+        right_on = [right_on] if isinstance(right_on, str) else list(right_on or ())
+    return left_on, right_on
+
+
 # ------------------------------------------------------------------ shuffle
 @traced("shuffle")
 def shuffle(env: CylonEnv, table: Table, key_cols: Sequence[str],
@@ -184,16 +232,18 @@ def shuffle(env: CylonEnv, table: Table, key_cols: Sequence[str],
         raise InvalidArgument(f"unknown partitioning {partitioning!r}")
     table = _prep(env, table)
     w = env.world_size
+    vh = _value_hash_tables(table, key_cols)
 
     def build():
         out_l = _out_cap_local(env, table, out_capacity=out_capacity)
 
         def body(t):
             lt, inof = _checked_local(t)
-            keys, vals = _key_data(lt, key_cols)
             if partitioning == "hash":
+                keys, vals = _partition_keys(lt, key_cols, vh)
                 pid = partition_ids(keys, w, vals)
             else:
+                keys, vals = _key_data(lt, key_cols)
                 pid = modulo_partition_ids(keys, w)
             res, of = checked_recv(
                 shuffle_local(lt, pid, out_l, bucket_cap), out_l)
@@ -243,11 +293,7 @@ def dist_join(env: CylonEnv, left: Table, right: Table, *,
     shuffle both tables by key hash, then local join — here a single
     fused XLA program; world==1 short-circuits to the local join like
     the reference's ``world==1`` branch at table.cpp:481)."""
-    if on is not None:
-        left_on = right_on = [on] if isinstance(on, str) else list(on)
-    else:
-        left_on = [left_on] if isinstance(left_on, str) else list(left_on or ())
-        right_on = [right_on] if isinstance(right_on, str) else list(right_on or ())
+    left_on, right_on = _normalize_join_keys(on, left_on, right_on)
     if env.world_size == 1:
         lt = dtable.gather_table(env, left) if dtable.is_distributed(left) else left
         rt = dtable.gather_table(env, right) if dtable.is_distributed(right) else right
@@ -628,6 +674,72 @@ def dist_unique(env: CylonEnv, table: Table,
         return _smap(env, body, 1)
 
     return _adaptive(build, (table,), out_capacity is None)
+
+
+# ------------------------------------------------- co-located (no-shuffle)
+@traced("colocated_join")
+def colocated_join(env: CylonEnv, left: Table, right: Table, *,
+                   on=None, left_on=None, right_on=None,
+                   how: str = "inner", suffixes=("_x", "_y"),
+                   out_capacity: int | None = None,
+                   algorithm: str = "sort") -> Table:
+    """Per-shard local join of two ALREADY key-co-located distributed
+    tables — no exchange (parity: the reference's local join stage after
+    its streaming all-to-all, ``ops/dis_join_op.cpp`` SplitOp→JoinOp).
+    The streaming op-graph shuffles chunk-by-chunk as data arrives and
+    calls this once at finalize; callers who shuffled via
+    :func:`shuffle` can use it to skip ``dist_join``'s re-exchange.
+    """
+    left_on, right_on = _normalize_join_keys(on, left_on, right_on)
+    left = _prep(env, left)
+    right = _prep(env, right)
+    w = env.world_size
+
+    def build():
+        if out_capacity is None:
+            from cylon_tpu import plan
+
+            join_l = (dtable.local_capacity(left)
+                      + dtable.local_capacity(right)) * plan.current_scale()
+        else:
+            join_l = -(-out_capacity // w)
+
+        def body(lt, rt):
+            ltab, liof = _checked_local(lt)
+            rtab, riof = _checked_local(rt)
+            res = _join_fn(ltab, rtab, left_on=left_on, right_on=right_on,
+                           how=how, suffixes=suffixes, out_capacity=join_l,
+                           algorithm=algorithm)
+            return _shard_view(poison(res, liof, riof))
+
+        return _smap(env, body, 2)
+
+    return _adaptive(build, (left, right), out_capacity is None)
+
+
+@traced("colocated_unique")
+def colocated_unique(env: CylonEnv, table: Table,
+                     cols: Sequence[str] | None = None,
+                     keep: str = "first",
+                     out_capacity: int | None = None) -> Table:
+    """Per-shard local unique of an already key-co-located distributed
+    table — the finalize stage of the streaming union graph.
+    ``out_capacity`` bounds the global result (split per shard) with
+    the usual raise-on-overflow contract."""
+    table = _prep(env, table)
+    out_l = (None if out_capacity is None
+             else -(-out_capacity // env.world_size))
+
+    def build():
+        def body(t):
+            lt, inof = _checked_local(t)
+            return _shard_view(poison(
+                _setops.unique(lt, cols, keep=keep, out_capacity=out_l),
+                inof))
+
+        return _smap(env, body, 1)
+
+    return _adaptive(build, (table,), False)
 
 
 # ------------------------------------------------------------------ concat
