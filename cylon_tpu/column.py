@@ -33,7 +33,7 @@ class Dictionary:
     are genuinely interchangeable as compile keys.
     """
 
-    __slots__ = ("values", "_key", "_hash")
+    __slots__ = ("values", "_key", "_hash", "_vhash")
 
     def __init__(self, values: np.ndarray):
         self.values = np.asarray(values, dtype=object)
@@ -43,6 +43,24 @@ class Dictionary:
         self.values.flags.writeable = False
         self._key = None
         self._hash = None
+        self._vhash = None
+
+    def value_hashes(self):
+        """[len] uint32 device array of stable per-VALUE hashes (crc32
+        of the string form), cached: code-independent partition hashing
+        maps codes through this table so independently ingested
+        relations co-locate equal keys (``dist_ops._partition_keys``).
+        Cached per dictionary — the streaming graph shuffles many
+        chunks sharing one dictionary."""
+        if self._vhash is None:
+            import zlib
+
+            import jax.numpy as jnp
+
+            hv = np.array([zlib.crc32(str(v).encode())
+                           for v in self.values], np.uint32)
+            self._vhash = jnp.asarray(hv)
+        return self._vhash
 
     def _content_key(self) -> tuple:
         if self._key is None:

@@ -206,7 +206,7 @@ class GroupByOp(Op):
               "min": "min", "max": "max"}
 
     def __init__(self, op_id: int, by: Sequence[str], aggs,
-                 out_capacity: int | None = None):
+                 out_capacity: int | None = None, env=None):
         super().__init__(op_id, name="GroupByOp")
         self._by = list(by)
         self._aggs = [(a[0], a[1], a[2] if len(a) > 2 else f"{a[0]}_{a[1]}")
@@ -214,6 +214,7 @@ class GroupByOp(Op):
         self._out_capacity = out_capacity
         self._decomposable = all(op in self._MERGE
                                  for _, op, _ in self._aggs)
+        self._env = env
         self._buf: dict[int, list] = {}
 
     def execute(self, tag: int, table: Table):
@@ -221,20 +222,40 @@ class GroupByOp(Op):
             part = groupby_aggregate(
                 table, self._by,
                 [(src, op, out) for src, op, out in self._aggs])
-            self._buf.setdefault(tag, []).append(part)
         else:
-            self._buf.setdefault(tag, []).append(table)
+            part = table
+        if self._env is not None:
+            # mesh mode: shuffle the (tiny) partials / raw rows so equal
+            # keys co-locate; the per-chunk collective is in flight
+            # while the next chunk pre-combines (the reference's
+            # comm/compute overlap)
+            from cylon_tpu.parallel.dist_ops import shuffle
+
+            part = shuffle(self._env, part, self._by,
+                           out_capacity=part.capacity
+                           * self._env.world_size)
+        self._buf.setdefault(tag, []).append(part)
         return ()
 
     def on_finalize(self):
         for tag in sorted(self._buf):
             chunks = self._buf[tag]
-            t = concat_tables(chunks) if len(chunks) > 1 else chunks[0]
             if self._decomposable:
                 final = [(out, self._MERGE[op], out)
                          for _, op, out in self._aggs]
             else:
                 final = self._aggs
+            if self._env is not None:
+                from cylon_tpu.parallel import (colocated_groupby,
+                                                dist_concat)
+
+                t = (dist_concat(self._env, chunks)
+                     if len(chunks) > 1 else chunks[0])
+                yield TableChunk(tag, colocated_groupby(
+                    self._env, t, self._by, final,
+                    out_capacity=self._out_capacity))
+                continue
+            t = concat_tables(chunks) if len(chunks) > 1 else chunks[0]
             yield TableChunk(tag, groupby_aggregate(
                 t, self._by, final, out_capacity=self._out_capacity))
 

@@ -29,6 +29,7 @@ from cylon_tpu.parallel.task_plan import (
     task_view,
 )
 from cylon_tpu.parallel.dist_ops import (
+    colocated_groupby,
     colocated_join,
     colocated_unique,
     dist_aggregate,
@@ -47,6 +48,7 @@ from cylon_tpu.parallel.dist_ops import (
 __all__ = [
     "ReduceOp",
     "all_reduce",
+    "colocated_groupby",
     "colocated_join",
     "colocated_unique",
     "dist_aggregate",

@@ -92,23 +92,20 @@ def _key_data(t: Table, cols):
 
 
 def _value_hash_tables(table: Table, cols) -> dict:
-    """Host-side per-dictionary value-hash tables for dictionary-encoded
-    key columns: codes are TABLE-LOCAL (independently ingested relations
+    """Per-dictionary value-hash tables for dictionary-encoded key
+    columns: codes are TABLE-LOCAL (independently ingested relations
     assign different codes to the same string), so partitioning must
     hash the VALUE, not the code, or equal keys land on different
-    shards. One tiny device gather maps codes -> stable value hashes.
-    dist_join avoids this by unifying dictionaries up front; the generic
-    shuffle (and the streaming graph feeding several relations through
-    it) cannot, because future chunks may extend the dictionary."""
-    import zlib
-
+    shards. One tiny device gather maps codes -> stable value hashes
+    (cached on the Dictionary — the streaming graph shuffles many
+    chunks sharing one dictionary). dist_join avoids this by unifying
+    dictionaries up front; the generic shuffle cannot, because future
+    chunks may extend the dictionary."""
     vh = {}
     for c in cols:
         col = table.column(c)
         if col.dtype.is_dictionary and col.dictionary is not None:
-            hv = np.array([zlib.crc32(str(v).encode())
-                           for v in col.dictionary.values], np.uint32)
-            vh[c] = jnp.asarray(hv)
+            vh[c] = col.dictionary.value_hashes()
     return vh
 
 
@@ -715,6 +712,31 @@ def colocated_join(env: CylonEnv, left: Table, right: Table, *,
         return _smap(env, body, 2)
 
     return _adaptive(build, (left, right), out_capacity is None)
+
+
+@traced("colocated_groupby")
+def colocated_groupby(env: CylonEnv, table: Table, by: Sequence[str],
+                      aggs, out_capacity: int | None = None,
+                      quantile: float = 0.5) -> Table:
+    """Per-shard local groupby of an already key-co-located distributed
+    table — the finalize stage of the streaming groupby graph (the
+    chunks were pre-combined and shuffled on arrival; equal keys live
+    on one shard, so a shard-local aggregate is globally correct)."""
+    table = _prep(env, table)
+    out_l = (None if out_capacity is None
+             else -(-out_capacity // env.world_size))
+
+    def build():
+        def body(t):
+            lt, inof = _checked_local(t)
+            res = _groupby.groupby_aggregate(lt, by, aggs,
+                                             out_capacity=out_l,
+                                             quantile=quantile)
+            return _shard_view(poison(res, inof))
+
+        return _smap(env, body, 1)
+
+    return _adaptive(build, (table,), False)
 
 
 @traced("colocated_unique")

@@ -111,6 +111,47 @@ def _check_overflow(out) -> None:
                     f"result rows {n} exceed capacity {t.capacity}")
 
 
+def _shrink_results(out):
+    """Trim result buffers to power-of-2 buckets of their true row
+    counts. Compiled queries keep intermediate static capacities all
+    the way to the output (no per-op host shrink), so a 4-row groupby
+    result can sit in a 600k-row buffer — materialising that over a
+    tunneled device transfers the whole buffer (~seconds) for a
+    screenful of rows. The row counts were just fetched by the overflow
+    check, so this costs no extra sync; distributed tables keep their
+    shard layout (the mesh contract)."""
+    from cylon_tpu.parallel import dtable
+    from cylon_tpu.table import Table
+
+    import os
+
+    if os.environ.get("CYLON_TPU_NO_SHRINK"):
+        return out
+
+    def shrink(t):
+        if dtable.is_distributed(t):
+            return t
+        # the row count is host-cached from the overflow check, so
+        # shrink_to_fit's num_rows read costs no extra device sync
+        return t.shrink_to_fit(only_above=0)
+
+    def walk(x):
+        if isinstance(x, Table):
+            return shrink(x)
+        t = getattr(x, "table", None)
+        if isinstance(t, Table) and hasattr(type(x), "_wrap"):
+            return type(x)._wrap(shrink(t), getattr(x, "_index", None))
+        if isinstance(x, list):
+            return [walk(v) for v in x]
+        if isinstance(x, tuple):
+            return tuple(walk(v) for v in x)
+        if isinstance(x, dict):
+            return {k: walk(v) for k, v in x.items()}
+        return x
+
+    return walk(out)
+
+
 class CompiledQuery:
     """A query function compiled to one XLA program per capacity scale.
 
@@ -153,7 +194,7 @@ class CompiledQuery:
                 scale *= 2
                 continue
             self._scale_memo[key] = scale
-            return out
+            return _shrink_results(out)
 
 
 def _is_dynamic(x) -> bool:

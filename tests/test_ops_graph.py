@@ -204,3 +204,33 @@ def test_dis_join_string_keys_independent_dictionaries(env8):
                                   want[cols].sort_values(cols)
                                   .reset_index(drop=True),
                                   check_dtype=False)
+
+
+def test_groupby_op_streams_over_mesh(env8, rng):
+    """GroupByOp(env=...): chunks pre-combine locally, the partials
+    shuffle over the mesh as they arrive, finalize aggregates per shard
+    (DistributedHashGroupBy's pre-combine -> exchange -> final combine,
+    streamed)."""
+    from cylon_tpu.ops_graph import (GroupByOp, RootOp, RoundRobinExecution,
+                                     chunk_stream)
+    from cylon_tpu.parallel import dist_to_pandas
+
+    n = 500
+    df = pd.DataFrame({"k": rng.integers(0, 25, n).astype(np.int64),
+                       "v": rng.normal(size=n)})
+    root = RootOp(0)
+    g = GroupByOp(1, ["k"], [("v", "sum"), ("v", "count")], env=env8)
+    g.add_child(root)
+    for chunk in chunk_stream(Table.from_pandas(df), 128):
+        g.insert(0, chunk)
+    g.finish()
+    chunks = root.wait_for_completion(RoundRobinExecution([g, root]))
+    assert len(chunks) == 1
+    got = dist_to_pandas(env8, chunks[0].table).sort_values("k") \
+        .reset_index(drop=True)
+    want = df.groupby("k").agg(v_sum=("v", "sum"),
+                               v_count=("v", "count")).reset_index()
+    assert len(got) == len(want)
+    np.testing.assert_allclose(got["v_sum"].values, want["v_sum"].values)
+    np.testing.assert_array_equal(got["v_count"].values,
+                                  want["v_count"].values)
