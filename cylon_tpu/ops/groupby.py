@@ -62,8 +62,25 @@ def groupby_aggregate(table: Table, by: Sequence[str],
     key-sorted. Null keys form their own group (they equal each other).
     Nulls/NaNs in value columns are skipped (pandas skipna semantics).
     """
-    out_cap = int(out_capacity if out_capacity is not None
-                  else table.capacity)
+    if out_capacity is not None:
+        out_cap = int(out_capacity)
+    else:
+        cap = int(table.capacity)
+        if isinstance(table.nrows, jax.core.Tracer):
+            # under a trace (whole-query compilation or a dist-op body)
+            # an enclosing regrow loop catches overflow — so bound the
+            # group count OPTIMISTICALLY: every segment reduction's
+            # cost scales with this static output bound (measured on
+            # v5e: 600k-segment f64 segment-sum ~160 ms vs ~6 ms at
+            # 8k), and most groupbys produce far fewer groups than
+            # rows. Overflow poisons nrows; the regrow re-dispatches
+            # at 2x (power-of-2 scale ladder bounds recompiles).
+            from cylon_tpu import plan
+
+            out_cap = min(cap, max(8192, cap // 16)
+                          * plan.current_scale())
+        else:
+            out_cap = cap
     return _groupby_compiled(table, by=tuple(by),
                              aggs=tuple(tuple(a) for a in aggs),
                              out_cap=out_cap, quantile=float(quantile))

@@ -410,6 +410,13 @@ def dist_groupby(env: CylonEnv, table: Table, by: Sequence[str],
         def body(t):
             lt, inof = _checked_local(t)
             part = _groupby.groupby_aggregate(lt, by, pre)
+            # the pre-combine may itself overflow its (optimistic)
+            # group bound; its poison would be LOST through the
+            # exchange (the shuffle sends only the surviving buffer
+            # rows), so capture it here and carry it to the output
+            pof = part.nrows > part.capacity
+            part = part.with_nrows(jnp.minimum(part.nrows,
+                                               part.capacity))
             keys, vals = _key_data(part, by)
             pid = partition_ids(keys, w, vals)
             # partials are at most cap_local groups; shuffle at same size
@@ -417,7 +424,7 @@ def dist_groupby(env: CylonEnv, table: Table, by: Sequence[str],
             res = _groupby.groupby_aggregate(sh, by, final,
                                              out_capacity=out_l)
             res = post(res)
-            return _shard_view(poison(res, inof, of))
+            return _shard_view(poison(res, inof, of, pof))
 
         return _smap(env, body, 1)
 
@@ -736,7 +743,9 @@ def colocated_groupby(env: CylonEnv, table: Table, by: Sequence[str],
 
         return _smap(env, body, 1)
 
-    return _adaptive(build, (table,), False)
+    # the defaulted group bound is optimistic under trace — regrow on
+    # overflow (explicit out_capacity keeps raise-on-overflow)
+    return _adaptive(build, (table,), out_capacity is None)
 
 
 @traced("colocated_unique")

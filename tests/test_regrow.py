@@ -129,3 +129,60 @@ def test_local_overflow_poison_propagates(rng):
     g = groupby_aggregate(j, ["k"], [("v", "sum", "s")])
     with pytest.raises(OutOfCapacity):
         g.num_rows
+
+
+def test_compiled_groupby_high_cardinality_regrows(rng):
+    """Under tracing, groupby bounds its group count optimistically
+    (segment-reduction cost scales with the static output bound);
+    more distinct keys than the bound must regrow, not truncate."""
+
+    @compile_query
+    def q(t):
+        return groupby_aggregate(t, ["k"], [("v", "sum", "s")])
+
+    n = 40_000  # optimistic bound = max(8192, n//16) = 8192 < ~18k keys
+    k = rng.integers(0, 30_000, n).astype(np.int64)
+    v = rng.normal(size=n)
+    out = q(Table.from_pydict({"k": k, "v": v}))
+    got = out.to_pandas()
+    exp = pd.DataFrame({"k": k, "v": v}).groupby("k")["v"].sum() \
+        .reset_index(name="s")
+    assert len(got) == len(exp)
+    pd.testing.assert_frame_equal(_sorted(got, ["k"]), _sorted(exp, ["k"]),
+                                  check_dtype=False)
+
+
+def test_dist_groupby_high_cardinality_regrows(env8, rng):
+    """The pre-combine partial can overflow its optimistic group bound
+    per shard; its poison must survive the exchange and trigger regrow
+    (not silently drop groups)."""
+    # per-shard capacity must exceed the 8192 optimistic floor for the
+    # pre-combine to overflow: 100k rows / 8 shards = 12.5k, nearly all
+    # keys distinct
+    n = 100_000
+    k = rng.integers(0, 10_000_000, n).astype(np.int64)
+    v = rng.normal(size=n)
+    t = Table.from_pydict({"k": k, "v": v})
+    g = dist_to_pandas(env8, dist_groupby(env8, t, ["k"],
+                                          [("v", "sum", "s")]))
+    exp = pd.DataFrame({"k": k, "v": v}).groupby("k")["v"].sum() \
+        .reset_index(name="s")
+    assert len(g) == len(exp)
+    pd.testing.assert_frame_equal(_sorted(g, ["k"]), _sorted(exp, ["k"]),
+                                  check_dtype=False)
+
+
+def test_streaming_groupby_high_cardinality(env8, rng):
+    """colocated_groupby (streaming finalize) regrows its defaulted
+    group bound instead of hard-failing."""
+    from cylon_tpu.parallel import colocated_groupby, shuffle
+
+    n = 100_000
+    k = rng.integers(0, 10_000_000, n).astype(np.int64)
+    v = rng.normal(size=n)
+    t = shuffle(env8, Table.from_pydict({"k": k, "v": v}), ["k"])
+    g = dist_to_pandas(env8, colocated_groupby(env8, t, ["k"],
+                                               [("v", "sum", "s")]))
+    exp = pd.DataFrame({"k": k, "v": v}).groupby("k")["v"].sum() \
+        .reset_index(name="s")
+    assert len(g) == len(exp)
