@@ -63,7 +63,11 @@ def main():
         for i in range(depth):
             r = rt.add_column("k", col(ks[i], None, rt.column("k").dtype))
             r = r.add_column("b", col(bs[i], None, rt.column("b").dtype))
-            res = join(lt, r, on="k", how="inner", out_capacity=out_cap)
+            # ordered=False matches the reference's semantics (its sort
+            # join emits key order, not left-frame order) and is what
+            # the distributed shards run
+            res = join(lt, r, on="k", how="inner", out_capacity=out_cap,
+                       ordered=False)
             total = total + res.nrows
         return total
 

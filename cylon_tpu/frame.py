@@ -254,13 +254,22 @@ class DataFrame:
                           left_on=left_on, right_on=right_on, how=how,
                           suffixes=suffixes, out_capacity=out_capacity,
                           algorithm=algorithm)
-        else:
-            t = _join(self._gathered(), right._gathered(), on=on,
-                      left_on=left_on, right_on=right_on, how=how,
-                      suffixes=suffixes, out_capacity=out_capacity,
-                      algorithm=algorithm)
-            t = _shrink(t)
-        return DataFrame._wrap(t)
+            return DataFrame._wrap(t)
+        # local eager path regrows a defaulted capacity like the
+        # distributed ops do (an N:M key blowup past the 1:N default
+        # re-dispatches at 2x; the row-count check is the same sync
+        # _shrink pays anyway). An explicit out_capacity keeps the
+        # raise-on-overflow contract; under whole-query tracing the
+        # enclosing CompiledQuery ladder takes over.
+        from cylon_tpu import plan
+
+        t = plan.regrow_eager(
+            lambda: _join(self._gathered(), right._gathered(), on=on,
+                          left_on=left_on, right_on=right_on, how=how,
+                          suffixes=suffixes, out_capacity=out_capacity,
+                          algorithm=algorithm),
+            bounded=out_capacity is not None)
+        return DataFrame._wrap(_shrink(t))
 
     def join(self, right: "DataFrame", on=None, how: str = "left",
              lsuffix: str = "_l", rsuffix: str = "_r",

@@ -42,7 +42,7 @@ def join(left: Table, right: Table, config: JoinConfig | None = None, *,
          how: str = "inner",
          suffixes: tuple[str, str] = ("_x", "_y"),
          out_capacity: int | None = None,
-         algorithm: str = "sort") -> Table:
+         algorithm: str = "sort", ordered: bool = True) -> Table:
     """Equi-join two tables (parity: ``join::JoinTables`` +
     ``Table::Join``; semantics follow pandas ``merge`` — the reference's
     own python-test oracle).
@@ -51,6 +51,12 @@ def join(left: Table, right: Table, config: JoinConfig | None = None, *,
     ``left.capacity + right.capacity`` — enough for any 1:N join; raise it
     for N:M key duplication). Overflow is detected host-side via
     ``Table.num_rows``.
+
+    ``ordered=False`` skips restoring pandas' left-frame output order
+    (one stable sort of the index pairs) — the row SET is identical.
+    The distributed operators use it per shard: the reference's own
+    sort-join emits key order, and cross-shard order is
+    implementation-defined anyway.
 
     ``algorithm`` (parity: ``JoinAlgorithm`` {SORT, HASH},
     ``join_config.hpp:25-31``): "sort" groups rows by lexicographic key
@@ -78,7 +84,8 @@ def join(left: Table, right: Table, config: JoinConfig | None = None, *,
         # right join = left join with sides swapped, columns re-ordered
         swapped = join(right, left, left_on=right_on, right_on=left_on,
                        how="left", suffixes=(suffixes[1], suffixes[0]),
-                       out_capacity=out_capacity, algorithm=algorithm)
+                       out_capacity=out_capacity, algorithm=algorithm,
+                       ordered=ordered)
         return _reorder_right_join(swapped, left, right, left_on, right_on,
                                    suffixes)
     if how not in ("inner", "left", "fullouter"):
@@ -106,21 +113,23 @@ def join(left: Table, right: Table, config: JoinConfig | None = None, *,
     return _join_compiled(left, right, left_on=tuple(left_on),
                           right_on=tuple(right_on), how=how,
                           suffixes=tuple(suffixes), out_cap=int(out_cap),
-                          algorithm=algorithm)
+                          algorithm=algorithm, ordered=ordered)
 
 
 @functools.partial(platform_jit, static_argnames=("left_on", "right_on",
                                                   "how", "suffixes",
-                                                  "out_cap", "algorithm"))
+                                                  "out_cap", "algorithm",
+                                                  "ordered"))
 def _join_compiled(left: Table, right: Table, *, left_on, right_on, how,
-                   suffixes, out_cap, algorithm="sort") -> Table:
+                   suffixes, out_cap, algorithm="sort",
+                   ordered=True) -> Table:
     lkeys = [left.column(n).data for n in left_on]
     rkeys = [right.column(n).data for n in right_on]
     lvals = [left.column(n).validity for n in left_on]
     rvals = [right.column(n).validity for n in right_on]
     left_idx, right_idx, total = _join_indices(
         lkeys, lvals, left.nrows, rkeys, rvals, right.nrows, how, out_cap,
-        hash_first=algorithm == "hash")
+        hash_first=algorithm == "hash", ordered=ordered)
     res = _assemble(left, right, list(left_on), list(right_on),
                     suffixes, left_idx, right_idx, total, how)
     return kernels.carry_overflow(res, left, right)
@@ -153,7 +162,7 @@ def _aligned_keys(left, right, left_on, right_on):
 
 
 def _join_indices(lkeys, lvals, lrows, rkeys, rvals, rrows, how, out_cap,
-                  hash_first: bool = False):
+                  hash_first: bool = False, ordered: bool = True):
     """Core: (left_idx, right_idx, total) gather plans of length out_cap.
 
     -1 in either index array marks a null (non-matched) side for that
@@ -251,19 +260,22 @@ def _join_indices(lkeys, lvals, lrows, rkeys, rvals, rrows, how, out_cap,
         right_idx = jnp.where(in_main, right_idx, extra_right)
         total = total + n_extra
 
-    # restore pandas order — left-frame order for matched/left slots,
-    # right-frame order for fullouter extras after them — with one
-    # stable sort of the index pairs (slots of one left row keep their
-    # right-frame order by stability)
-    valid_slot = j < total
-    extra_key = (jnp.uint32(0x80000000)
-                 + jnp.maximum(right_idx, 0).astype(jnp.uint32))
-    okey = jnp.where(valid_slot,
-                     jnp.where(left_idx >= 0,
-                               left_idx.astype(jnp.uint32), extra_key),
-                     jnp.uint32(0xFFFFFFFF))
-    _, left_idx, right_idx = jax.lax.sort(
-        (okey, left_idx, right_idx), num_keys=1, is_stable=True)
+    if ordered:
+        # restore pandas order — left-frame order for matched/left
+        # slots, right-frame order for fullouter extras after them —
+        # with one stable sort of the index pairs (slots of one left
+        # row keep their right-frame order by stability). Valid slots
+        # are contiguous at the front either way, so ordered=False can
+        # simply skip this.
+        valid_slot = j < total
+        extra_key = (jnp.uint32(0x80000000)
+                     + jnp.maximum(right_idx, 0).astype(jnp.uint32))
+        okey = jnp.where(valid_slot,
+                         jnp.where(left_idx >= 0,
+                                   left_idx.astype(jnp.uint32), extra_key),
+                         jnp.uint32(0xFFFFFFFF))
+        _, left_idx, right_idx = jax.lax.sort(
+            (okey, left_idx, right_idx), num_keys=1, is_stable=True)
 
     return left_idx, right_idx, total
 

@@ -297,10 +297,12 @@ def dist_join(env: CylonEnv, left: Table, right: Table, *,
 
         def build1():
             def run(l, r):
+                # ordered=False like the sharded path, so output
+                # order does not silently change with world size
                 res = _join_fn(l, r, left_on=left_on, right_on=right_on,
                                how=how, suffixes=suffixes,
                                out_capacity=out_capacity,
-                               algorithm=algorithm)
+                               algorithm=algorithm, ordered=False)
                 return res.with_nrows(res.nrows.reshape(1))
             return run
 
@@ -343,7 +345,7 @@ def dist_join(env: CylonEnv, left: Table, right: Table, *,
                                     shuf_r)
             res = _join_fn(lsh, rsh, left_on=left_on, right_on=right_on,
                            how=how, suffixes=suffixes, out_capacity=join_l,
-                           algorithm=algorithm)
+                           algorithm=algorithm, ordered=False)
             return _shard_view(poison(res, liof, riof, lof, rof))
 
         return _smap(env, body, 2)
@@ -713,7 +715,7 @@ def colocated_join(env: CylonEnv, left: Table, right: Table, *,
             rtab, riof = _checked_local(rt)
             res = _join_fn(ltab, rtab, left_on=left_on, right_on=right_on,
                            how=how, suffixes=suffixes, out_capacity=join_l,
-                           algorithm=algorithm)
+                           algorithm=algorithm, ordered=False)
             return _shard_view(poison(res, liof, riof))
 
         return _smap(env, body, 2)

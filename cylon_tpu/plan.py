@@ -241,6 +241,34 @@ def _hashable(v):
     return v
 
 
+def regrow_eager(run, *, bounded: bool):
+    """Host-side regrow ladder for ONE eager local dispatch.
+
+    ``run()`` must build and execute the op reading the ambient
+    :func:`capacity_scale` for its defaulted bounds and return a local
+    Table. ``bounded=True`` (caller passed an explicit capacity) keeps
+    the raise-on-overflow contract. Under an outer trace the row count
+    is a tracer — the check is skipped and the enclosing
+    :class:`CompiledQuery` ladder regrows the whole program instead
+    (seeding from ``current_scale()`` keeps the two ladders composable).
+    The distributed analog with per-shard count checks is
+    ``parallel.dist_ops._adaptive``.
+    """
+    scale = current_scale()
+    while True:
+        with capacity_scale(scale):
+            t = run()
+        if bounded or isinstance(t.nrows, jax.core.Tracer):
+            return t
+        try:
+            t.num_rows  # host sync; raises on overflow
+            return t
+        except OutOfCapacity:
+            if scale >= MAX_SCALE:
+                raise
+            scale *= 2
+
+
 def compile_query(fn=None, *, check: bool = True):
     """Decorator/wrapper: compile a whole query into one XLA program
     with automatic capacity regrow (see module docstring).

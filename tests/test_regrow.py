@@ -186,3 +186,19 @@ def test_streaming_groupby_high_cardinality(env8, rng):
     exp = pd.DataFrame({"k": k, "v": v}).groupby("k")["v"].sum() \
         .reset_index(name="s")
     assert len(g) == len(exp)
+
+
+def test_eager_local_merge_regrows(rng):
+    """The facade's local merge regrows a defaulted capacity like the
+    distributed ops (an N:M blowup must not force the user to guess
+    out_capacity)."""
+    from cylon_tpu.frame import DataFrame
+
+    n = 3000
+    l = DataFrame({"k": rng.integers(0, 80, n).astype(np.int64),
+                   "a": rng.normal(size=n)})
+    r = DataFrame({"k": rng.integers(0, 80, n).astype(np.int64),
+                   "b": rng.normal(size=n)})
+    got = l.merge(r, on="k").to_pandas()
+    exp = l.to_pandas().merge(r.to_pandas(), on="k")
+    pd.testing.assert_frame_equal(got, exp)  # exact pandas order locally
