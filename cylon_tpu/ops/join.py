@@ -92,6 +92,10 @@ def join(left: Table, right: Table, config: JoinConfig | None = None, *,
         raise InvalidArgument(f"unknown join type {how!r}")
     if algorithm not in ("sort", "hash"):
         raise InvalidArgument(f"unknown join algorithm {algorithm!r}")
+    if how == "fullouter" and ordered and algorithm == "hash":
+        # pandas sorts the key union for outer joins; hash-bucket group
+        # order cannot reproduce that — use key-ordered grouping
+        algorithm = "sort"
 
     cl, cr = left.capacity, right.capacity
     if out_capacity is not None:
@@ -169,8 +173,10 @@ def _join_indices(lkeys, lvals, lrows, rkeys, rvals, rrows, how, out_cap,
     output row.
 
     Everything runs in the COMBINED GROUP-SORTED layout from one
-    ``group_sort`` over both sides' keys (side flag as a sub-order key,
-    so each group's left rows precede its right rows). Per-group values
+    ``group_sort`` over both sides' keys (the row iota as a sub-order
+    key: left indices < cl precede right ones, so each group's left
+    rows sort first, and its uniqueness makes the order total — the
+    sort runs unstable). Per-group values
     — right-run count, right-run start — broadcast to every row by
     segmented scans (``forward_fill``/``reverse_fill``: cumsum + cummax
     encodings), NOT by random gathers: on TPU a same-size gather costs
@@ -179,9 +185,9 @@ def _join_indices(lkeys, lvals, lrows, rkeys, rvals, rrows, how, out_cap,
     what three scans now compute in place. The irreducible gathers that
     remain are the run expansion itself (``packed[parent]``, the right
     partner lookup) plus the final ``take_columns``. Output order is
-    restored to pandas' (left-frame order; fullouter extras in
-    right-frame order after) by one stable sort of the [out_cap] index
-    pairs.
+    restored to pandas' by one stable sort of the [out_cap] index pairs
+    (inner/left: left-frame order; fullouter: the sorted key union with
+    null keys last).
     """
     cl = lkeys[0].shape[0]
     cr = rkeys[0].shape[0]
@@ -200,10 +206,32 @@ def _join_indices(lkeys, lvals, lrows, rkeys, rvals, rrows, how, out_cap,
                               kernels.valid_mask(cr, rrows)])
 
     iota_c = jnp.arange(ncomb, dtype=jnp.int32)
-    side = (iota_c >= cl).astype(jnp.uint8)     # left rows sort first
-    gid_s, _, (orig_s,) = kernels.group_sort(
-        ckeys, cvalid, cvals, payloads=[iota_c], hash_first=hash_first,
-        suborder=[side])
+    # the row iota is BOTH the sub-order key (left indices < cl precede
+    # right ones, so each group's left rows sort first) and the
+    # original-row payload — one operand instead of a side flag plus a
+    # payload; its uniqueness makes the order total, so the sort can
+    # skip stability bookkeeping
+    want_gid = ordered and how == "fullouter"
+    extra_payloads = []
+    if want_gid:
+        # null-key flag rides the sort so the ordering key can put
+        # null-key groups last (pandas sorts nulls last in the outer
+        # key union, while group_sort ranks them among zeroed values)
+        knull_row = jnp.zeros(ncomb, bool)
+        for v in cvals:
+            if v is not None:
+                knull_row = knull_row | ~v
+        extra_payloads = [knull_row.astype(jnp.uint8)]
+    gid_s, _, sorted_pl = kernels.group_sort(
+        ckeys, cvalid, cvals, hash_first=hash_first,
+        suborder=[iota_c.astype(jnp.uint32)], stable=False,
+        payloads=extra_payloads)
+    orig_s = sorted_pl[0].astype(jnp.int32)
+    if want_gid:
+        # order key per group: gid with the null-flag in bit 30 (safe
+        # while ncomb < 2^30) — non-null groups in key order first,
+        # null-key groups after
+        ogid_s = gid_s | (sorted_pl[1].astype(jnp.int32) << 30)
 
     valid_s = gid_s < ncomb
     is_r = valid_s & (orig_s >= cl)
@@ -240,40 +268,51 @@ def _join_indices(lkeys, lvals, lrows, rkeys, rvals, rrows, how, out_cap,
     mark = jnp.full(out_cap, -1, jnp.int32).at[start].max(iota_c,
                                                           mode="drop")
     parent = jnp.clip(jax.lax.cummax(mark), 0, max(ncomb - 1, 0))
-    packed = jnp.stack([offs.astype(jnp.int32), match_counts,
-                        right_start, orig_s], axis=1)   # [ncomb, 4]
-    g = packed[parent]                          # one [out_cap, 4] gather
+    # the order-key gid column rides the packed gather only when the
+    # fullouter restore needs it (gathers are priced ~10x elementwise)
+    pcols = [offs.astype(jnp.int32), match_counts, right_start, orig_s]
+    if want_gid:
+        pcols.append(ogid_s)
+    packed = jnp.stack(pcols, axis=1)           # [ncomb, 4 or 5]
+    g = packed[parent]                          # one packed row-gather
     j = jnp.arange(out_cap, dtype=jnp.int32)
     within = j - g[:, 0]
     matched = g[:, 1] > 0
     r_pos = jnp.clip(g[:, 2] + within, 0, max(ncomb - 1, 0))
     right_idx = jnp.where(matched, orig_s[r_pos] - cl, -1)
     left_idx = g[:, 3]
+    slot_gid = g[:, 4] if want_gid else None
 
     if how == "fullouter":
         extra_mask = is_r & (lcnt == 0)
         perm_s, n_extra = kernels.compact_mask(extra_mask, valid_s)
         shifted = jnp.clip(j - total, 0, max(ncomb - 1, 0))
-        extra_right = orig_s[perm_s[shifted]] - cl
+        ecols = [orig_s] + ([ogid_s] if want_gid else [])
+        epair = jnp.stack(ecols, axis=1)[perm_s[shifted]]
         in_main = j < total
         left_idx = jnp.where(in_main, left_idx, -1)
-        right_idx = jnp.where(in_main, right_idx, extra_right)
+        right_idx = jnp.where(in_main, right_idx, epair[:, 0] - cl)
+        if want_gid:
+            slot_gid = jnp.where(in_main, slot_gid, epair[:, 1])
         total = total + n_extra
 
     if ordered:
-        # restore pandas order — left-frame order for matched/left
-        # slots, right-frame order for fullouter extras after them —
-        # with one stable sort of the index pairs (slots of one left
-        # row keep their right-frame order by stability). Valid slots
-        # are contiguous at the front either way, so ordered=False can
-        # simply skip this.
+        # restore pandas order with one stable sort of the index pairs.
+        # inner/left: left-frame order (slots of one left row keep
+        # their right-frame order by stability). fullouter: pandas
+        # sorts the key union lexicographically — that is GROUP order
+        # here, so the group id is the sort key (right-only extras
+        # interleave by key; within a key the left-frame emission order
+        # is preserved by stability). Valid slots are contiguous at the
+        # front either way, so ordered=False simply skips this.
         valid_slot = j < total
-        extra_key = (jnp.uint32(0x80000000)
-                     + jnp.maximum(right_idx, 0).astype(jnp.uint32))
-        okey = jnp.where(valid_slot,
-                         jnp.where(left_idx >= 0,
-                                   left_idx.astype(jnp.uint32), extra_key),
-                         jnp.uint32(0xFFFFFFFF))
+        if how == "fullouter":
+            okey = jnp.where(valid_slot, slot_gid.astype(jnp.uint32),
+                             jnp.uint32(0xFFFFFFFF))
+        else:
+            okey = jnp.where(valid_slot & (left_idx >= 0),
+                             left_idx.astype(jnp.uint32),
+                             jnp.uint32(0xFFFFFFFF))
         _, left_idx, right_idx = jax.lax.sort(
             (okey, left_idx, right_idx), num_keys=1, is_stable=True)
 

@@ -236,7 +236,8 @@ def group_sort(keys: Sequence[jax.Array], nrows,
                validities: Sequence[jax.Array | None] | None = None,
                payloads: Sequence[jax.Array] = (),
                hash_first: bool = False,
-               suborder: Sequence[jax.Array] = ()
+               suborder: Sequence[jax.Array] = (),
+               stable: bool = True
                ) -> tuple[jax.Array, jax.Array, list]:
     """One ``lax.sort`` that groups rows by key AND carries ``payloads``
     into group order as sort values.
@@ -262,9 +263,15 @@ def group_sort(keys: Sequence[jax.Array], nrows,
 
     ``suborder``: extra unsigned sort-key operands ranked BELOW the key
     columns and ABOVE stability — they order rows *within* a group
-    without splitting it (group boundaries ignore them). The join uses
-    this to place each group's left-side rows before its right-side
-    rows in one sort.
+    without splitting it (group boundaries ignore them). Their SORTED
+    values are returned as the leading entries of ``sorted_payloads``.
+    The join passes the row iota here: it both orders each group
+    (left-side rows first — left indices precede right ones) and serves
+    as the original-row payload, one operand doing two jobs.
+
+    ``stable=False`` is sound whenever the combined key+suborder tuple
+    is a total order (e.g. a unique iota suborder) — the comparator
+    network can then skip the stability bookkeeping.
     """
     cap = keys[0].shape[0]
     full_keys = []
@@ -289,9 +296,9 @@ def group_sort(keys: Sequence[jax.Array], nrows,
     operands = key_ops + list(suborder)
     nk = len(operands)
     out = jax.lax.sort(tuple(operands) + tuple(payloads), num_keys=nk,
-                       is_stable=True)
+                       is_stable=stable)
     sorted_keys = out[:nb]
-    sorted_payloads = list(out[nk:])
+    sorted_payloads = list(out[nb:])     # sorted suborder first
     iota = jnp.arange(cap, dtype=jnp.int32)
     valid_sorted = iota < total_valid
     # padding flag is constant 0 across valid rows, so boundaries on the

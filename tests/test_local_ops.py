@@ -461,3 +461,38 @@ def test_multidim_columns_through_payload_paths():
                            out_capacity=2)
     np.testing.assert_allclose(np.asarray(g3.column("em").data[:2]),
                                (want / 2.0)[:2])
+
+
+def test_all_join_types_exact_pandas_order(rng):
+    """Exact output-order parity for every join type, including
+    how="outer" where pandas sorts the key union lexicographically
+    (regression: the order restore used to emit left-frame order with
+    extras appended, not the sorted union)."""
+    n = 800
+    l = Table.from_pydict({"k": rng.integers(0, 40, n).astype(np.int64),
+                           "a": rng.normal(size=n)})
+    r = Table.from_pydict({"k": rng.integers(0, 800, n).astype(np.int64),
+                           "b": rng.normal(size=n)})
+    lp, rp = l.to_pandas(), r.to_pandas()
+    for how in ("inner", "left", "right", "outer"):
+        got = join(l, r, on="k", how=how,
+                   out_capacity=40_000).to_pandas()
+        exp = lp.merge(rp, on="k", how=how)
+        pd.testing.assert_frame_equal(got.reset_index(drop=True),
+                                      exp.reset_index(drop=True),
+                                      check_dtype=False)
+
+
+def test_outer_join_null_keys_sort_last():
+    """pandas sorts null keys LAST in the outer key union (regression:
+    group_sort ranks null groups among zeroed values, which put them
+    first for string keys)."""
+    l = Table.from_pandas(pd.DataFrame({"k": ["b", None, "a"],
+                                        "x": [1.0, 2.0, 3.0]}))
+    r = Table.from_pandas(pd.DataFrame({"k": [None, "c", "b"],
+                                        "y": [10.0, 20.0, 30.0]}))
+    got = join(l, r, on="k", how="outer").to_pandas()
+    exp = l.to_pandas().merge(r.to_pandas(), on="k", how="outer")
+    pd.testing.assert_frame_equal(got.reset_index(drop=True),
+                                  exp.reset_index(drop=True),
+                                  check_dtype=False)
