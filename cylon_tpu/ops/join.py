@@ -212,26 +212,10 @@ def _join_indices(lkeys, lvals, lrows, rkeys, rvals, rrows, how, out_cap,
     # payload; its uniqueness makes the order total, so the sort can
     # skip stability bookkeeping
     want_gid = ordered and how == "fullouter"
-    extra_payloads = []
-    if want_gid:
-        # null-key flag rides the sort so the ordering key can put
-        # null-key groups last (pandas sorts nulls last in the outer
-        # key union, while group_sort ranks them among zeroed values)
-        knull_row = jnp.zeros(ncomb, bool)
-        for v in cvals:
-            if v is not None:
-                knull_row = knull_row | ~v
-        extra_payloads = [knull_row.astype(jnp.uint8)]
-    gid_s, _, sorted_pl = kernels.group_sort(
+    gid_s, _, (orig_u,) = kernels.group_sort(
         ckeys, cvalid, cvals, hash_first=hash_first,
-        suborder=[iota_c.astype(jnp.uint32)], stable=False,
-        payloads=extra_payloads)
-    orig_s = sorted_pl[0].astype(jnp.int32)
-    if want_gid:
-        # order key per group: gid with the null-flag in bit 30 (safe
-        # while ncomb < 2^30) — non-null groups in key order first,
-        # null-key groups after
-        ogid_s = gid_s | (sorted_pl[1].astype(jnp.int32) << 30)
+        suborder=[iota_c.astype(jnp.uint32)], stable=False)
+    orig_s = orig_u.astype(jnp.int32)
 
     valid_s = gid_s < ncomb
     is_r = valid_s & (orig_s >= cl)
@@ -272,7 +256,7 @@ def _join_indices(lkeys, lvals, lrows, rkeys, rvals, rrows, how, out_cap,
     # fullouter restore needs it (gathers are priced ~10x elementwise)
     pcols = [offs.astype(jnp.int32), match_counts, right_start, orig_s]
     if want_gid:
-        pcols.append(ogid_s)
+        pcols.append(gid_s)
     packed = jnp.stack(pcols, axis=1)           # [ncomb, 4 or 5]
     g = packed[parent]                          # one packed row-gather
     j = jnp.arange(out_cap, dtype=jnp.int32)
@@ -287,7 +271,7 @@ def _join_indices(lkeys, lvals, lrows, rkeys, rvals, rrows, how, out_cap,
         extra_mask = is_r & (lcnt == 0)
         perm_s, n_extra = kernels.compact_mask(extra_mask, valid_s)
         shifted = jnp.clip(j - total, 0, max(ncomb - 1, 0))
-        ecols = [orig_s] + ([ogid_s] if want_gid else [])
+        ecols = [orig_s] + ([gid_s] if want_gid else [])
         epair = jnp.stack(ecols, axis=1)[perm_s[shifted]]
         in_main = j < total
         left_idx = jnp.where(in_main, left_idx, -1)
@@ -300,18 +284,20 @@ def _join_indices(lkeys, lvals, lrows, rkeys, rvals, rrows, how, out_cap,
         # restore pandas order with one stable sort of the index pairs.
         # inner/left: left-frame order (slots of one left row keep
         # their right-frame order by stability). fullouter: pandas
-        # sorts the key union lexicographically — that is GROUP order
-        # here, so the group id is the sort key (right-only extras
-        # interleave by key; within a key the left-frame emission order
-        # is preserved by stability). Valid slots are contiguous at the
-        # front either way, so ordered=False simply skips this.
+        # sorts the key union lexicographically with nulls last per
+        # level — exactly GROUP order (group_sort ranks null keys with
+        # the max word per level), so the group id is the sort key
+        # (right-only extras interleave by key; within a key the
+        # left-frame emission order is preserved by stability). Valid
+        # slots are contiguous at the front either way, so
+        # ordered=False simply skips this.
         valid_slot = j < total
         if how == "fullouter":
             okey = jnp.where(valid_slot, slot_gid.astype(jnp.uint32),
                              jnp.uint32(0xFFFFFFFF))
         else:
-            okey = jnp.where(valid_slot & (left_idx >= 0),
-                             left_idx.astype(jnp.uint32),
+            # every valid inner/left slot has a left-row parent
+            okey = jnp.where(valid_slot, left_idx.astype(jnp.uint32),
                              jnp.uint32(0xFFFFFFFF))
         _, left_idx, right_idx = jax.lax.sort(
             (okey, left_idx, right_idx), num_keys=1, is_stable=True)

@@ -282,13 +282,16 @@ def group_sort(keys: Sequence[jax.Array], nrows,
     for i, k in enumerate(keys):
         v = validities[i] if validities is not None else None
         nk = order_key(k)
+        full_keys.append(nk if v is None
+                         else jnp.where(v, nk, jnp.zeros((), nk.dtype) - 1))
         if v is not None:
-            nk = jnp.where(v, nk, jnp.zeros((), nk.dtype))
-        full_keys.append(nk)
-    if validities is not None:
-        for v in validities:
-            if v is not None:
-                full_keys.append(v.astype(jnp.uint8))
+            # nulls take the max word above so they RANK LAST per key
+            # level (pandas: NaN/None sorts last within each level of a
+            # multi-key sort/groupby/outer-join union); this inverted
+            # validity word, interleaved right after its level, breaks
+            # the tie against a genuine max value — null still ranks
+            # after it, and null == null group identity stays exact
+            full_keys.append((~v).astype(jnp.uint8))
     vmask = valid_mask(cap, nrows)
     total_valid = vmask.sum(dtype=jnp.int32)
     key_ops = pack_order_keys([(~vmask).astype(jnp.uint8)] + full_keys)

@@ -26,3 +26,19 @@ op.finish()
 result = op.result(RoundRobinExecution())
 print("streamed join rows:", result.num_rows)
 print(result.to_pandas().head())
+
+# --- distributed mode: the same graph over the device mesh -----------
+# every chunk all-to-alls over the mesh as it arrives (ShuffleOp); the
+# finalize join is shard-local on the co-located accumulation — the
+# reference's incremental exchange with its comm/compute overlap
+env = ct.CylonEnv(ct.TPUConfig())
+dop = DisJoinOp("k", env=env, how="inner")
+for chunk in chunk_stream(left, 512):
+    dop.insert_left(chunk)
+for chunk in chunk_stream(right, 512):
+    dop.insert_right(chunk)
+dist_result = dop.result()
+from cylon_tpu.parallel import dist_num_rows
+
+print("streamed join over the mesh:", dist_num_rows(dist_result), "rows",
+      "on", env)

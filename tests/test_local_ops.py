@@ -496,3 +496,19 @@ def test_outer_join_null_keys_sort_last():
     pd.testing.assert_frame_equal(got.reset_index(drop=True),
                                   exp.reset_index(drop=True),
                                   check_dtype=False)
+
+
+def test_outer_join_multikey_null_order():
+    """Multi-key outer join: pandas sorts the key union lexicographically
+    with nulls last PER LEVEL — a (a, None) row belongs inside the
+    k1=a run, not after all non-null groups."""
+    l = Table.from_pandas(pd.DataFrame(
+        {"k1": ["a", "a", "b"], "k2": [None, "z", "c"],
+         "x": [1.0, 2.0, 3.0]}))
+    r = Table.from_pandas(pd.DataFrame(
+        {"k1": ["b", "a"], "k2": ["c", None], "y": [10.0, 20.0]}))
+    got = join(l, r, on=["k1", "k2"], how="outer").to_pandas()
+    exp = l.to_pandas().merge(r.to_pandas(), on=["k1", "k2"], how="outer")
+    pd.testing.assert_frame_equal(got.reset_index(drop=True),
+                                  exp.reset_index(drop=True),
+                                  check_dtype=False)
