@@ -36,7 +36,12 @@ class Dictionary:
     __slots__ = ("values", "_key", "_hash", "_vhash")
 
     def __init__(self, values: np.ndarray):
-        self.values = np.asarray(values, dtype=object)
+        arr = np.asarray(values, dtype=object)
+        if arr is values:
+            # asarray aliases object ndarrays; freezing in place would
+            # make the CALLER's array read-only as a side effect
+            arr = arr.copy()
+        self.values = arr
         # content hashing requires immutable content: mutation after the
         # first hash would silently corrupt jit-cache keys and
         # unify_dictionaries' equal-content pass-through

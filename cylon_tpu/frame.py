@@ -211,12 +211,26 @@ class DataFrame:
             return DataFrame._wrap(self._table.select([key]), self._index)
         if isinstance(key, (list, tuple)):
             return DataFrame._wrap(self._table.select(list(key)), self._index)
-        if isinstance(key, DataFrame):
-            key = key._single_column().data
-        if isinstance(key, (jnp.ndarray, np.ndarray)):
-            t = _selection.filter_table(self._gathered(), jnp.asarray(key))
-            return DataFrame._wrap(_shrink(t))
-        raise KeyError_(f"bad key {key!r}")
+        from cylon_tpu.series import Series
+
+        if isinstance(key, (DataFrame, Series, Column,
+                            jnp.ndarray, np.ndarray)):
+            if self.is_distributed:
+                # a mask is always built on the PADDED shard layout;
+                # gathering first would compact rows out from under it
+                # and silently select the wrong ones — the layout-safe
+                # path is the shard-local filter
+                raise InvalidArgument(
+                    "boolean-mask selection on a distributed frame: use "
+                    ".filter(mask, env=env) (shard-local, no gather)")
+            return self.filter(key)
+        # no repr(key): a Series/DataFrame repr host-syncs, which under
+        # whole-query tracing raises ConcretizationTypeError and masks
+        # this KeyError
+        raise KeyError_(
+            f"bad key of type {type(key).__name__}; expected a column "
+            f"name, list of names, or boolean mask "
+            f"(columns: {list(self._table.column_names)!r})")
 
     def __setitem__(self, name, value):
         if self.is_distributed:
@@ -308,7 +322,51 @@ class DataFrame:
             _shrink(_setops.unique(self._gathered(), subset, keep=keep)))
 
     def head(self, n: int = 5) -> "DataFrame":
-        return DataFrame._wrap(_selection.head(self._gathered(), n))
+        if self.is_distributed:
+            from cylon_tpu.parallel import dist_head
+
+            # no gather, no data movement: only the [W] count vector
+            # changes (rows keep shard order, = the gathered order)
+            return DataFrame._wrap(dist_head(self._table, n))
+        return DataFrame._wrap(_selection.head(self._table, n))
+
+    def filter(self, mask=None, env: CylonEnv | None = None,
+               items: Sequence[str] | None = None) -> "DataFrame":
+        """Row filter / column selection.
+
+        With ``items=`` (or a list of column names) this is pandas
+        ``DataFrame.filter``: select columns by label. With a bool
+        array / Series / single-column DataFrame it is a row filter
+        that preserves the table's layout: on a mesh-distributed frame
+        each shard compacts its own rows — no gather (parity:
+        rank-local filters, ``compute.pyx:212``). Null mask entries
+        filter as False (SQL/pandas semantics)."""
+        from cylon_tpu.series import Series
+
+        if items is not None:
+            return self[list(items)]
+        if isinstance(mask, (list, tuple)) and all(
+                isinstance(x, str) for x in mask):
+            return self[list(mask)]  # pandas filter(items) shorthand
+        if isinstance(mask, DataFrame):
+            mask = mask._single_column()
+        if isinstance(mask, Series):
+            mask = mask.column
+        if isinstance(mask, Column):
+            m = mask.data.astype(bool)
+            if mask.validity is not None:
+                m = m & mask.validity
+            mask = m
+        mask = jnp.asarray(mask)
+        if self.is_distributed:
+            from cylon_tpu.parallel import dist_filter
+
+            if env is None:
+                raise InvalidArgument(
+                    "filter on a distributed frame needs env= (the mesh)")
+            return DataFrame._wrap(dist_filter(env, self._table, mask))
+        t = _selection.filter_table(self._table, mask)
+        return DataFrame._wrap(_shrink(t))
 
     def sample_rows(self, n: int) -> "DataFrame":
         return DataFrame._wrap(_selection.sample(self._gathered(), n))
@@ -413,10 +471,16 @@ class DataFrame:
     map = applymap  # pandas 2.x name
 
     def series(self, name: str):
-        """Single column as a :class:`cylon_tpu.series.Series`."""
+        """Single column as a :class:`cylon_tpu.series.Series`.
+
+        Layout-preserving: on a distributed frame the Series wraps the
+        sharded column directly (elementwise ops — arithmetic, isin,
+        str predicates — never move data, so they stay shard-local);
+        reductions on such a Series raise, use ``df.sum(env=...)`` /
+        ``dist_aggregate`` instead."""
         from cylon_tpu.series import Series
 
-        t = self._materialized().table
+        t = self._table
         return Series._wrap(t.column(name), t.nrows, name)
 
     def isnull(self) -> "DataFrame":

@@ -57,19 +57,29 @@ def table_aggregate(table: Table, col: str, op: str, quantile: float = 0.5):
     nulls = _null_flags(c)
     ok = vmask if nulls is None else vmask & (nulls == 0)
     # overflow poison folds into the scalar on-device (NaN for float
-    # results, -1 for integer ones): a truncated upstream op must never
-    # yield a silently-wrong aggregate, including under whole-query
-    # tracing where no host check can run (same convention as
-    # dist_aggregate)
+    # results, iinfo.min for integer ones — -1 would be indistinguishable
+    # from a legitimate sum/min/max over negative values): a truncated
+    # upstream op must never yield a silently-wrong aggregate. Under
+    # whole-query tracing the flag is ALSO registered with the enclosing
+    # CompiledQuery (plan.note_overflow) so scalar-returning compiled
+    # queries trigger the regrow ladder instead of returning poison.
+    from cylon_tpu import plan
+
     nr = table.nrows
     bad = ((nr > cap) if getattr(nr, "ndim", 0) == 0
            else jnp.zeros((), bool))
+    plan.note_overflow(bad)
 
     def _guard(val):
         val = jnp.asarray(val)
         if jnp.issubdtype(val.dtype, jnp.floating):
             return jnp.where(bad, jnp.full((), jnp.nan, val.dtype), val)
-        return jnp.where(bad, jnp.asarray(-1, val.dtype), val)
+        # bool (and unsigned, where iinfo.min == 0) sentinels are
+        # ambiguous — there the registered flag (note_overflow above) is
+        # the reliable signal; the sentinel is best-effort poison
+        sent = (False if val.dtype == jnp.bool_
+                else jnp.iinfo(val.dtype).min)
+        return jnp.where(bad, jnp.asarray(sent, val.dtype), val)
 
     data = c.data
     if op == "count":

@@ -34,7 +34,9 @@ from cylon_tpu.parallel.dist_ops import (
     colocated_unique,
     dist_aggregate,
     dist_concat,
+    dist_filter,
     dist_groupby,
+    dist_head,
     dist_intersect,
     dist_join,
     dist_sort,
@@ -53,7 +55,9 @@ __all__ = [
     "colocated_unique",
     "dist_aggregate",
     "dist_concat",
+    "dist_filter",
     "dist_groupby",
+    "dist_head",
     "dist_intersect",
     "dist_join",
     "dist_num_rows",

@@ -112,12 +112,20 @@ def device_put_table(env: CylonEnv, table: Table) -> Table:
     return Table(cols, nrows)
 
 
+#: test/diagnostic hook: when set to a list, every gather of a
+#: distributed table appends its capacity here (tests/test_no_gather.py
+#: pins that distributed TPC-H never gathers an input mid-query)
+_GATHER_LOG: "list | None" = None
+
+
 def gather_table(env: "CylonEnv | None", table: Table) -> Table:
     """Distributed -> local: compact every shard's valid rows to the
     front of one global buffer (single XLA program, no shard_map; env is
     accepted for API symmetry but not needed)."""
     if not is_distributed(table):
         return table
+    if _GATHER_LOG is not None:
+        _GATHER_LOG.append(table.capacity)
     from cylon_tpu.ops import kernels
     from cylon_tpu.ops.selection import take_columns
 

@@ -40,7 +40,7 @@ import jax
 from cylon_tpu.errors import OutOfCapacity
 
 __all__ = ["capacity_scale", "current_scale", "compile_query",
-           "CompiledQuery", "MAX_SCALE"]
+           "CompiledQuery", "MAX_SCALE", "note_overflow"]
 
 #: regrow ceiling: 2^10 = 1024x the default budget. Buffers grow only as
 #: far as the retry that fits (geometric, ~10 re-dispatches worst case);
@@ -52,6 +52,40 @@ MAX_SCALE = 1024
 
 _SCALE: contextvars.ContextVar = contextvars.ContextVar(
     "cylon_capacity_scale", default=1)
+
+#: trace-time overflow-flag registry. Tables carry overflow as a
+#: poisoned ``nrows > capacity`` the host check reads off the result —
+#: but a compiled query that returns only a *scalar* (q6/q14/q17 shape)
+#: has no table in its result pytree, so an internal join/groupby
+#: truncation would otherwise come back as plausible-looking on-device
+#: poison (NaN / iinfo.min). Ops therefore also register their 0-d bool
+#: overflow indicators here while tracing; :class:`CompiledQuery`
+#: returns the OR of them alongside the result and checks it on host.
+_FLAGS: contextvars.ContextVar = contextvars.ContextVar(
+    "cylon_overflow_flags", default=None)
+
+
+def note_overflow(flag) -> None:
+    """Register a 0-d bool overflow indicator with the enclosing
+    :class:`CompiledQuery` trace (no-op outside one). Ops whose result
+    cannot carry table-poison (scalar aggregates) MUST call this; ops
+    that do poison ``nrows`` may also call it — the flag check subsumes
+    the result-table scan when intermediate poison could be masked by a
+    downstream op."""
+    lst = _FLAGS.get()
+    if lst is not None:
+        import jax.numpy as jnp
+
+        lst.append(jnp.asarray(flag).reshape(()))
+
+
+@contextlib.contextmanager
+def _collect_flags(into: list):
+    tok = _FLAGS.set(into)
+    try:
+        yield
+    finally:
+        _FLAGS.reset(tok)
 
 
 @contextlib.contextmanager
@@ -167,26 +201,41 @@ class CompiledQuery:
         self._scale_memo: dict = {}  # static key -> known-good scale
 
         def traced(scale, static_pos, static_kw, dyn_pos, **dyn_kw):
+            import jax.numpy as jnp
+
             n = len(static_pos) + len(dyn_pos)
             slots = dict(static_pos)
             dyn_idx = (i for i in range(n) if i not in slots)
             slots.update(zip(dyn_idx, dyn_pos))
-            with capacity_scale(scale):
-                return fn(*(slots[i] for i in range(n)),
-                          **dict(static_kw), **dyn_kw)
+            flags: list = []
+            with capacity_scale(scale), _collect_flags(flags):
+                out = fn(*(slots[i] for i in range(n)),
+                         **dict(static_kw), **dyn_kw)
+            bad = functools.reduce(jax.numpy.logical_or, flags,
+                                   jnp.zeros((), bool))
+            return out, bad
 
         self._jitted = jax.jit(traced, static_argnums=(0, 1, 2))
 
     def __call__(self, *args, **kwargs):
+        import numpy as np
+
         dyn_pos, static_pos, static_kw, dyn_kw = _split_args(args, kwargs)
         key = (static_pos, static_kw)
         scale = self._scale_memo.get(key, 1)
         while True:
-            out = self._jitted(scale, static_pos, static_kw,
-                               tuple(dyn_pos), **dyn_kw)
+            out, bad = self._jitted(scale, static_pos, static_kw,
+                                    tuple(dyn_pos), **dyn_kw)
             if not self._check:
                 return out
             try:
+                # registered flags first (covers scalar-only results and
+                # intermediate poison masked by downstream ops), then the
+                # result-table nrows scan
+                if bool(np.asarray(bad)):
+                    raise OutOfCapacity(
+                        "an op inside the compiled query overflowed its "
+                        "capacity bound")
                 _check_overflow(out)
             except OutOfCapacity:
                 if scale >= MAX_SCALE:

@@ -58,7 +58,18 @@ class Series:
     def nrows(self):
         return self._nrows
 
+    def _require_local(self, what: str):
+        # a [W]-vector nrows means the column is mesh-distributed
+        # (frame.DataFrame.series keeps the layout); only elementwise
+        # ops are defined there
+        if getattr(self._nrows, "ndim", 0) == 1:
+            raise InvalidArgument(
+                f"{what} on a distributed Series; use the DataFrame "
+                "reductions with env= (dist_aggregate) or materialise "
+                "the frame first")
+
     def __len__(self):
+        self._require_local("len()")
         return int(self._nrows)
 
     @property
@@ -180,6 +191,7 @@ class Series:
     def dropna(self) -> "Series":
         from cylon_tpu.ops import kernels
 
+        self._require_local("dropna()")
         mask = ~self.null_flags()
         perm, count = kernels.compact_mask(mask, self._nrows)
         c = self._col
@@ -275,6 +287,7 @@ class Series:
         from cylon_tpu.ops import aggregates
         from cylon_tpu.table import Table
 
+        self._require_local(f"{op}()")
         t = Table({self.name or "x": self._col}, self._nrows)
         res = aggregates.table_aggregate(t, self.name or "x", op)
         if isinstance(res, jax.core.Tracer):

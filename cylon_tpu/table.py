@@ -184,7 +184,9 @@ class Table:
         except (jax.errors.TracerIntegerConversionError,
                 jax.errors.ConcretizationTypeError):  # under jit trace
             return self
-        bucket = max(min_capacity, 1 << max(n - 1, 0).bit_length())
+        from cylon_tpu.utils import pow2_bucket
+
+        bucket = pow2_bucket(n, min_capacity)
         if bucket < self.capacity:
             return self.with_capacity(bucket)
         return self

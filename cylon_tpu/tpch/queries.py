@@ -12,6 +12,15 @@ every join/groupby as a fused shard_map program over the mesh.
 Row-local predicates (segment/date filters) are applied before the
 first shuffle — the same predicate-pushdown any TPC-H implementation
 does — so the all-to-all only moves surviving rows.
+
+With an ``env`` the queries are distributed END TO END: inputs are laid
+out on the mesh once (``_tables``), every filter/derived column runs
+shard-local (``dist_filter`` — each shard compacts its own rows, the
+reference's per-rank SPMD contract, ``docs/docs/arch.md:41-48``),
+scalar subqueries reduce shard-local + psum (``dist_aggregate``), and
+final sorts are distributed sample-sorts. NO input is ever gathered to
+a single host buffer; only the final (small) result materialises on
+``to_pandas``. ``tests/test_no_gather.py`` pins this property.
 """
 
 from typing import Mapping
@@ -44,15 +53,41 @@ def _df(x) -> DataFrame:
     return DataFrame(x)
 
 
-def _tables(data: Mapping, names) -> list[DataFrame]:
-    """Coerce inputs to *local-layout* DataFrames. Masks in the query
-    bodies are built on ``df.table`` and applied via ``df[mask]``, which
-    filters the gathered layout — materialising upfront keeps the two
-    views identical even when a caller feeds a distributed frame in."""
+def _tables(data: Mapping, names, env=None) -> list[DataFrame]:
+    """Coerce inputs to the layout the query runs in. With an ``env``
+    every input is laid out on the mesh (already-distributed frames pass
+    through untouched) and stays there: filters, derived columns, joins,
+    groupbys and sorts all run shard-local — no input is ever gathered
+    (the reference's SPMD contract, ``docs/docs/arch.md:41-48``: every
+    rank computes on its own partition). With ``env=None`` inputs are
+    materialised to the local layout (the pandas-exact eager path)."""
     missing = [n for n in names if n not in data]
     if missing:
         raise InvalidArgument(f"tpch input missing tables {missing}")
-    return [_df(data[n])._materialized() for n in names]
+    if env is None:
+        return [_df(data[n])._materialized() for n in names]
+    from cylon_tpu.parallel import scatter_table
+
+    return [DataFrame._wrap(scatter_table(env, _df(data[n]).table))
+            for n in names]
+
+
+def _filt(df: DataFrame, mask, env=None) -> DataFrame:
+    """Row filter in the query's layout: shard-local compaction on the
+    mesh (``dist_filter`` — no gather, no collectives), pandas-exact
+    local filtering otherwise. Masks are [capacity] bool arrays built
+    elementwise on ``df.table``, so they are born in the right layout."""
+    return df.filter(mask, env=env) if df.is_distributed else df.filter(mask)
+
+
+def _agg_scalar(df: DataFrame, col: str, op: str, env=None):
+    """Scalar aggregate in the query's layout (shard-local + psum via
+    ``dist_aggregate`` on the mesh; one fused local reduce otherwise)."""
+    if df.is_distributed:
+        from cylon_tpu.parallel import dist_aggregate
+
+        return _scalar(dist_aggregate(env, df.table, col, op))
+    return _scalar(getattr(df.series(col), op)())
 
 
 def _eq_str(df: DataFrame, col: str, value: str) -> jnp.ndarray:
@@ -103,15 +138,15 @@ def q3(data: Mapping, env=None, segment: str = "BUILDING",
     if cutoff is None:
         cutoff = date_int(1995, 3, 15)
     customer, orders, lineitem = _tables(
-        data, ["customer", "orders", "lineitem"])
+        data, ["customer", "orders", "lineitem"], env)
 
-    cust = customer[_eq_str(customer, "c_mktsegment", segment)]
+    cust = _filt(customer, _eq_str(customer, "c_mktsegment", segment), env)
     cust = cust[["c_custkey"]]
-    ords = orders[jnp.asarray(orders.table.column("o_orderdate").data
-                              < jnp.int32(cutoff))]
+    ords = _filt(orders, orders.table.column("o_orderdate").data
+                 < jnp.int32(cutoff), env)
     ords = ords[["o_orderkey", "o_custkey", "o_orderdate", "o_shippriority"]]
-    li = lineitem[jnp.asarray(lineitem.table.column("l_shipdate").data
-                              > jnp.int32(cutoff))]
+    li = _filt(lineitem, lineitem.table.column("l_shipdate").data
+               > jnp.int32(cutoff), env)
     li = _with_revenue(li)[["l_orderkey", "revenue"]]
 
     oc = ords.merge(cust, left_on="o_custkey", right_on="c_custkey",
@@ -120,7 +155,8 @@ def q3(data: Mapping, env=None, segment: str = "BUILDING",
                  how="inner", env=env)
     g = j.groupby(["l_orderkey", "o_orderdate", "o_shippriority"],
                   env=env).agg([("revenue", "sum", "revenue")])
-    out = g.sort_values(["revenue", "o_orderdate"], ascending=[False, True])
+    out = g.sort_values(["revenue", "o_orderdate"], ascending=[False, True],
+                        env=env)
     out = out.head(limit)
     return out[["l_orderkey", "revenue", "o_orderdate", "o_shippriority"]]
 
@@ -145,19 +181,19 @@ def q5(data: Mapping, env=None, region: str = "ASIA",
         date_to = date_int(1995, 1, 1)
     customer, orders, lineitem, supplier, nation, reg = _tables(
         data, ["customer", "orders", "lineitem", "supplier", "nation",
-               "region"])
+               "region"], env)
 
-    reg = reg[_eq_str(reg, "r_name", region)][["r_regionkey"]]
-    # nation ⋈ region: the in-region nations (tiny — stays local)
+    reg = _filt(reg, _eq_str(reg, "r_name", region), env)[["r_regionkey"]]
+    # nation ⋈ region: the in-region nations (tiny, but layout-local)
     nat = nation.merge(reg, left_on="n_regionkey", right_on="r_regionkey",
-                       how="inner")[["n_nationkey", "n_name"]]
+                       how="inner", env=env)[["n_nationkey", "n_name"]]
     sup = supplier.merge(nat, left_on="s_nationkey",
-                         right_on="n_nationkey",
-                         how="inner")[["s_suppkey", "s_nationkey", "n_name"]]
+                         right_on="n_nationkey", how="inner",
+                         env=env)[["s_suppkey", "s_nationkey", "n_name"]]
 
     od = orders.table.column("o_orderdate").data
-    ords = orders[jnp.asarray((od >= jnp.int32(date_from))
-                              & (od < jnp.int32(date_to)))]
+    ords = _filt(orders, (od >= jnp.int32(date_from))
+                 & (od < jnp.int32(date_to)), env)
     ords = ords[["o_orderkey", "o_custkey"]]
     cust = customer[["c_custkey", "c_nationkey"]]
     li = _with_revenue(lineitem)[["l_orderkey", "l_suppkey", "revenue"]]
@@ -173,7 +209,7 @@ def q5(data: Mapping, env=None, region: str = "ASIA",
                 right_on=["s_suppkey", "s_nationkey"],
                 how="inner", env=env)
     g = j.groupby(["n_name"], env=env).agg([("revenue", "sum", "revenue")])
-    out = g.sort_values(["revenue"], ascending=[False])
+    out = g.sort_values(["revenue"], ascending=[False], env=env)
     return out[["n_name", "revenue"]]
 
 
@@ -191,9 +227,9 @@ def q1(data: Mapping, env=None, cutoff: int | None = None) -> DataFrame:
     """
     if cutoff is None:
         cutoff = date_int(1998, 9, 2)
-    (lineitem,) = _tables(data, ["lineitem"])
-    li = lineitem[jnp.asarray(lineitem.table.column("l_shipdate").data
-                              <= jnp.int32(cutoff))]
+    (lineitem,) = _tables(data, ["lineitem"], env)
+    li = _filt(lineitem, lineitem.table.column("l_shipdate").data
+               <= jnp.int32(cutoff), env)
     price = li.series("l_extendedprice")
     disc = li.series("l_discount")
     disc_price = price * (1 - disc)
@@ -211,7 +247,7 @@ def q1(data: Mapping, env=None, cutoff: int | None = None) -> DataFrame:
         ("l_discount", "mean", "avg_disc"),
         ("l_quantity", "count", "count_order"),
     ])
-    return g.sort_values(["l_returnflag", "l_linestatus"])
+    return g.sort_values(["l_returnflag", "l_linestatus"], env=env)
 
 
 def q6(data: Mapping, env=None, date_from: int | None = None,
@@ -228,7 +264,7 @@ def q6(data: Mapping, env=None, date_from: int | None = None,
         date_from = date_int(1994, 1, 1)
     if date_to is None:
         date_to = date_int(1995, 1, 1)
-    (lineitem,) = _tables(data, ["lineitem"])
+    (lineitem,) = _tables(data, ["lineitem"], env)
     t = lineitem.table
     sd = t.column("l_shipdate").data
     dc = t.column("l_discount").data
@@ -236,7 +272,7 @@ def q6(data: Mapping, env=None, date_from: int | None = None,
     mask = ((sd >= jnp.int32(date_from)) & (sd < jnp.int32(date_to))
             & (dc >= discount - 0.01001) & (dc <= discount + 0.01001)
             & (qt < quantity))
-    li = lineitem[jnp.asarray(mask)]
+    li = _filt(lineitem, mask, env)
     rev = li.series("l_extendedprice") * li.series("l_discount")
     if env is not None:
         from cylon_tpu.parallel import dist_aggregate
@@ -261,21 +297,21 @@ def q4(data: Mapping, env=None, date_from: int | None = None,
         date_from = date_int(1993, 7, 1)
     if date_to is None:
         date_to = date_int(1993, 10, 1)
-    orders, lineitem = _tables(data, ["orders", "lineitem"])
+    orders, lineitem = _tables(data, ["orders", "lineitem"], env)
 
     od = orders.table.column("o_orderdate").data
-    ords = orders[jnp.asarray((od >= jnp.int32(date_from))
-                              & (od < jnp.int32(date_to)))]
+    ords = _filt(orders, (od >= jnp.int32(date_from))
+                 & (od < jnp.int32(date_to)), env)
     ords = ords[["o_orderkey", "o_orderpriority"]]
-    late = lineitem[jnp.asarray(
-        lineitem.table.column("l_commitdate").data
-        < lineitem.table.column("l_receiptdate").data)]
+    late = _filt(lineitem,
+                 lineitem.table.column("l_commitdate").data
+                 < lineitem.table.column("l_receiptdate").data, env)
     keys = late[["l_orderkey"]].drop_duplicates(["l_orderkey"], env=env)
     j = ords.merge(keys, left_on="o_orderkey", right_on="l_orderkey",
                    how="inner", env=env)
     g = j.groupby(["o_orderpriority"], env=env).agg(
         [("o_orderkey", "count", "order_count")])
-    return g.sort_values(["o_orderpriority"])[
+    return g.sort_values(["o_orderpriority"], env=env)[
         ["o_orderpriority", "order_count"]]
 
 
@@ -298,13 +334,13 @@ def q10(data: Mapping, env=None, date_from: int | None = None,
     if date_to is None:
         date_to = date_int(1994, 1, 1)
     customer, orders, lineitem, nation = _tables(
-        data, ["customer", "orders", "lineitem", "nation"])
+        data, ["customer", "orders", "lineitem", "nation"], env)
 
     od = orders.table.column("o_orderdate").data
-    ords = orders[jnp.asarray((od >= jnp.int32(date_from))
-                              & (od < jnp.int32(date_to)))]
+    ords = _filt(orders, (od >= jnp.int32(date_from))
+                 & (od < jnp.int32(date_to)), env)
     ords = ords[["o_orderkey", "o_custkey"]]
-    li = lineitem[_eq_str(lineitem, "l_returnflag", "R")]
+    li = _filt(lineitem, _eq_str(lineitem, "l_returnflag", "R"), env)
     li = _with_revenue(li)[["l_orderkey", "revenue"]]
     cust = customer[["c_custkey", "c_nationkey", "c_acctbal"]]
     nat = nation[["n_nationkey", "n_name"]]
@@ -317,7 +353,8 @@ def q10(data: Mapping, env=None, date_from: int | None = None,
                 how="inner", env=env)
     g = j.groupby(["c_custkey", "c_acctbal", "n_name"], env=env).agg(
         [("revenue", "sum", "revenue")])
-    out = g.sort_values(["revenue", "c_custkey"], ascending=[False, True])
+    out = g.sort_values(["revenue", "c_custkey"], ascending=[False, True],
+                        env=env)
     out = out.head(limit)
     return out[["c_custkey", "revenue", "c_acctbal", "n_name"]]
 
@@ -341,7 +378,7 @@ def q12(data: Mapping, env=None, modes=("MAIL", "SHIP"),
         date_from = date_int(1994, 1, 1)
     if date_to is None:
         date_to = date_int(1995, 1, 1)
-    orders, lineitem = _tables(data, ["orders", "lineitem"])
+    orders, lineitem = _tables(data, ["orders", "lineitem"], env)
 
     t = lineitem.table
     rd = t.column("l_receiptdate").data
@@ -349,11 +386,12 @@ def q12(data: Mapping, env=None, modes=("MAIL", "SHIP"),
             & (t.column("l_commitdate").data < rd)
             & (t.column("l_shipdate").data < t.column("l_commitdate").data)
             & (rd >= jnp.int32(date_from)) & (rd < jnp.int32(date_to)))
-    li = lineitem[jnp.asarray(mask)][["l_orderkey", "l_shipmode"]]
+    li = _filt(lineitem, mask, env)[["l_orderkey", "l_shipmode"]]
     j = li.merge(orders[["o_orderkey", "o_orderpriority"]],
                  left_on="l_orderkey", right_on="o_orderkey",
                  how="inner", env=env)
-    j = j._materialized()
+    # the CASE indicators build elementwise on the (possibly
+    # distributed) joined table — no materialisation
     high = j.series("o_orderpriority").isin(["1-URGENT", "2-HIGH"])
     low = ~high
     t2 = j.table.add_column("high_line_count",
@@ -363,7 +401,7 @@ def q12(data: Mapping, env=None, modes=("MAIL", "SHIP"),
         ("high_line_count", "sum", "high_line_count"),
         ("low_line_count", "sum", "low_line_count"),
     ])
-    return g.sort_values(["l_shipmode"])[
+    return g.sort_values(["l_shipmode"], env=env)[
         ["l_shipmode", "high_line_count", "low_line_count"]]
 
 
@@ -381,11 +419,11 @@ def q14(data: Mapping, env=None, date_from: int | None = None,
         date_from = date_int(1995, 9, 1)
     if date_to is None:
         date_to = date_int(1995, 10, 1)
-    lineitem, part = _tables(data, ["lineitem", "part"])
+    lineitem, part = _tables(data, ["lineitem", "part"], env)
 
     sd = lineitem.table.column("l_shipdate").data
-    li = lineitem[jnp.asarray((sd >= jnp.int32(date_from))
-                              & (sd < jnp.int32(date_to)))]
+    li = _filt(lineitem, (sd >= jnp.int32(date_from))
+               & (sd < jnp.int32(date_to)), env)
     li = _with_revenue(li)[["l_partkey", "revenue"]]
     j = li.merge(part[["p_partkey", "p_type"]], left_on="l_partkey",
                  right_on="p_partkey", how="inner", env=env)
@@ -431,12 +469,12 @@ def q18(data: Mapping, env=None, threshold: int = 300,
     ORDER BY o_totalprice DESC, o_orderdate LIMIT :limit
     """
     customer, orders, lineitem = _tables(
-        data, ["customer", "orders", "lineitem"])
+        data, ["customer", "orders", "lineitem"], env)
 
     g = lineitem.groupby(["l_orderkey"], env=env).agg(
-        [("l_quantity", "sum", "sum_qty")])._materialized()
-    big = g[jnp.asarray(g.table.column("sum_qty").data
-                        > jnp.float64(threshold))]
+        [("l_quantity", "sum", "sum_qty")])
+    big = _filt(g, g.table.column("sum_qty").data
+                > jnp.float64(threshold), env)
     j = big.merge(orders[["o_orderkey", "o_custkey", "o_orderdate",
                           "o_totalprice"]],
                   left_on="l_orderkey", right_on="o_orderkey",
@@ -444,7 +482,7 @@ def q18(data: Mapping, env=None, threshold: int = 300,
     j = j.merge(customer[["c_custkey"]], left_on="o_custkey",
                 right_on="c_custkey", how="inner", env=env)
     out = j.sort_values(["o_totalprice", "o_orderdate"],
-                        ascending=[False, True]).head(limit)
+                        ascending=[False, True], env=env).head(limit)
     return out[["c_custkey", "o_orderkey", "o_orderdate", "o_totalprice",
                 "sum_qty"]]
 
@@ -475,11 +513,11 @@ def q19(data: Mapping, env=None,
             "q19 branch tuples must have equal length: "
             f"{len(brands)} brands, {len(quantities)} quantities, "
             f"{len(containers)} containers, {len(sizes)} sizes")
-    lineitem, part = _tables(data, ["lineitem", "part"])
+    lineitem, part = _tables(data, ["lineitem", "part"], env)
 
     pre = (lineitem.series("l_shipmode").isin(["AIR", "REG AIR"]).column.data
            & _eq_str(lineitem, "l_shipinstruct", "DELIVER IN PERSON"))
-    li = _with_revenue(lineitem[jnp.asarray(pre)])[
+    li = _with_revenue(_filt(lineitem, pre, env))[
         ["l_partkey", "l_quantity", "revenue"]]
     j = li.merge(part[["p_partkey", "p_brand", "p_container", "p_size"]],
                  left_on="l_partkey", right_on="p_partkey",
@@ -536,23 +574,25 @@ def q7(data: Mapping, env=None, nation1: str = "FRANCE",
     if date_to is None:
         date_to = date_int(1996, 12, 31)
     supplier, lineitem, orders, customer, nation = _tables(
-        data, ["supplier", "lineitem", "orders", "customer", "nation"])
+        data, ["supplier", "lineitem", "orders", "customer", "nation"], env)
 
     pair = [nation1, nation2]
-    n1 = nation[_dict_mask(nation.table.column("n_name"), pair)]
+    n1 = _filt(nation, _dict_mask(nation.table.column("n_name"), pair), env)
     n1 = n1[["n_nationkey", "n_name"]].rename(
         columns={"n_name": "supp_nation"})
-    n2 = nation[_dict_mask(nation.table.column("n_name"), pair)]
+    n2 = _filt(nation, _dict_mask(nation.table.column("n_name"), pair), env)
     n2 = n2[["n_nationkey", "n_name"]].rename(
         columns={"n_name": "cust_nation"})
     sup = supplier[["s_suppkey", "s_nationkey"]].merge(
-        n1, left_on="s_nationkey", right_on="n_nationkey", how="inner")
+        n1, left_on="s_nationkey", right_on="n_nationkey", how="inner",
+        env=env)
     cust = customer[["c_custkey", "c_nationkey"]].merge(
-        n2, left_on="c_nationkey", right_on="n_nationkey", how="inner")
+        n2, left_on="c_nationkey", right_on="n_nationkey", how="inner",
+        env=env)
 
     sd = lineitem.table.column("l_shipdate").data
-    li = lineitem[jnp.asarray((sd >= jnp.int32(date_from))
-                              & (sd <= jnp.int32(date_to)))]
+    li = _filt(lineitem, (sd >= jnp.int32(date_from))
+               & (sd <= jnp.int32(date_to)), env)
     li = _with_revenue(li)[["l_orderkey", "l_suppkey", "revenue",
                             "l_shipdate"]]
     yr = Column(year_of(li.table.column("l_shipdate").data)
@@ -567,14 +607,15 @@ def q7(data: Mapping, env=None, nation1: str = "FRANCE",
     j = j.merge(sup, left_on="l_suppkey", right_on="s_suppkey",
                 how="inner", env=env)
     g = j.groupby(["supp_nation", "cust_nation", "l_year"], env=env).agg(
-        [("revenue", "sum", "revenue")])._materialized()
+        [("revenue", "sum", "revenue")])
     t = g.table
     keep = ((_dict_mask(t.column("supp_nation"), [nation1])
              & _dict_mask(t.column("cust_nation"), [nation2]))
             | (_dict_mask(t.column("supp_nation"), [nation2])
                & _dict_mask(t.column("cust_nation"), [nation1])))
-    g = g[jnp.asarray(keep)]
-    return g.sort_values(["supp_nation", "cust_nation", "l_year"])[
+    g = _filt(g, keep, env)
+    return g.sort_values(["supp_nation", "cust_nation", "l_year"],
+                         env=env)[
         ["supp_nation", "cust_nation", "l_year", "revenue"]]
 
 
@@ -598,26 +639,28 @@ def q8(data: Mapping, env=None, nation: str = "BRAZIL",
     target = nation
     (part, supplier, lineitem, orders, customer, nations, reg
      ) = _tables(data, ["part", "supplier", "lineitem", "orders",
-                        "customer", "nation", "region"])
+                        "customer", "nation", "region"], env)
 
-    pf = part[_eq_str(part, "p_type", ptype)][["p_partkey"]]
+    pf = _filt(part, _eq_str(part, "p_type", ptype), env)[["p_partkey"]]
     # customers restricted to the region (n1 ⋈ region pushdown)
-    regk = reg[_eq_str(reg, "r_name", region)][["r_regionkey"]]
+    regk = _filt(reg, _eq_str(reg, "r_name", region), env)[["r_regionkey"]]
     n1 = nations.merge(regk, left_on="n_regionkey", right_on="r_regionkey",
-                       how="inner")[["n_nationkey"]]
+                       how="inner", env=env)[["n_nationkey"]]
     cust = customer[["c_custkey", "c_nationkey"]].merge(
-        n1, left_on="c_nationkey", right_on="n_nationkey", how="inner")
+        n1, left_on="c_nationkey", right_on="n_nationkey", how="inner",
+        env=env)
     cust = cust[["c_custkey"]]
     # supplier nation name rides the supplier side (n2)
     n2 = nations[["n_nationkey", "n_name"]].rename(
         columns={"n_name": "supp_nation"})
     sup = supplier[["s_suppkey", "s_nationkey"]].merge(
-        n2, left_on="s_nationkey", right_on="n_nationkey", how="inner")
+        n2, left_on="s_nationkey", right_on="n_nationkey", how="inner",
+        env=env)
     sup = sup[["s_suppkey", "supp_nation"]]
 
     od = orders.table.column("o_orderdate").data
-    ords = orders[jnp.asarray((od >= jnp.int32(date_int(1995, 1, 1)))
-                              & (od <= jnp.int32(date_int(1996, 12, 31))))]
+    ords = _filt(orders, (od >= jnp.int32(date_int(1995, 1, 1)))
+                 & (od <= jnp.int32(date_int(1996, 12, 31))), env)
     ords = ords[["o_orderkey", "o_custkey", "o_orderdate"]]
     yr = Column(year_of(ords.table.column("o_orderdate").data)
                 .astype(jnp.int32), None, dtypes.int32)
@@ -645,10 +688,12 @@ def q8(data: Mapping, env=None, nation: str = "BRAZIL",
     g = j.groupby(["o_year"], env=env).agg([
         ("revenue", "sum", "total"),
         ("nation_rev", "sum", "nation_total"),
-    ])._materialized()
+    ])
+    # the share ratio is elementwise — it builds on the (possibly
+    # distributed) grouped result in place
     share = g.series("nation_total") / g.series("total")
     out = DataFrame._wrap(g.table.add_column("mkt_share", share.column))
-    return out.sort_values(["o_year"])[["o_year", "mkt_share"]]
+    return out.sort_values(["o_year"], env=env)[["o_year", "mkt_share"]]
 
 
 def q9(data: Mapping, env=None, color: str = "green") -> DataFrame:
@@ -669,15 +714,17 @@ def q9(data: Mapping, env=None, color: str = "green") -> DataFrame:
 
     (part, supplier, lineitem, partsupp, orders, nation
      ) = _tables(data, ["part", "supplier", "lineitem", "partsupp",
-                        "orders", "nation"])
+                        "orders", "nation"], env)
 
-    pf = part[jnp.asarray(_dict_mask(
+    pf = _filt(part, _dict_mask(
         part.table.column("p_name"),
-        pred=lambda v: v is not None and color in str(v)))][["p_partkey"]]
+        pred=lambda v: v is not None and color in str(v)),
+        env)[["p_partkey"]]
     nat = nation[["n_nationkey", "n_name"]].rename(
         columns={"n_name": "nation"})
     sup = supplier[["s_suppkey", "s_nationkey"]].merge(
-        nat, left_on="s_nationkey", right_on="n_nationkey", how="inner")
+        nat, left_on="s_nationkey", right_on="n_nationkey", how="inner",
+        env=env)
     sup = sup[["s_suppkey", "nation"]]
     yr = Column(year_of(orders.table.column("o_orderdate").data)
                 .astype(jnp.int32), None, dtypes.int32)
@@ -705,8 +752,8 @@ def q9(data: Mapping, env=None, color: str = "green") -> DataFrame:
         "amount", Column(amount, None, dtypes.float64)))
     g = j.groupby(["nation", "o_year"], env=env).agg(
         [("amount", "sum", "profit")])
-    return g.sort_values(["nation", "o_year"], ascending=[True, False])[
-        ["nation", "o_year", "profit"]]
+    return g.sort_values(["nation", "o_year"], ascending=[True, False],
+                         env=env)[["nation", "o_year", "profit"]]
 
 
 def q11(data: Mapping, env=None, nation: str = "GERMANY",
@@ -724,11 +771,13 @@ def q11(data: Mapping, env=None, nation: str = "GERMANY",
     """
     target = nation
     partsupp, supplier, nations = _tables(
-        data, ["partsupp", "supplier", "nation"])
+        data, ["partsupp", "supplier", "nation"], env)
 
-    natk = nations[_eq_str(nations, "n_name", target)][["n_nationkey"]]
+    natk = _filt(nations, _eq_str(nations, "n_name", target),
+                 env)[["n_nationkey"]]
     sup = supplier[["s_suppkey", "s_nationkey"]].merge(
-        natk, left_on="s_nationkey", right_on="n_nationkey", how="inner")
+        natk, left_on="s_nationkey", right_on="n_nationkey", how="inner",
+        env=env)
     sup = sup[["s_suppkey"]]
     t = partsupp.table
     value = (t.column("ps_supplycost").data
@@ -739,11 +788,13 @@ def q11(data: Mapping, env=None, nation: str = "GERMANY",
     j = ps.merge(sup, left_on="ps_suppkey", right_on="s_suppkey",
                  how="inner", env=env)
     g = j.groupby(["ps_partkey"], env=env).agg(
-        [("value", "sum", "value")])._materialized()
-    total = _scalar(g.series("value").sum())
+        [("value", "sum", "value")])
+    # HAVING total: shard-local sum + psum — the grouped result never
+    # leaves the mesh
+    total = _agg_scalar(g, "value", "sum", env)
     keep = g.table.column("value").data > (fraction * total)
-    out = g[jnp.asarray(keep)]
-    return out.sort_values(["value"], ascending=[False])[
+    out = _filt(g, keep, env)
+    return out.sort_values(["value"], ascending=[False], env=env)[
         ["ps_partkey", "value"]]
 
 
@@ -768,20 +819,22 @@ def q2(data: Mapping, env=None, size: int = 15,
     ORDER BY s_acctbal DESC, n_name, s_name, p_partkey LIMIT :limit
     """
     part, supplier, partsupp, nations, reg = _tables(
-        data, ["part", "supplier", "partsupp", "nation", "region"])
+        data, ["part", "supplier", "partsupp", "nation", "region"], env)
 
-    regk = reg[_eq_str(reg, "r_name", region)][["r_regionkey"]]
+    regk = _filt(reg, _eq_str(reg, "r_name", region),
+                 env)[["r_regionkey"]]
     nat = nations.merge(regk, left_on="n_regionkey",
-                        right_on="r_regionkey",
-                        how="inner")[["n_nationkey", "n_name"]]
+                        right_on="r_regionkey", how="inner",
+                        env=env)[["n_nationkey", "n_name"]]
     sup = supplier[["s_suppkey", "s_name", "s_acctbal",
                     "s_nationkey"]].merge(
-        nat, left_on="s_nationkey", right_on="n_nationkey", how="inner")
-    pf = part[jnp.asarray(
-        (part.table.column("p_size").data == jnp.int64(size))
-        & _dict_mask(part.table.column("p_type"),
-                     pred=lambda v: v is not None
-                     and str(v).endswith(type_suffix)))]
+        nat, left_on="s_nationkey", right_on="n_nationkey", how="inner",
+        env=env)
+    pf = _filt(part,
+               (part.table.column("p_size").data == jnp.int64(size))
+               & _dict_mask(part.table.column("p_type"),
+                            pred=lambda v: v is not None
+                            and str(v).endswith(type_suffix)), env)
     pf = pf[["p_partkey", "p_mfgr"]]
 
     ps = partsupp[["ps_partkey", "ps_suppkey", "ps_supplycost"]]
@@ -791,12 +844,13 @@ def q2(data: Mapping, env=None, size: int = 15,
                 how="inner", env=env)
     mn = j.groupby(["ps_partkey"], env=env).agg(
         [("ps_supplycost", "min", "min_cost")])
-    j = j.merge(mn, on="ps_partkey", how="inner", env=env)._materialized()
+    j = j.merge(mn, on="ps_partkey", how="inner", env=env)
     t = j.table
     keep = t.column("ps_supplycost").data == t.column("min_cost").data
-    j = j[jnp.asarray(keep)]
+    j = _filt(j, keep, env)
     out = j.sort_values(["s_acctbal", "n_name", "s_name", "ps_partkey"],
-                        ascending=[False, True, True, True]).head(limit)
+                        ascending=[False, True, True, True],
+                        env=env).head(limit)
     return out[["s_acctbal", "s_name", "n_name", "ps_partkey", "p_mfgr"]]
 
 
@@ -813,13 +867,13 @@ def q13(data: Mapping, env=None, word1: str = "special",
        GROUP BY c_custkey)
     GROUP BY c_count ORDER BY custdist DESC, c_count DESC
     """
-    customer, orders = _tables(data, ["customer", "orders"])
+    customer, orders = _tables(data, ["customer", "orders"], env)
 
     keep = ~_dict_mask(
         orders.table.column("o_comment"),
         pred=lambda v: v is not None and word1 in str(v)
         and word2 in str(v)[str(v).index(word1) + len(word1):])
-    ords = orders[jnp.asarray(keep)][["o_orderkey", "o_custkey"]]
+    ords = _filt(orders, keep, env)[["o_orderkey", "o_custkey"]]
     j = customer[["c_custkey"]].merge(
         ords, left_on="c_custkey", right_on="o_custkey", how="left",
         env=env)
@@ -828,7 +882,7 @@ def q13(data: Mapping, env=None, word1: str = "special",
     g2 = g.groupby(["c_count"], env=env).agg(
         [("c_custkey", "count", "custdist")])
     return g2.sort_values(["custdist", "c_count"],
-                          ascending=[False, False])[
+                          ascending=[False, False], env=env)[
         ["c_count", "custdist"]]
 
 
@@ -849,21 +903,22 @@ def q15(data: Mapping, env=None, date_from: int | None = None,
         date_from = date_int(1996, 1, 1)
     if date_to is None:
         date_to = date_int(1996, 4, 1)
-    supplier, lineitem = _tables(data, ["supplier", "lineitem"])
+    supplier, lineitem = _tables(data, ["supplier", "lineitem"], env)
 
     sd = lineitem.table.column("l_shipdate").data
-    li = lineitem[jnp.asarray((sd >= jnp.int32(date_from))
-                              & (sd < jnp.int32(date_to)))]
+    li = _filt(lineitem, (sd >= jnp.int32(date_from))
+               & (sd < jnp.int32(date_to)), env)
     li = _with_revenue(li)[["l_suppkey", "revenue"]]
     g = li.groupby(["l_suppkey"], env=env).agg(
-        [("revenue", "sum", "total_revenue")])._materialized()
-    mx = _scalar(g.series("total_revenue").max())
-    top = g[jnp.asarray(g.table.column("total_revenue").data
-                        >= jnp.float64(mx))]
+        [("revenue", "sum", "total_revenue")])
+    # MAX over the revenue view: shard-local max + pmax
+    mx = _agg_scalar(g, "total_revenue", "max", env)
+    top = _filt(g, g.table.column("total_revenue").data
+                >= jnp.asarray(mx, jnp.float64), env)
     out = top.merge(supplier[["s_suppkey", "s_name"]],
                     left_on="l_suppkey", right_on="s_suppkey",
-                    how="inner")
-    return out.sort_values(["s_suppkey"])[
+                    how="inner", env=env)
+    return out.sort_values(["s_suppkey"], env=env)[
         ["s_suppkey", "s_name", "total_revenue"]]
 
 
@@ -878,11 +933,12 @@ def q17(data: Mapping, env=None, brand: str = "Brand#23",
       AND p_container = :container
       AND l_quantity < 0.2 * (SELECT AVG(l_quantity) ... same part)
     """
-    part, lineitem = _tables(data, ["part", "lineitem"])
+    part, lineitem = _tables(data, ["part", "lineitem"], env)
 
-    pf = part[jnp.asarray(
-        _dict_mask(part.table.column("p_brand"), [brand])
-        & _dict_mask(part.table.column("p_container"), [container]))]
+    pf = _filt(part,
+               _dict_mask(part.table.column("p_brand"), [brand])
+               & _dict_mask(part.table.column("p_container"), [container]),
+               env)
     pf = pf[["p_partkey"]]
     li = lineitem[["l_partkey", "l_quantity", "l_extendedprice"]]
     j = li.merge(pf, left_on="l_partkey", right_on="p_partkey",
@@ -925,12 +981,12 @@ def q16(data: Mapping, env=None, brand: str = "Brand#45",
     GROUP BY 1,2,3 ORDER BY 4 DESC, 1, 2, 3
     """
     part, partsupp, supplier = _tables(
-        data, ["part", "partsupp", "supplier"])
+        data, ["part", "partsupp", "supplier"], env)
 
-    good = supplier[jnp.asarray(~_dict_mask(
+    good = _filt(supplier, ~_dict_mask(
         supplier.table.column("s_comment"),
         pred=lambda v: v is not None and "Customer" in str(v)
-        and "Complaints" in str(v)[str(v).index("Customer"):]))]
+        and "Complaints" in str(v)[str(v).index("Customer"):]), env)
     good = good[["s_suppkey"]]
     sizes_arr = jnp.asarray(np.asarray(sizes, np.int64))
     t = part.table
@@ -940,8 +996,8 @@ def q16(data: Mapping, env=None, brand: str = "Brand#45",
                            and str(v).startswith(type_prefix))
              & (t.column("p_size").data[:, None]
                 == sizes_arr[None, :]).any(axis=1))
-    pf = part[jnp.asarray(pmask)][["p_partkey", "p_brand", "p_type",
-                                   "p_size"]]
+    pf = _filt(part, pmask, env)[["p_partkey", "p_brand", "p_type",
+                                  "p_size"]]
     j = partsupp[["ps_partkey", "ps_suppkey"]].merge(
         pf, left_on="ps_partkey", right_on="p_partkey", how="inner",
         env=env)
@@ -950,7 +1006,7 @@ def q16(data: Mapping, env=None, brand: str = "Brand#45",
     g = j.groupby(["p_brand", "p_type", "p_size"], env=env).agg(
         [("ps_suppkey", "nunique", "supplier_cnt")])
     return g.sort_values(["supplier_cnt", "p_brand", "p_type", "p_size"],
-                         ascending=[False, True, True, True])[
+                         ascending=[False, True, True, True], env=env)[
         ["p_brand", "p_type", "p_size", "supplier_cnt"]]
 
 
@@ -972,19 +1028,19 @@ def q20(data: Mapping, env=None, color: str = "forest",
     """
     target = nation
     part, partsupp, lineitem, supplier, nations = _tables(
-        data, ["part", "partsupp", "lineitem", "supplier", "nation"])
+        data, ["part", "partsupp", "lineitem", "supplier", "nation"], env)
     if date_from is None:
         date_from = date_int(1994, 1, 1)
     if date_to is None:
         date_to = date_int(1995, 1, 1)
 
-    pf = part[jnp.asarray(_dict_mask(
+    pf = _filt(part, _dict_mask(
         part.table.column("p_name"),
         pred=lambda v: v is not None
-        and str(v).startswith(color)))][["p_partkey"]]
+        and str(v).startswith(color)), env)[["p_partkey"]]
     sd = lineitem.table.column("l_shipdate").data
-    li = lineitem[jnp.asarray((sd >= jnp.int32(date_from))
-                              & (sd < jnp.int32(date_to)))]
+    li = _filt(lineitem, (sd >= jnp.int32(date_from))
+               & (sd < jnp.int32(date_to)), env)
     li = li[["l_partkey", "l_suppkey", "l_quantity"]]
     shipped = li.groupby(["l_partkey", "l_suppkey"], env=env).agg(
         [("l_quantity", "sum", "qty_sum")])
@@ -995,18 +1051,20 @@ def q20(data: Mapping, env=None, color: str = "forest",
     # inner join (pairs with shipments only) is the faithful semantics
     j = j.merge(shipped, left_on=["ps_partkey", "ps_suppkey"],
                 right_on=["l_partkey", "l_suppkey"], how="inner",
-                env=env)._materialized()
+                env=env)
     t = j.table
     keep = (t.column("ps_availqty").data.astype(jnp.float64)
             > 0.5 * t.column("qty_sum").data)
-    cand = j[jnp.asarray(keep)][["ps_suppkey"]].drop_duplicates(
-        ["ps_suppkey"])
-    natk = nations[_eq_str(nations, "n_name", target)][["n_nationkey"]]
+    cand = _filt(j, keep, env)[["ps_suppkey"]].drop_duplicates(
+        ["ps_suppkey"], env=env)
+    natk = _filt(nations, _eq_str(nations, "n_name", target),
+                 env)[["n_nationkey"]]
     sup = supplier[["s_suppkey", "s_name", "s_nationkey"]].merge(
-        natk, left_on="s_nationkey", right_on="n_nationkey", how="inner")
+        natk, left_on="s_nationkey", right_on="n_nationkey", how="inner",
+        env=env)
     out = cand.merge(sup, left_on="ps_suppkey", right_on="s_suppkey",
-                     how="inner")
-    return out.sort_values(["s_name"])[["s_name"]]
+                     how="inner", env=env)
+    return out.sort_values(["s_name"], env=env)[["s_name"]]
 
 
 def q21(data: Mapping, env=None, nation: str = "SAUDI ARABIA",
@@ -1029,45 +1087,49 @@ def q21(data: Mapping, env=None, nation: str = "SAUDI ARABIA",
     """
     target = nation
     supplier, lineitem, orders, nations = _tables(
-        data, ["supplier", "lineitem", "orders", "nation"])
+        data, ["supplier", "lineitem", "orders", "nation"], env)
 
     t = lineitem.table
     late_mask = (t.column("l_receiptdate").data
                  > t.column("l_commitdate").data)
     pairs = lineitem[["l_orderkey", "l_suppkey"]].drop_duplicates(
-        ["l_orderkey", "l_suppkey"])
+        ["l_orderkey", "l_suppkey"], env=env)
     nsupp = pairs.groupby(["l_orderkey"], env=env).agg(
         [("l_suppkey", "count", "nsupp")])
-    late_pairs = lineitem[jnp.asarray(late_mask)][
+    late_pairs = _filt(lineitem, late_mask, env)[
         ["l_orderkey", "l_suppkey"]].drop_duplicates(
-        ["l_orderkey", "l_suppkey"])
+        ["l_orderkey", "l_suppkey"], env=env)
     nlate = late_pairs.groupby(["l_orderkey"], env=env).agg(
         [("l_suppkey", "count", "nlate")])
     nlate = nlate.rename(columns={"l_orderkey": "lo"})
 
-    of = orders[_eq_str(orders, "o_orderstatus", "F")][["o_orderkey"]]
+    of = _filt(orders, _eq_str(orders, "o_orderstatus", "F"),
+               env)[["o_orderkey"]]
     # COUNT(*) counts qualifying late l1 ROWS (spec), so the final path
     # joins the raw late rows, not the deduped pairs (those only feed
     # the per-order distinct counts above)
-    late_rows = lineitem[jnp.asarray(late_mask)][
+    late_rows = _filt(lineitem, late_mask, env)[
         ["l_orderkey", "l_suppkey"]]
     j = late_rows.merge(of, left_on="l_orderkey", right_on="o_orderkey",
                         how="inner", env=env)
     j = j.merge(nsupp, on="l_orderkey", how="inner", env=env)
     j = j.merge(nlate, left_on="l_orderkey", right_on="lo", how="inner",
-                env=env)._materialized()
+                env=env)
     tt = j.table
     keep = ((tt.column("nsupp").data >= 2)
             & (tt.column("nlate").data == 1))
-    j = j[jnp.asarray(keep)]
-    natk = nations[_eq_str(nations, "n_name", target)][["n_nationkey"]]
+    j = _filt(j, keep, env)
+    natk = _filt(nations, _eq_str(nations, "n_name", target),
+                 env)[["n_nationkey"]]
     sup = supplier[["s_suppkey", "s_name", "s_nationkey"]].merge(
-        natk, left_on="s_nationkey", right_on="n_nationkey", how="inner")
+        natk, left_on="s_nationkey", right_on="n_nationkey", how="inner",
+        env=env)
     j = j.merge(sup, left_on="l_suppkey", right_on="s_suppkey",
-                how="inner")
-    g = j.groupby(["s_name"]).agg([("l_orderkey", "count", "numwait")])
+                how="inner", env=env)
+    g = j.groupby(["s_name"], env=env).agg(
+        [("l_orderkey", "count", "numwait")])
     return g.sort_values(["numwait", "s_name"],
-                         ascending=[False, True]).head(limit)[
+                         ascending=[False, True], env=env).head(limit)[
         ["s_name", "numwait"]]
 
 
@@ -1089,30 +1151,30 @@ def q22(data: Mapping, env=None,
     EXISTS anti-join = left join on distinct order custkeys + null
     filter.
     """
-    customer, orders = _tables(data, ["customer", "orders"])
+    customer, orders = _tables(data, ["customer", "orders"], env)
 
     code = customer.series("c_phone").map(lambda v: str(v)[:2])
     cust = DataFrame._wrap(customer.table.add_column("cntrycode",
                                                      code.column))
-    cust = cust[jnp.asarray(_dict_mask(cust.table.column("cntrycode"),
-                                       list(codes)))]
+    cust = _filt(cust, _dict_mask(cust.table.column("cntrycode"),
+                                  list(codes)), env)
     cust = cust[["c_custkey", "c_acctbal", "cntrycode"]]
     bal = cust.table.column("c_acctbal").data
-    pos = cust[jnp.asarray(bal > 0.0)]
-    avg = _scalar(pos.series("c_acctbal").mean())
-    cand = cust[jnp.asarray(cust.table.column("c_acctbal").data > avg)]
+    pos = _filt(cust, bal > 0.0, env)
+    avg = _agg_scalar(pos, "c_acctbal", "mean", env)
+    cand = _filt(cust, cust.table.column("c_acctbal").data > avg, env)
 
     active = orders[["o_custkey"]].drop_duplicates(["o_custkey"],
                                                    env=env)
     j = cand.merge(active, left_on="c_custkey", right_on="o_custkey",
-                   how="left", env=env)._materialized()
+                   how="left", env=env)
     nul = j.table.column("o_custkey")
     no_orders = (jnp.zeros(j.table.capacity, bool) if nul.validity is None
                  else ~nul.validity)
-    idle = j[jnp.asarray(no_orders)]
-    g = idle.groupby(["cntrycode"]).agg([
+    idle = _filt(j, no_orders, env)
+    g = idle.groupby(["cntrycode"], env=env).agg([
         ("c_custkey", "count", "numcust"),
         ("c_acctbal", "sum", "totacctbal"),
     ])
-    return g.sort_values(["cntrycode"])[
+    return g.sort_values(["cntrycode"], env=env)[
         ["cntrycode", "numcust", "totacctbal"]]

@@ -10,7 +10,16 @@ from cylon_tpu.utils.logging import (disable_logging, get_logger,
 from cylon_tpu.utils.tracing import (profile_to, report, reset_timings,
                                      span, timings, traced)
 
+
+def pow2_bucket(n: int, minimum: int = 1) -> int:
+    """Smallest power of two >= max(n, minimum) — THE capacity bucket
+    policy (power-of-2 buckets bound the distinct shape count and hence
+    compiles; see plan.capacity_scale)."""
+    return max(int(minimum), 1 << max(int(n) - 1, 0).bit_length())
+
+
 __all__ = [
     "disable_logging", "get_logger", "init_logging", "log_level",
+    "pow2_bucket",
     "profile_to", "report", "reset_timings", "span", "timings", "traced",
 ]

@@ -144,9 +144,20 @@ def test_rename_drop_astype():
 
 # ----------------------------------------- review-finding regressions
 def test_distributed_mask_filter(env8, rng):
+    from cylon_tpu.errors import InvalidArgument
+
     df = DataFrame(pd.DataFrame({"a": np.arange(40)}), env=env8)
-    got = df[np.asarray(df["a"].to_dict()["a"]) % 2 == 0]
-    assert len(got) == 20
+    # layout-safe path: the mask is built elementwise on the padded
+    # shard layout and applied shard-local (no gather)
+    got = df.filter(df.table.column("a").data % 2 == 0, env=env8)
+    assert got.is_distributed and len(got) == 20
+    # Series masks carry validity and work too
+    got2 = df.filter(df.series("a") % 2 == 0, env=env8)
+    assert len(got2) == 20
+    # df[mask] on a distributed frame is ambiguous (padded vs gathered
+    # order) and must refuse rather than silently select wrong rows
+    with pytest.raises(InvalidArgument):
+        df[np.asarray(df["a"].to_dict()["a"]) % 2 == 0]
 
 
 def test_setitem_on_distributed(env8):

@@ -117,6 +117,25 @@ def test_compiled_query_fuses_and_regrows(rng):
     assert list(q._scale_memo.values()) == [8]
 
 
+def test_compiled_scalar_query_regrows(rng):
+    """ADVICE r2 (medium): a compiled query returning only a SCALAR has
+    no table in its result pytree; an internal join overflow must still
+    drive the regrow ladder (plan.note_overflow) instead of returning
+    the on-device poison (NaN) and memoizing scale 1 as known-good."""
+    from cylon_tpu.ops.aggregates import table_aggregate
+
+    @compile_query
+    def q(l, r):
+        j = join(l, r, on="k", how="inner")
+        return table_aggregate(j, "v", "sum")
+
+    n = 64
+    k = np.zeros(n, np.int64)  # n*n join rows >> default capacity
+    out = q(Table.from_pydict({"k": k, "v": np.ones(n)}),
+            Table.from_pydict({"k": k, "w": np.ones(n)}))
+    assert float(np.asarray(out)) == float(n * n)
+
+
 def test_local_overflow_poison_propagates(rng):
     """A truncated local join feeding a groupby must poison the final
     result (kernels.carry_overflow) — under whole-query fusion there is
