@@ -75,7 +75,17 @@ class SortOptions:
 
 @dataclasses.dataclass(frozen=True)
 class CSVReadOptions:
-    """Parity: ``io/csv_read_config.hpp:28-152`` (builder methods become fields)."""
+    """Parity: ``io/csv_read_config.hpp:28-152`` — every builder method
+    becomes a field (UseThreads, WithDelimiter, IgnoreEmptyLines,
+    BlockSize, IncludeColumns, SkipRows, ColumnNames,
+    AutoGenerateColumnNames, UseQuoting/WithQuoteChar/DoubleQuote,
+    UseEscaping/EscapingCharacter, HasNewLinesInValues, NullValues,
+    TrueValues/FalseValues, StringsCanBeNull, WithColumnTypes,
+    ConcurrentFileReads, Slice, IncludeMissingColumns).
+
+    The native engine handles quoting, ``na_values`` and
+    ``column_types``; escaping, true/false values, embedded newlines and
+    skip_rows route to the arrow engine automatically."""
 
     use_threads: bool = True
     delimiter: str = ","
@@ -85,6 +95,37 @@ class CSVReadOptions:
     skip_rows: int = 0
     column_names: Sequence[str] | None = None
     slice: bool = False  # distributed read: shard rows across the mesh
+    concurrent_file_reads: bool = True
+    auto_generate_column_names: bool = False
+    # quoting (UseQuoting/WithQuoteChar/DoubleQuote)
+    use_quoting: bool = True
+    quote_char: str = '"'
+    double_quote: bool = True
+    # escaping (UseEscaping/EscapingCharacter)
+    use_escaping: bool = False
+    escaping_character: str = "\\"
+    has_newlines_in_values: bool = False
+    # null/bool spellings (NullValues/TrueValues/FalseValues/
+    # StringsCanBeNull)
+    na_values: Sequence[str] | None = None
+    true_values: Sequence[str] | None = None
+    false_values: Sequence[str] | None = None
+    strings_can_be_null: bool = False
+    # explicit per-column dtypes (WithColumnTypes): {name: "int64" |
+    # "float64" | "str" | np.dtype-like}
+    column_types: "dict | None" = None
+    include_missing_columns: bool = False
+
+    def __hash__(self):  # dict/sequence fields -> canonical tuples
+        def h(v):
+            if isinstance(v, dict):
+                return tuple(sorted((k, str(x)) for k, x in v.items()))
+            if isinstance(v, (list, tuple)):
+                return tuple(v)
+            return v
+
+        return hash(tuple(h(getattr(self, f.name))
+                          for f in dataclasses.fields(self)))
 
 
 @dataclasses.dataclass(frozen=True)

@@ -26,6 +26,20 @@ def _native_ok() -> bool:
         return False
 
 
+def _column_types_arrow(column_types):
+    """{name: "int64"|"float64"|"str"|np.dtype-like} -> pyarrow types."""
+    import numpy as np
+    import pyarrow as pa
+
+    out = {}
+    for name, t in (column_types or {}).items():
+        if t in ("str", "string", str):
+            out[name] = pa.string()
+        else:
+            out[name] = pa.from_numpy_dtype(np.dtype(t))
+    return out or None
+
+
 def _arrow_csv_read(path, options: CSVReadOptions):
     import pyarrow.csv as pacsv
 
@@ -35,13 +49,33 @@ def _arrow_csv_read(path, options: CSVReadOptions):
         skip_rows=options.skip_rows,
         column_names=(list(options.column_names)
                       if options.column_names else None),
+        autogenerate_column_names=options.auto_generate_column_names,
     )
     parse_opts = pacsv.ParseOptions(
         delimiter=options.delimiter,
         ignore_empty_lines=options.ignore_emptylines,
+        quote_char=(options.quote_char if options.use_quoting else False),
+        double_quote=options.double_quote,
+        escape_char=(options.escaping_character if options.use_escaping
+                     else False),
+        newlines_in_values=options.has_newlines_in_values,
     )
-    convert = pacsv.ConvertOptions(
-        include_columns=(list(options.use_cols) if options.use_cols else None))
+    convert_kw = dict(
+        include_columns=(list(options.use_cols) if options.use_cols
+                         else None),
+        include_missing_columns=options.include_missing_columns,
+        strings_can_be_null=options.strings_can_be_null,
+        column_types=_column_types_arrow(options.column_types),
+    )
+    # pyarrow treats empty lists as "nothing is null/true/false"; only
+    # override its defaults when the caller actually set spellings
+    if options.na_values is not None:
+        convert_kw["null_values"] = list(options.na_values)
+    if options.true_values is not None:
+        convert_kw["true_values"] = list(options.true_values)
+    if options.false_values is not None:
+        convert_kw["false_values"] = list(options.false_values)
+    convert = pacsv.ConvertOptions(**convert_kw)
     return pacsv.read_csv(path, read_options=read_opts,
                           parse_options=parse_opts, convert_options=convert)
 
@@ -62,25 +96,63 @@ def read_csv(paths, options: CSVReadOptions | None = None,
     single = isinstance(paths, (str, bytes))
     path_list = [paths] if single else list(paths)
 
-    plain = options.skip_rows == 0 and options.column_names is None
+    # the native engine covers plain reads plus quoting/na_values/dtype
+    # overrides; the rest (skip_rows, explicit/auto column names,
+    # escaping, embedded newlines, bool spellings, arrow's implicit
+    # default null spellings for strings, missing-column filling,
+    # non-{int64,float64,str} dtype overrides) routes to arrow
+    def _native_dtype_ok(t):
+        import numpy as np
+
+        if t in ("str", "string", str):
+            return True
+        try:
+            return str(np.dtype(t)) in ("int64", "float64")
+        except TypeError:
+            return False
+
+    plain = (options.skip_rows == 0 and options.column_names is None
+             and not options.auto_generate_column_names
+             and not options.use_escaping
+             and not options.has_newlines_in_values
+             and options.true_values is None
+             and options.false_values is None
+             and options.double_quote
+             and not options.include_missing_columns
+             and not (options.strings_can_be_null
+                      and options.na_values is None)
+             and all(_native_dtype_ok(t)
+                     for t in (options.column_types or {}).values()))
     if engine == "native" or (engine == "auto" and plain and _native_ok()):
         if not plain:
             from cylon_tpu.errors import NotImplemented_
 
             raise NotImplemented_(
-                "native csv engine does not support skip_rows/column_names;"
-                " use engine='arrow'")
+                "native csv engine does not support skip_rows/"
+                "column_names/escaping/newlines-in-values/bool "
+                "spellings/missing-column filling/default null "
+                "spellings/non-{int64,float64,str} dtype overrides; "
+                "use engine='arrow'")
         from cylon_tpu import native
 
+        kw = dict(
+            quote_char=(options.quote_char if options.use_quoting
+                        else None),
+            na_values=(list(options.na_values)
+                       if options.na_values else None),
+            column_types=options.column_types,
+            strings_can_be_null=options.strings_can_be_null,
+        )
         try:
             if len(path_list) == 1:
                 t = native.csv_to_table(path_list[0], options.delimiter,
-                                        capacity=capacity)
+                                        capacity=capacity, **kw)
             else:
                 with ThreadPoolExecutor(
                         max_workers=min(8, len(path_list))) as ex:
                     tables = list(ex.map(
-                        lambda p: native.csv_to_table(p, options.delimiter),
+                        lambda p: native.csv_to_table(
+                            p, options.delimiter, **kw),
                         path_list))
                 from cylon_tpu.ops.selection import concat_tables
 

@@ -81,6 +81,10 @@ def _declare(lib):
 
     lib.cylon_csv_read.restype = c.c_void_p
     lib.cylon_csv_read.argtypes = [c.c_char_p, c.c_char, c.c_int, c.c_int]
+    lib.cylon_csv_read_opts.restype = c.c_void_p
+    lib.cylon_csv_read_opts.argtypes = [
+        c.c_char_p, c.c_char, c.c_int, c.c_int, c.c_char, c.c_char_p,
+        c.c_char_p, c.c_int]
     lib.cylon_csv_error.restype = c.c_char_p
     lib.cylon_csv_error.argtypes = [c.c_void_p]
     lib.cylon_csv_num_rows.restype = c.c_int64
@@ -203,20 +207,59 @@ def murmur3_int64(keys: np.ndarray, seed: int = 0) -> np.ndarray:
 _COL_INT64, _COL_FLOAT64, _COL_STRING = 0, 1, 2
 
 
+#: ColType ints of the native parser (cylon_host.h)
+_NATIVE_TYPES = {"int64": 0, "float64": 1, "str": 2, "string": 2}
+
+
+def _native_type_spec(column_types) -> bytes | None:
+    if not column_types:
+        return None
+    parts = []
+    for name, t in column_types.items():
+        if t in (str,):
+            code = 2
+        elif str(t) in _NATIVE_TYPES:
+            code = _NATIVE_TYPES[str(t)]
+        else:
+            key = str(np.dtype(t))
+            if key not in ("int64", "float64"):
+                raise NotImplementedError(
+                    f"native csv engine cannot represent dtype {t!r} for "
+                    f"column {name!r} (int64/float64/str only); use "
+                    f"engine='arrow'")
+            code = {"int64": 0, "float64": 1}[key]
+        parts.append(f"{name}\x1f{code}")
+    return (";".join(parts)).encode()
+
+
 def read_csv_native(path: str, delimiter: str = ",", header: bool = True,
-                    n_threads: int = 0) -> dict:
+                    n_threads: int = 0, quote_char: str | None = None,
+                    na_values=None, column_types=None,
+                    strings_can_be_null: bool = False) -> dict:
     """Chunk-parallel CSV parse → dict of numpy columns (+ dictionaries).
 
     Returns ``{name: ndarray}`` where string columns come back as
     ``(codes int32, values ndarray[object], validity)`` triples ready for
     :class:`cylon_tpu.column.Column`; numeric columns are int64/float64
     arrays (with a validity array when nulls were seen).
+
+    ``quote_char``/``na_values``/``column_types``/``strings_can_be_null``
+    mirror the reference's UseQuoting/NullValues/WithColumnTypes/
+    StringsCanBeNull (csv_read_config.hpp:80-141).
     """
     lib = _load()
     if lib is None:
         raise RuntimeError(f"native runtime unavailable: {_build_error}")
-    h = lib.cylon_csv_read(path.encode(), delimiter.encode(),
-                           1 if header else 0, n_threads)
+    if quote_char or na_values or column_types:
+        na = ("\x1f".join(na_values).encode() if na_values else None)
+        h = lib.cylon_csv_read_opts(
+            path.encode(), delimiter.encode(), 1 if header else 0,
+            n_threads, (quote_char or "\x00").encode(), na,
+            _native_type_spec(column_types),
+            1 if strings_can_be_null else 0)
+    else:
+        h = lib.cylon_csv_read(path.encode(), delimiter.encode(),
+                               1 if header else 0, n_threads)
     try:
         err = lib.cylon_csv_error(h)
         if err:
@@ -256,7 +299,9 @@ def read_csv_native(path: str, delimiter: str = ",", header: bool = True,
 
 
 def csv_to_table(path: str, delimiter: str = ",", header: bool = True,
-                 n_threads: int = 0, capacity: int | None = None):
+                 n_threads: int = 0, capacity: int | None = None,
+                 quote_char: str | None = None, na_values=None,
+                 column_types=None, strings_can_be_null: bool = False):
     """Native CSV → device :class:`cylon_tpu.table.Table`."""
     import jax.numpy as jnp
 
@@ -264,7 +309,10 @@ def csv_to_table(path: str, delimiter: str = ",", header: bool = True,
     from cylon_tpu.column import Column, Dictionary
     from cylon_tpu.table import Table
 
-    raw = read_csv_native(path, delimiter, header, n_threads)
+    raw = read_csv_native(path, delimiter, header, n_threads,
+                          quote_char=quote_char, na_values=na_values,
+                          column_types=column_types,
+                          strings_can_be_null=strings_can_be_null)
     cols = {}
     n = 0
     for name, payload in raw.items():

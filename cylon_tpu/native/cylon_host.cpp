@@ -23,8 +23,10 @@
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <deque>
 #include <fstream>
 #include <unordered_map>
+#include <unordered_set>
 #include <functional>
 #include <algorithm>
 #include <limits>
@@ -310,6 +312,86 @@ static void split_fields(const char* line, size_t len, char delim,
   }
 }
 
+// Quote-aware variant (parity: csv_read_config UseQuoting/WithQuoteChar/
+// DoubleQuote): a field starting with `quote` runs to the closing quote,
+// may contain the delimiter, and encodes a literal quote as a doubled
+// one. Unescaped bytes are materialised into `arena` (cleared per line
+// by the caller); embedded newlines are NOT supported on this path —
+// the chunker splits at raw newlines (callers with
+// has_newlines_in_values use the arrow engine).
+static void split_fields_q(const char* line, size_t len, char delim,
+                           char quote, std::deque<std::string>* arena,
+                           std::vector<std::pair<const char*, size_t>>* out,
+                           bool* unterminated) {
+  out->clear();
+  size_t i = 0;
+  while (i <= len) {
+    if (i < len && line[i] == quote) {
+      std::string buf;
+      size_t j = i + 1;
+      bool closed = false;
+      while (j < len) {
+        if (line[j] == quote) {
+          if (j + 1 < len && line[j + 1] == quote) {
+            buf.push_back(quote);
+            j += 2;
+          } else {
+            j++;
+            closed = true;
+            break;
+          }
+        } else {
+          buf.push_back(line[j++]);
+        }
+      }
+      // a quoted field running past end-of-line means the value
+      // contains a raw newline — the chunker split inside it; callers
+      // must fail (arrow with has_newlines_in_values handles those)
+      if (!closed && unterminated) *unterminated = true;
+      arena->push_back(std::move(buf));
+      out->push_back({arena->back().data(), arena->back().size()});
+      while (j < len && line[j] != delim) j++;  // skip \r etc.
+      if (j >= len) return;
+      i = j + 1;
+    } else {
+      size_t j = i;
+      while (j < len && line[j] != delim) j++;
+      size_t flen = j - i;
+      while (flen > 0 && line[i + flen - 1] == '\r') flen--;
+      out->push_back({line + i, flen});
+      if (j >= len) return;
+      i = j + 1;
+    }
+  }
+}
+
+struct CsvOpts {
+  char quote = 0;  // 0 = quoting off
+  bool strings_null = false;  // NullValues apply to string columns too
+  std::vector<std::string> na;  // tiny: linear memcmp beats hashing
+  std::unordered_map<std::string, int32_t> type_overrides;  // name -> ColType
+};
+
+static void csv_split(const char* line, size_t len, char delim,
+                      const CsvOpts& o, std::deque<std::string>* arena,
+                      std::vector<std::pair<const char*, size_t>>* out,
+                      bool* unterminated = nullptr) {
+  if (o.quote) {
+    arena->clear();
+    split_fields_q(line, len, delim, o.quote, arena, out, unterminated);
+  } else {
+    split_fields(line, len, delim, out);
+  }
+}
+
+static bool is_na(const CsvOpts& o, const char* s, size_t len) {
+  // hot per-cell path: no allocations (the na list is a handful of
+  // short spellings)
+  for (const auto& v : o.na)
+    if (v.size() == len && std::memcmp(v.data(), s, len) == 0) return true;
+  return false;
+}
+
 static bool parse_i64(const char* s, size_t len, int64_t* out) {
   if (len == 0) return false;
   char buf[32];
@@ -338,8 +420,8 @@ static bool parse_f64(const char* s, size_t len, double* out) {
   return true;
 }
 
-void* cylon_csv_read(const char* path, char delim, int has_header,
-                     int n_threads) {
+static void* csv_read_impl(const char* path, char delim, int has_header,
+                           int n_threads, const CsvOpts& opt) {
   auto* res = new CsvResult();
   std::ifstream f(path, std::ios::binary | std::ios::ate);
   if (!f) {
@@ -357,9 +439,10 @@ void* cylon_csv_read(const char* path, char delim, int has_header,
   // header
   size_t pos = 0;
   std::vector<std::pair<const char*, size_t>> fields;
+  std::deque<std::string> arena;
   size_t first_nl = content.find('\n');
   if (first_nl == std::string::npos) first_nl = content.size();
-  split_fields(content.data(), first_nl, delim, &fields);
+  csv_split(content.data(), first_nl, delim, opt, &arena, &fields);
   res->n_cols = static_cast<int32_t>(fields.size());
   if (has_header) {
     for (auto& fd : fields) res->names.emplace_back(fd.first, fd.second);
@@ -369,26 +452,40 @@ void* cylon_csv_read(const char* path, char delim, int has_header,
       res->names.push_back("f" + std::to_string(i));
   }
 
-  // type inference from first data row
-  size_t probe_end = content.find('\n', pos);
-  if (probe_end == std::string::npos) probe_end = content.size();
-  if (pos >= content.size()) {
-    res->types.assign(res->n_cols, COL_STRING);
-  } else {
-    split_fields(content.data() + pos, probe_end - pos, delim, &fields);
-    for (size_t i = 0; i < static_cast<size_t>(res->n_cols); i++) {
-      int64_t iv;
-      double dv;
-      if (i >= fields.size()) {
-        res->types.push_back(COL_STRING);
-      } else if (parse_i64(fields[i].first, fields[i].second, &iv)) {
-        res->types.push_back(COL_INT64);
-      } else if (parse_f64(fields[i].first, fields[i].second, &dv)) {
-        res->types.push_back(COL_FLOAT64);
-      } else {
-        res->types.push_back(COL_STRING);
+  // type inference: first non-NA value per column decides, scanning up
+  // to 100 rows (a single-row probe would stringify numeric columns
+  // whose first value is one of na_values)
+  res->types.assign(res->n_cols, -1);
+  {
+    size_t p = pos;
+    int32_t resolved = 0;
+    for (int probe = 0; probe < 100 && p < content.size()
+                        && resolved < res->n_cols; probe++) {
+      size_t nl = content.find('\n', p);
+      if (nl == std::string::npos) nl = content.size();
+      csv_split(content.data() + p, nl - p, delim, opt, &arena, &fields);
+      for (size_t i = 0; i < static_cast<size_t>(res->n_cols); i++) {
+        if (res->types[i] != -1 || i >= fields.size()) continue;
+        const char* s = fields[i].first;
+        size_t sl = fields[i].second;
+        if (sl == 0 || is_na(opt, s, sl)) continue;  // undecided
+        int64_t iv;
+        double dv;
+        if (parse_i64(s, sl, &iv)) res->types[i] = COL_INT64;
+        else if (parse_f64(s, sl, &dv)) res->types[i] = COL_FLOAT64;
+        else res->types[i] = COL_STRING;
+        resolved++;
       }
+      p = nl + 1;
     }
+    for (auto& t : res->types)
+      if (t == -1) t = COL_STRING;  // all-null/empty columns
+  }
+  // explicit per-column dtype overrides (parity: WithColumnTypes,
+  // csv_read_config.hpp:113)
+  for (size_t i = 0; i < res->names.size(); i++) {
+    auto it = opt.type_overrides.find(res->names[i]);
+    if (it != opt.type_overrides.end()) res->types[i] = it->second;
   }
 
   // chunk boundaries at newlines
@@ -424,6 +521,7 @@ void* cylon_csv_read(const char* path, char delim, int has_header,
         out.str.resize(ncols);
         out.valid.resize(ncols);
         std::vector<std::pair<const char*, size_t>> fds;
+        std::deque<std::string> chunk_arena;
         size_t p = ranges[c].first;
         const size_t end = ranges[c].second;
         while (p < end) {
@@ -439,28 +537,37 @@ void* cylon_csv_read(const char* path, char delim, int has_header,
                 break;
               }
             if (!empty) {
-              split_fields(content.data() + p, linelen, delim, &fds);
+              bool unterm = false;
+              csv_split(content.data() + p, linelen, delim, opt,
+                        &chunk_arena, &fds, &unterm);
+              if (unterm) {
+                failed.store(true);
+                break;
+              }
               out.rows++;
               for (int col = 0; col < ncols; col++) {
                 const char* s = col < (int)fds.size() ? fds[col].first : "";
                 size_t sl = col < (int)fds.size() ? fds[col].second : 0;
-                uint8_t ok = 1;
+                uint8_t ok = is_na(opt, s, sl) ? 0 : 1;
                 switch (res->types[col]) {
                   case COL_INT64: {
                     int64_t v = 0;
-                    if (!parse_i64(s, sl, &v)) ok = 0;
+                    if (!ok || !parse_i64(s, sl, &v)) ok = 0, v = 0;
                     out.i64[col].push_back(v);
                     break;
                   }
                   case COL_FLOAT64: {
                     double v = 0;
-                    if (!parse_f64(s, sl, &v)) ok = 0;
+                    if (!ok || !parse_f64(s, sl, &v)) ok = 0, v = 0;
                     out.f64[col].push_back(v);
                     break;
                   }
                   default: {
+                    // arrow semantics: NullValues hit string columns
+                    // only under StringsCanBeNull
+                    if (!ok && !opt.strings_null) ok = 1;
                     if (sl == 0) ok = 0;
-                    out.str[col].emplace_back(s, sl);
+                    out.str[col].emplace_back(ok ? s : "", ok ? sl : 0);
                     break;
                   }
                 }
@@ -475,7 +582,8 @@ void* cylon_csv_read(const char* path, char delim, int has_header,
     tp.wait_all();
   }
   if (failed.load()) {
-    res->error = "parse failed";
+    res->error = "quoted field contains a raw newline; read with "
+                 "has_newlines_in_values (arrow engine)";
     return res;
   }
 
@@ -527,6 +635,52 @@ void* cylon_csv_read(const char* path, char delim, int has_header,
     }
   }
   return res;
+}
+
+void* cylon_csv_read(const char* path, char delim, int has_header,
+                     int n_threads) {
+  return csv_read_impl(path, delim, has_header, n_threads, CsvOpts());
+}
+
+// Extended reader (parity: csv_read_config.hpp UseQuoting/WithQuoteChar/
+// NullValues/WithColumnTypes).
+//   quote_char:  0 disables quoting.
+//   na_values:   '\x1f'-joined null spellings, or NULL.
+//   col_types:   "name\x1ftype;..." with type = ColType int, or NULL.
+void* cylon_csv_read_opts(const char* path, char delim, int has_header,
+                          int n_threads, char quote_char,
+                          const char* na_values, const char* col_types,
+                          int strings_can_be_null) {
+  CsvOpts opt;
+  opt.quote = quote_char;
+  opt.strings_null = strings_can_be_null != 0;
+  if (na_values && *na_values) {
+    const char* s = na_values;
+    while (true) {
+      const char* sep = strchr(s, '\x1f');
+      if (!sep) {
+        opt.na.emplace_back(s);
+        break;
+      }
+      opt.na.emplace_back(s, sep - s);
+      s = sep + 1;
+    }
+  }
+  if (col_types && *col_types) {
+    const char* s = col_types;
+    while (*s) {
+      const char* sep = strchr(s, '\x1f');
+      if (!sep) break;  // malformed: ignore rest
+      const char* end = strchr(sep + 1, ';');
+      std::string name(s, sep - s);
+      int32_t t = static_cast<int32_t>(
+          strtol(sep + 1, nullptr, 10));
+      if (t >= COL_INT64 && t <= COL_STRING) opt.type_overrides[name] = t;
+      if (!end) break;
+      s = end + 1;
+    }
+  }
+  return csv_read_impl(path, delim, has_header, n_threads, opt);
 }
 
 const char* cylon_csv_error(void* r) {

@@ -52,6 +52,17 @@ void     cylon_threadpool_wait(void* tp);
  * encoded string (int32 codes + per-column dictionary). */
 void*       cylon_csv_read(const char* path, char delim, int has_header,
                            int n_threads);
+/* Extended options (parity: UseQuoting/WithQuoteChar/NullValues/
+ * WithColumnTypes of csv_read_config.hpp):
+ *   quote_char  0 disables quoting; else RFC-4180 quoting with doubled
+ *               quotes for literals (no embedded newlines).
+ *   na_values   '\x1f'-joined null spellings, or NULL.
+ *   col_types   "name\x1f<type int>;..." per-column overrides, or NULL. */
+void*       cylon_csv_read_opts(const char* path, char delim,
+                                int has_header, int n_threads,
+                                char quote_char, const char* na_values,
+                                const char* col_types,
+                                int strings_can_be_null);
 const char* cylon_csv_error(void* r);          /* NULL when ok */
 int64_t     cylon_csv_num_rows(void* r);
 int32_t     cylon_csv_num_cols(void* r);

@@ -165,3 +165,134 @@ def test_read_csv_sharded_wrong_count(tmp_path, env8):
     pd.DataFrame({"a": [1]}).to_csv(p, index=False)
     with pytest.raises(InvalidArgument):
         read_csv_sharded([str(p)] * 3, env8)
+
+
+# ------------------------------------------------- CSV options parity
+def _write(tmp_path, name, text):
+    p = tmp_path / name
+    p.write_text(text)
+    return str(p)
+
+
+def _native_available():
+    try:
+        from cylon_tpu import native
+
+        return native.available()
+    except Exception:
+        return False
+
+
+ENGINES = ["arrow",
+           pytest.param("native", marks=pytest.mark.skipif(
+               not _native_available(), reason="native runtime not built"))]
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+def test_csv_quoting(tmp_path, engine):
+    """RFC-4180 quoting: embedded delimiters and doubled quotes
+    (parity: UseQuoting/WithQuoteChar/DoubleQuote,
+    csv_read_config.hpp:80-95)."""
+    p = _write(tmp_path, "q.csv",
+               'a,b\n1,"x,y"\n2,"he said ""hi"""\n3,plain\n')
+    df = read_csv(p, engine=engine)
+    assert df.to_dict() == {"a": [1, 2, 3],
+                            "b": ["x,y", 'he said "hi"', "plain"]}
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+def test_csv_na_values(tmp_path, engine):
+    """Custom null spellings (parity: NullValues + StringsCanBeNull,
+    csv_read_config.hpp:119,135)."""
+    from cylon_tpu.config import CSVReadOptions
+
+    p = _write(tmp_path, "na.csv", "a,b,s\n1,2.5,x\nNA,-99,NA\n3,4.5,z\n")
+    opts = CSVReadOptions(na_values=["NA", "-99"])
+    df = read_csv(p, opts, engine=engine)
+    pdf = df.to_pandas()
+    assert pdf["a"].isna().tolist() == [False, True, False]
+    assert pdf["b"].isna().tolist() == [False, True, False]
+    # strings keep the literal "NA" unless strings_can_be_null
+    assert pdf["s"].tolist() == ["x", "NA", "z"]
+
+    opts2 = CSVReadOptions(na_values=["NA"], strings_can_be_null=True)
+    df2 = read_csv(p, opts2, engine=engine)
+    assert df2.to_pandas()["s"].isna().tolist() == [False, True, False]
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+def test_csv_column_types(tmp_path, engine):
+    """Explicit dtype overrides (parity: WithColumnTypes,
+    csv_read_config.hpp:113): an int-looking column forced to float64
+    and to string."""
+    from cylon_tpu.config import CSVReadOptions
+
+    p = _write(tmp_path, "t.csv", "a,b\n1,2\n3,4\n")
+    df = read_csv(p, CSVReadOptions(column_types={"a": "float64",
+                                                  "b": "str"}),
+                  engine=engine)
+    assert str(df.table.column("a").data.dtype) == "float64"
+    assert df.to_dict() == {"a": [1.0, 3.0], "b": ["2", "4"]}
+
+
+@pytest.mark.skipif(not _native_available(),
+                    reason="native runtime not built")
+def test_csv_na_inference_skips_null_rows(tmp_path):
+    """A numeric column whose FIRST value is a null spelling must still
+    infer as numeric (multi-row probe)."""
+    from cylon_tpu.config import CSVReadOptions
+
+    p = _write(tmp_path, "n.csv", "a\nNA\n7\n8\n")
+    df = read_csv(p, CSVReadOptions(na_values=["NA"]), engine="native")
+    pdf = df.to_pandas()
+    assert pdf["a"].isna().tolist() == [True, False, False]
+    assert pdf["a"].iloc[1] == 7
+
+
+def test_csv_true_false_values(tmp_path):
+    """Custom bool spellings route to the arrow engine (parity:
+    TrueValues/FalseValues, csv_read_config.hpp:124-129)."""
+    from cylon_tpu.config import CSVReadOptions
+
+    p = _write(tmp_path, "b.csv", "f\nYES\nNO\nYES\n")
+    df = read_csv(p, CSVReadOptions(true_values=["YES"],
+                                    false_values=["NO"]))
+    assert df.to_dict()["f"] == [True, False, True]
+
+
+def test_csv_escaping_and_autogen_names(tmp_path):
+    """Escape-character parsing + AutoGenerateColumnNames (arrow
+    engine; parity: UseEscaping/EscapingCharacter:95-100,
+    AutoGenerateColumnNames:71)."""
+    from cylon_tpu.config import CSVReadOptions
+
+    p = _write(tmp_path, "e.csv", '1,x\\,y\n2,z\n')
+    df = read_csv(p, CSVReadOptions(use_escaping=True,
+                                    use_quoting=False,
+                                    auto_generate_column_names=True))
+    assert df.to_dict() == {"f0": [1, 2], "f1": ["x,y", "z"]}
+
+
+@pytest.mark.skipif(not _native_available(),
+                    reason="native runtime not built")
+def test_csv_embedded_newline_native_refuses(tmp_path):
+    """A raw newline inside a quoted field breaks newline chunking —
+    the native engine must ERROR, never silently mis-split; the arrow
+    engine handles it under has_newlines_in_values."""
+    from cylon_tpu.config import CSVReadOptions
+
+    p = _write(tmp_path, "nl.csv", 'a,b\n1,"x\ny"\n')
+    with pytest.raises(IOError_):
+        read_csv(p, engine="native")
+    df = read_csv(p, CSVReadOptions(has_newlines_in_values=True))
+    assert df.to_dict() == {"a": [1], "b": ["x\ny"]}
+
+
+def test_csv_unsupported_native_dtype_routes_to_arrow(tmp_path):
+    """column_types={'a': 'int32'} is representable only by arrow; auto
+    routing must pick arrow instead of crashing the native path."""
+    from cylon_tpu.config import CSVReadOptions
+
+    p = _write(tmp_path, "i32.csv", "a\n1\n2\n")
+    df = read_csv(p, CSVReadOptions(column_types={"a": "int32"}))
+    assert str(df.table.column("a").data.dtype) == "int32"
