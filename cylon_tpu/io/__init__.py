@@ -101,15 +101,7 @@ def read_csv(paths, options: CSVReadOptions | None = None,
     # escaping, embedded newlines, bool spellings, arrow's implicit
     # default null spellings for strings, missing-column filling,
     # non-{int64,float64,str} dtype overrides) routes to arrow
-    def _native_dtype_ok(t):
-        import numpy as np
-
-        if t in ("str", "string", str):
-            return True
-        try:
-            return str(np.dtype(t)) in ("int64", "float64")
-        except TypeError:
-            return False
+    from cylon_tpu.native import csv_dtype_ok as _native_dtype_ok
 
     plain = (options.skip_rows == 0 and options.column_names is None
              and not options.auto_generate_column_names
@@ -148,8 +140,9 @@ def read_csv(paths, options: CSVReadOptions | None = None,
                 t = native.csv_to_table(path_list[0], options.delimiter,
                                         capacity=capacity, **kw)
             else:
-                with ThreadPoolExecutor(
-                        max_workers=min(8, len(path_list))) as ex:
+                workers = (min(8, len(path_list))
+                           if options.concurrent_file_reads else 1)
+                with ThreadPoolExecutor(max_workers=workers) as ex:
                     tables = list(ex.map(
                         lambda p: native.csv_to_table(
                             p, options.delimiter, **kw),
@@ -172,7 +165,9 @@ def read_csv(paths, options: CSVReadOptions | None = None,
         if len(path_list) == 1:
             atables = [_arrow_csv_read(path_list[0], options)]
         else:
-            with ThreadPoolExecutor(max_workers=min(8, len(path_list))) as ex:
+            workers = (min(8, len(path_list))
+                       if options.concurrent_file_reads else 1)
+            with ThreadPoolExecutor(max_workers=workers) as ex:
                 atables = list(ex.map(
                     lambda p: _arrow_csv_read(p, options), path_list))
     except Exception as e:  # pyarrow raises its own hierarchy

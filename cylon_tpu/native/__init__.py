@@ -211,23 +211,32 @@ _COL_INT64, _COL_FLOAT64, _COL_STRING = 0, 1, 2
 _NATIVE_TYPES = {"int64": 0, "float64": 1, "str": 2, "string": 2}
 
 
+def csv_dtype_ok(t) -> bool:
+    """Can the native csv engine represent dtype override ``t``?
+    (int64 / float64 / str only — THE acceptance rule, shared by the
+    io routing gate and the spec encoder below.)"""
+    if t in ("str", "string", str):
+        return True
+    try:
+        return str(np.dtype(t)) in ("int64", "float64")
+    except TypeError:
+        return False
+
+
 def _native_type_spec(column_types) -> bytes | None:
     if not column_types:
         return None
     parts = []
     for name, t in column_types.items():
-        if t in (str,):
+        if not csv_dtype_ok(t):
+            raise NotImplementedError(
+                f"native csv engine cannot represent dtype {t!r} for "
+                f"column {name!r} (int64/float64/str only); use "
+                f"engine='arrow'")
+        if t in ("str", "string", str):
             code = 2
-        elif str(t) in _NATIVE_TYPES:
-            code = _NATIVE_TYPES[str(t)]
         else:
-            key = str(np.dtype(t))
-            if key not in ("int64", "float64"):
-                raise NotImplementedError(
-                    f"native csv engine cannot represent dtype {t!r} for "
-                    f"column {name!r} (int64/float64/str only); use "
-                    f"engine='arrow'")
-            code = {"int64": 0, "float64": 1}[key]
+            code = _NATIVE_TYPES[str(np.dtype(t))]
         parts.append(f"{name}\x1f{code}")
     return (";".join(parts)).encode()
 

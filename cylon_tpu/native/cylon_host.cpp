@@ -322,35 +322,42 @@ static void split_fields(const char* line, size_t len, char delim,
 static void split_fields_q(const char* line, size_t len, char delim,
                            char quote, std::deque<std::string>* arena,
                            std::vector<std::pair<const char*, size_t>>* out,
+                           std::vector<uint8_t>* quoted,
                            bool* unterminated) {
   out->clear();
+  if (quoted) quoted->clear();
   size_t i = 0;
   while (i <= len) {
     if (i < len && line[i] == quote) {
+      // quoted field: a state machine matching arrow's — doubled
+      // quotes inside are literals, and bytes AFTER the closing quote
+      // up to the delimiter still belong to the field ('"x"yz' -> xyz)
       std::string buf;
-      size_t j = i + 1;
-      bool closed = false;
-      while (j < len) {
-        if (line[j] == quote) {
-          if (j + 1 < len && line[j + 1] == quote) {
+      size_t j = i;
+      bool in_q = false;
+      while (j < len && (in_q || line[j] != delim)) {
+        char ch = line[j];
+        if (ch == quote) {
+          if (in_q && j + 1 < len && line[j + 1] == quote) {
             buf.push_back(quote);
             j += 2;
-          } else {
-            j++;
-            closed = true;
-            break;
+            continue;
           }
-        } else {
-          buf.push_back(line[j++]);
+          in_q = !in_q;
+          j++;
+          continue;
         }
+        buf.push_back(ch);
+        j++;
       }
       // a quoted field running past end-of-line means the value
       // contains a raw newline — the chunker split inside it; callers
       // must fail (arrow with has_newlines_in_values handles those)
-      if (!closed && unterminated) *unterminated = true;
+      if (in_q && unterminated) *unterminated = true;
+      while (!buf.empty() && buf.back() == '\r') buf.pop_back();
       arena->push_back(std::move(buf));
       out->push_back({arena->back().data(), arena->back().size()});
-      while (j < len && line[j] != delim) j++;  // skip \r etc.
+      if (quoted) quoted->push_back(1);
       if (j >= len) return;
       i = j + 1;
     } else {
@@ -359,6 +366,7 @@ static void split_fields_q(const char* line, size_t len, char delim,
       size_t flen = j - i;
       while (flen > 0 && line[i + flen - 1] == '\r') flen--;
       out->push_back({line + i, flen});
+      if (quoted) quoted->push_back(0);
       if (j >= len) return;
       i = j + 1;
     }
@@ -375,12 +383,15 @@ struct CsvOpts {
 static void csv_split(const char* line, size_t len, char delim,
                       const CsvOpts& o, std::deque<std::string>* arena,
                       std::vector<std::pair<const char*, size_t>>* out,
+                      std::vector<uint8_t>* quoted = nullptr,
                       bool* unterminated = nullptr) {
   if (o.quote) {
     arena->clear();
-    split_fields_q(line, len, delim, o.quote, arena, out, unterminated);
+    split_fields_q(line, len, delim, o.quote, arena, out, quoted,
+                   unterminated);
   } else {
     split_fields(line, len, delim, out);
+    if (quoted) quoted->assign(out->size(), 0);
   }
 }
 
@@ -452,15 +463,16 @@ static void* csv_read_impl(const char* path, char delim, int has_header,
       res->names.push_back("f" + std::to_string(i));
   }
 
-  // type inference: first non-NA value per column decides, scanning up
-  // to 100 rows (a single-row probe would stringify numeric columns
-  // whose first value is one of na_values)
+  // type inference: the first non-NA value per column decides (a
+  // single-row probe would stringify numeric columns whose first
+  // values are null spellings). The scan stops as soon as every
+  // column is resolved — row 1 for typical files; an all-null column
+  // costs one extra pass, the price of agreeing with arrow.
   res->types.assign(res->n_cols, -1);
   {
     size_t p = pos;
     int32_t resolved = 0;
-    for (int probe = 0; probe < 100 && p < content.size()
-                        && resolved < res->n_cols; probe++) {
+    while (p < content.size() && resolved < res->n_cols) {
       size_t nl = content.find('\n', p);
       if (nl == std::string::npos) nl = content.size();
       csv_split(content.data() + p, nl - p, delim, opt, &arena, &fields);
@@ -521,6 +533,7 @@ static void* csv_read_impl(const char* path, char delim, int has_header,
         out.str.resize(ncols);
         out.valid.resize(ncols);
         std::vector<std::pair<const char*, size_t>> fds;
+        std::vector<uint8_t> fquoted;
         std::deque<std::string> chunk_arena;
         size_t p = ranges[c].first;
         const size_t end = ranges[c].second;
@@ -539,7 +552,7 @@ static void* csv_read_impl(const char* path, char delim, int has_header,
             if (!empty) {
               bool unterm = false;
               csv_split(content.data() + p, linelen, delim, opt,
-                        &chunk_arena, &fds, &unterm);
+                        &chunk_arena, &fds, &fquoted, &unterm);
               if (unterm) {
                 failed.store(true);
                 break;
@@ -548,6 +561,7 @@ static void* csv_read_impl(const char* path, char delim, int has_header,
               for (int col = 0; col < ncols; col++) {
                 const char* s = col < (int)fds.size() ? fds[col].first : "";
                 size_t sl = col < (int)fds.size() ? fds[col].second : 0;
+                bool was_q = col < (int)fquoted.size() && fquoted[col];
                 uint8_t ok = is_na(opt, s, sl) ? 0 : 1;
                 switch (res->types[col]) {
                   case COL_INT64: {
@@ -564,9 +578,10 @@ static void* csv_read_impl(const char* path, char delim, int has_header,
                   }
                   default: {
                     // arrow semantics: NullValues hit string columns
-                    // only under StringsCanBeNull
+                    // only under StringsCanBeNull, and an explicitly
+                    // QUOTED empty field is the empty string, not null
                     if (!ok && !opt.strings_null) ok = 1;
-                    if (sl == 0) ok = 0;
+                    if (sl == 0 && !was_q) ok = 0;
                     out.str[col].emplace_back(ok ? s : "", ok ? sl : 0);
                     break;
                   }

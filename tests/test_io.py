@@ -296,3 +296,30 @@ def test_csv_unsupported_native_dtype_routes_to_arrow(tmp_path):
     p = _write(tmp_path, "i32.csv", "a\n1\n2\n")
     df = read_csv(p, CSVReadOptions(column_types={"a": "int32"}))
     assert str(df.table.column("a").data.dtype) == "int32"
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+def test_csv_quoted_empty_and_trailing_bytes(tmp_path, engine):
+    """Arrow-exact corner semantics: a QUOTED empty field is the empty
+    string (not null), and bytes after a closing quote still belong to
+    the field ('\"x\"yz' -> xyz)."""
+    p = _write(tmp_path, "corner.csv", 'a,b\n1,""\n2,"x"yz\n')
+    df = read_csv(p, engine=engine)
+    pdf = df.to_pandas()
+    assert pdf["b"].isna().tolist() == [False, False]
+    assert pdf["b"].tolist() == ["", "xyz"]
+
+
+@pytest.mark.skipif(not _native_available(),
+                    reason="native runtime not built")
+def test_csv_long_null_prefix_stays_numeric(tmp_path):
+    """Type inference must scan past ANY number of leading nulls (a
+    capped probe stringified columns with >cap leading NAs)."""
+    from cylon_tpu.config import CSVReadOptions
+
+    body = "\n".join(["NA"] * 150 + ["7", "8"])
+    p = _write(tmp_path, "longna.csv", "a\n" + body + "\n")
+    df = read_csv(p, CSVReadOptions(na_values=["NA"]), engine="native")
+    pdf = df.to_pandas()
+    assert str(df.table.column("a").data.dtype) == "int64"
+    assert pdf["a"].isna().sum() == 150 and pdf["a"].iloc[150] == 7
