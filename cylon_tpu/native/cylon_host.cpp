@@ -329,32 +329,46 @@ static void split_fields_q(const char* line, size_t len, char delim,
   size_t i = 0;
   while (i <= len) {
     if (i < len && line[i] == quote) {
-      // quoted field: a state machine matching arrow's — doubled
-      // quotes inside are literals, and bytes AFTER the closing quote
-      // up to the delimiter still belong to the field ('"x"yz' -> xyz)
+      // quoted field, arrow-exact: doubled quotes inside are literals;
+      // the FIRST lone closing quote ends quoted mode for good, and
+      // everything after it up to the delimiter — including further
+      // quote chars — is literal ('"x"yz' -> xyz, '"x"y"z"' -> xy"z").
       std::string buf;
-      size_t j = i;
-      bool in_q = false;
-      while (j < len && (in_q || line[j] != delim)) {
+      size_t j = i + 1;
+      bool in_q = true;
+      size_t close_pos = 0;  // buf length at the closing quote
+      while (j < len) {
         char ch = line[j];
-        if (ch == quote) {
-          if (in_q && j + 1 < len && line[j + 1] == quote) {
-            buf.push_back(quote);
-            j += 2;
+        if (in_q) {
+          if (ch == quote) {
+            if (j + 1 < len && line[j + 1] == quote) {
+              buf.push_back(quote);
+              j += 2;
+              continue;
+            }
+            in_q = false;
+            close_pos = buf.size();
+            j++;
             continue;
           }
-          in_q = !in_q;
+          buf.push_back(ch);
           j++;
-          continue;
+        } else {
+          if (ch == delim) break;
+          buf.push_back(ch);
+          j++;
         }
-        buf.push_back(ch);
-        j++;
       }
       // a quoted field running past end-of-line means the value
       // contains a raw newline — the chunker split inside it; callers
       // must fail (arrow with has_newlines_in_values handles those)
-      if (in_q && unterminated) *unterminated = true;
-      while (!buf.empty() && buf.back() == '\r') buf.pop_back();
+      if (in_q) {
+        if (unterminated) *unterminated = true;
+        close_pos = buf.size();
+      }
+      // line-ending \r trim: only bytes appended OUTSIDE the quotes
+      // (a \r inside the quotes is data)
+      while (buf.size() > close_pos && buf.back() == '\r') buf.pop_back();
       arena->push_back(std::move(buf));
       out->push_back({arena->back().data(), arena->back().size()});
       if (quoted) quoted->push_back(1);
@@ -472,6 +486,15 @@ static void* csv_read_impl(const char* path, char delim, int has_header,
   {
     size_t p = pos;
     int32_t resolved = 0;
+    // explicit overrides resolve up front (parity: WithColumnTypes,
+    // csv_read_config.hpp:113) — they must not force the scan on
+    for (size_t i = 0; i < res->names.size(); i++) {
+      auto it = opt.type_overrides.find(res->names[i]);
+      if (it != opt.type_overrides.end()) {
+        res->types[i] = it->second;
+        resolved++;
+      }
+    }
     while (p < content.size() && resolved < res->n_cols) {
       size_t nl = content.find('\n', p);
       if (nl == std::string::npos) nl = content.size();
@@ -492,12 +515,6 @@ static void* csv_read_impl(const char* path, char delim, int has_header,
     }
     for (auto& t : res->types)
       if (t == -1) t = COL_STRING;  // all-null/empty columns
-  }
-  // explicit per-column dtype overrides (parity: WithColumnTypes,
-  // csv_read_config.hpp:113)
-  for (size_t i = 0; i < res->names.size(); i++) {
-    auto it = opt.type_overrides.find(res->names[i]);
-    if (it != opt.type_overrides.end()) res->types[i] = it->second;
   }
 
   // chunk boundaries at newlines

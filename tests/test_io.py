@@ -323,3 +323,23 @@ def test_csv_long_null_prefix_stays_numeric(tmp_path):
     pdf = df.to_pandas()
     assert str(df.table.column("a").data.dtype) == "int64"
     assert pdf["a"].isna().sum() == 150 and pdf["a"].iloc[150] == 7
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+def test_csv_post_close_quotes_are_literal(tmp_path, engine):
+    """After a field's closing quote, further quote chars are LITERAL
+    (arrow semantics): '"x"y"z"' -> 'xy"z"'; an odd trailing quote is
+    data, not an unterminated field."""
+    p = _write(tmp_path, "pq.csv", 'a,b\n1,"x"y"z"\n2,"x"y"\n')
+    df = read_csv(p, engine=engine)
+    assert df.to_dict()["b"] == ['xy"z"', 'xy"']
+
+
+@pytest.mark.parametrize("engine", ENGINES)
+def test_csv_quoted_carriage_return_preserved(tmp_path, engine):
+    """A \\r INSIDE quotes is data; only the line-ending CRLF \\r is
+    trimmed."""
+    p = _write(tmp_path, "cr.csv", 'a,b\n1,"x\r"\r\n2,"y\r",3\n'
+               .replace(",3\n", "\n"))
+    df = read_csv(p, engine=engine)
+    assert df.to_dict()["b"] == ["x\r", "y\r"]
