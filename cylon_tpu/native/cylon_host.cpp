@@ -961,15 +961,108 @@ inline int64_t cell_width(const CatColumn& c) {
   return c.dtype == 2 ? 4 : 8;
 }
 
-// composite row-key hash over the key columns (null == null: validity
+// ---- dictionary sidecars (the Python binding's wire convention,
+// native/__init__.py: "<col>\x01blob" utf8 bytes + "<col>\x01offs"
+// int64 offsets carry a string column's dictionary through the
+// catalog; the device program only ever sees the int32 codes) ----
+
+constexpr char kSidecarSep = '\x01';
+
+inline bool is_sidecar(const std::string& n) {
+  return n.find(kSidecarSep) != std::string::npos;
+}
+
+inline int find_col(const CatTable& t, const std::string& name) {
+  for (size_t i = 0; i < t.cols.size(); ++i)
+    if (t.cols[i].name == name) return (int)i;
+  return -1;
+}
+
+bool extract_dict(const CatTable& t, const std::string& base,
+                  std::vector<std::string>* out) {
+  int bi = find_col(t, base + kSidecarSep + std::string("blob"));
+  int oi = find_col(t, base + kSidecarSep + std::string("offs"));
+  if (bi < 0 || oi < 0) return false;
+  const auto& blob = t.cols[bi].data;
+  const auto& offs = t.cols[oi].data;
+  if (offs.size() < 8 || offs.size() % 8) return false;
+  size_t n = offs.size() / 8 - 1;
+  out->clear();
+  for (size_t i = 0; i < n; ++i) {
+    int64_t a, b;
+    std::memcpy(&a, offs.data() + i * 8, 8);
+    std::memcpy(&b, offs.data() + (i + 1) * 8, 8);
+    if (a < 0 || b < a || (size_t)b > blob.size()) return false;
+    out->emplace_back(blob.begin() + a, blob.begin() + b);
+  }
+  return true;
+}
+
+void append_dict_sidecars(CatTable* out, const std::string& base,
+                          const std::vector<std::string>& values) {
+  CatColumn blob, offs;
+  blob.name = base + kSidecarSep + std::string("blob");
+  blob.dtype = 1;  // Kind.UINT8 tag, matching the Python binding
+  offs.name = base + kSidecarSep + std::string("offs");
+  offs.dtype = 8;  // Kind.INT64 tag
+  offs.data.resize((values.size() + 1) * 8, 0);
+  int64_t pos = 0;
+  for (size_t i = 0; i < values.size(); ++i) {
+    blob.data.insert(blob.data.end(), values[i].begin(), values[i].end());
+    pos += (int64_t)values[i].size();
+    std::memcpy(offs.data.data() + (i + 1) * 8, &pos, 8);
+  }
+  out->cols.push_back(std::move(blob));
+  out->cols.push_back(std::move(offs));
+}
+
+// ---- join key views: the physical interpretation of a key column.
+// Accepts both the raw C-client tags (0 int64 / 1 f64 / 2 codes,
+// cylon_host.h) and the Python binding's Kind tags (8=INT64,
+// 11=DOUBLE, 12/13=STRING/BINARY codes). ----
+
+struct KeyCol {
+  const CatColumn* col;
+  int cls;  // 0 = 8-byte int image, 1 = f64, 2 = int32 codes
+};
+
+inline int key_class(const CatColumn& c, int64_t n_rows) {
+  int64_t w = n_rows > 0 ? (int64_t)c.data.size() / n_rows : 0;
+  int tag = c.dtype & 0xFF;
+  if (tag == 2 || tag == 12 || tag == 13) return 2;
+  if (tag == 1 || tag == 11) return 1;
+  if (w != 0 && w != 8) return -1;  // unsupported physical key width
+  return 0;
+}
+
+inline int64_t key_bits(const KeyCol& k, int64_t i) {
+  const CatColumn& c = *k.col;
+  if (k.cls == 2) {
+    int32_t v;
+    std::memcpy(&v, c.data.data() + i * 4, 4);
+    return v;
+  }
+  if (k.cls == 1) {
+    double d;
+    std::memcpy(&d, c.data.data() + i * 8, 8);
+    if (d == 0.0) d = 0.0;                      // -0.0 -> +0.0
+    if (d != d) d = std::numeric_limits<double>::quiet_NaN();
+    int64_t v;
+    std::memcpy(&v, &d, 8);
+    return v;
+  }
+  int64_t v;
+  std::memcpy(&v, c.data.data() + i * 8, 8);
+  return v;
+}
+
+// composite row-key hash over the key views (null == null: validity
 // folds in as its own word, like ops/hash._row_words)
-inline uint64_t row_key_hash(const CatTable& t,
-                             const std::vector<int32_t>& keys, int64_t i) {
+inline uint64_t row_key_hash(const std::vector<KeyCol>& keys, int64_t i) {
   uint64_t h = 0x9E3779B97F4A7C15ull;
-  for (int32_t k : keys) {
-    const CatColumn& c = t.cols[k];
-    bool valid = cell_valid(c, i);
-    uint64_t w = valid ? static_cast<uint64_t>(cell_bits(c, i)) : 0ull;
+  for (const KeyCol& k : keys) {
+    bool valid = cell_valid(*k.col, i);
+    uint64_t w = valid ? static_cast<uint64_t>(key_bits(k, i)) : 0ull;
     h ^= w + 0x9E3779B97F4A7C15ull + (h << 6) + (h >> 2);
     h ^= (valid ? 0x517CC1B727220A95ull : 0x2545F4914F6CDD1Dull)
          + (h << 6) + (h >> 2);
@@ -977,26 +1070,24 @@ inline uint64_t row_key_hash(const CatTable& t,
   return h;
 }
 
-inline bool rows_key_equal(const CatTable& a,
-                           const std::vector<int32_t>& ka, int64_t i,
-                           const CatTable& b,
-                           const std::vector<int32_t>& kb, int64_t j) {
+inline bool rows_key_equal(const std::vector<KeyCol>& ka, int64_t i,
+                           const std::vector<KeyCol>& kb, int64_t j) {
   for (size_t f = 0; f < ka.size(); ++f) {
-    const CatColumn& ca = a.cols[ka[f]];
-    const CatColumn& cb = b.cols[kb[f]];
-    bool va = cell_valid(ca, i), vb = cell_valid(cb, j);
+    bool va = cell_valid(*ka[f].col, i), vb = cell_valid(*kb[f].col, j);
     if (va != vb) return false;
-    if (va && cell_bits(ca, i) != cell_bits(cb, j)) return false;
+    if (va && key_bits(ka[f], i) != key_bits(kb[f], j)) return false;
   }
   return true;
 }
 
-// gather `rows` (with -1 = null slot) from `src` into a fresh column
-CatColumn gather_col(const CatColumn& src, const std::vector<int64_t>& rows) {
+// gather `rows` (with -1 = null slot) from `src` into a fresh column;
+// `w` is the per-row byte width (from data length / n_rows — dtype
+// tags alone are ambiguous across the two tag conventions)
+CatColumn gather_col_w(const CatColumn& src, int64_t w,
+                       const std::vector<int64_t>& rows) {
   CatColumn out;
   out.name = src.name;
   out.dtype = src.dtype;
-  const int64_t w = cell_width(src);
   out.data.assign(rows.size() * w, 0);
   bool any_null = false;
   out.validity.assign(rows.size(), 1);
@@ -1011,6 +1102,10 @@ CatColumn gather_col(const CatColumn& src, const std::vector<int64_t>& rows) {
   }
   if (!any_null) out.validity.clear();
   return out;
+}
+
+CatColumn gather_col(const CatColumn& src, const std::vector<int64_t>& rows) {
+  return gather_col_w(src, cell_width(src), rows);
 }
 
 }  // namespace
@@ -1037,7 +1132,68 @@ int32_t cylon_catalog_join(const char* left_id, const char* right_id,
     if (lk_[i] < 0 || lk_[i] >= (int32_t)L.cols.size() || rk_[i] < 0 ||
         rk_[i] >= (int32_t)R.cols.size())
       return -3;
+    // exact tag equality (incl. temporal-unit bits): equal raw images
+    // of DIFFERENT logical types (timestamp[s] vs [ms], raw codes vs
+    // Kind-tagged codes) must not join on bit coincidence
     if (L.cols[lk_[i]].dtype != R.cols[rk_[i]].dtype) return -4;
+    if (key_class(L.cols[lk_[i]], L.n_rows) < 0) return -4;
+  }
+
+  // dictionary-aware keys: codes are TABLE-LOCAL (each ingest assigns
+  // its own), so when both sides carry their dictionaries (sidecar
+  // columns) the codes are remapped onto one merged sorted dictionary
+  // before hashing — otherwise equal strings with different codes
+  // would not join (and different strings with equal codes would).
+  // Raw-code tables without sidecars keep the legacy bit compare.
+  std::deque<CatColumn> shadows;
+  std::vector<KeyCol> lkv, rkv;
+  std::vector<int8_t> unified(n_keys, 0);
+  std::vector<std::vector<std::string>> merged_vals(n_keys);
+  for (int32_t f = 0; f < n_keys; ++f) {
+    const CatColumn& lc = L.cols[lk_[f]];
+    const CatColumn& rc = R.cols[rk_[f]];
+    int cls = key_class(lc, L.n_rows);
+    if (cls == 2) {
+      std::vector<std::string> lv, rv;
+      if (extract_dict(L, lc.name, &lv) && extract_dict(R, rc.name, &rv)) {
+        std::vector<std::string> merged = lv;
+        merged.insert(merged.end(), rv.begin(), rv.end());
+        std::sort(merged.begin(), merged.end());
+        merged.erase(std::unique(merged.begin(), merged.end()),
+                     merged.end());
+        auto remap = [&merged](const std::vector<std::string>& vals) {
+          std::vector<int32_t> m(vals.size());
+          for (size_t c = 0; c < vals.size(); ++c)
+            m[c] = (int32_t)(std::lower_bound(merged.begin(), merged.end(),
+                                              vals[c]) - merged.begin());
+          return m;
+        };
+        std::vector<int32_t> lm = remap(lv), rm = remap(rv);
+        auto shadow = [&shadows](const CatColumn& src, int64_t n,
+                                 const std::vector<int32_t>& m) {
+          CatColumn s;
+          s.dtype = 2;
+          s.validity = src.validity;
+          s.data.assign((size_t)n * 4, 0);
+          for (int64_t i = 0; i < n; ++i) {
+            int32_t code;
+            std::memcpy(&code, src.data.data() + i * 4, 4);
+            int32_t u = (code >= 0 && (size_t)code < m.size())
+                            ? m[code] : -1;
+            std::memcpy(s.data.data() + i * 4, &u, 4);
+          }
+          shadows.push_back(std::move(s));
+          return &shadows.back();
+        };
+        lkv.push_back({shadow(lc, L.n_rows, lm), 2});
+        rkv.push_back({shadow(rc, R.n_rows, rm), 2});
+        unified[f] = 1;
+        merged_vals[f] = std::move(merged);
+        continue;
+      }
+    }
+    lkv.push_back({&lc, cls});
+    rkv.push_back({&rc, cls});
   }
 
   // build on the right, probe from the left (hash_join.cpp builds on
@@ -1045,18 +1201,18 @@ int32_t cylon_catalog_join(const char* left_id, const char* right_id,
   std::unordered_map<uint64_t, std::vector<int64_t>> buckets;
   buckets.reserve(R.n_rows * 2);
   for (int64_t j = 0; j < R.n_rows; ++j)
-    buckets[row_key_hash(R, rk_, j)].push_back(j);
+    buckets[row_key_hash(rkv, j)].push_back(j);
 
   std::vector<int64_t> li_out, ri_out;
   std::vector<uint8_t> r_matched(R.n_rows, 0);
   const bool emit_left = join_type == 1 || join_type == 3;   // left/full
   const bool emit_right = join_type == 2 || join_type == 3;  // right/full
   for (int64_t i = 0; i < L.n_rows; ++i) {
-    auto it = buckets.find(row_key_hash(L, lk_, i));
+    auto it = buckets.find(row_key_hash(lkv, i));
     bool any = false;
     if (it != buckets.end()) {
       for (int64_t j : it->second) {
-        if (rows_key_equal(L, lk_, i, R, rk_, j)) {
+        if (rows_key_equal(lkv, i, rkv, j)) {
           li_out.push_back(i);
           ri_out.push_back(j);
           r_matched[j] = 1;
@@ -1089,22 +1245,46 @@ int32_t cylon_catalog_join(const char* left_id, const char* right_id,
   std::unordered_map<std::string, int> name_count;
   std::vector<uint8_t> drop_r(R.cols.size(), 0);   // shared (same-name) keys
   std::vector<int32_t> coalesce_r(L.cols.size(), -1);
+  std::vector<int32_t> key_of_l(L.cols.size(), -1);
   for (int32_t f = 0; f < n_keys; ++f) {
+    key_of_l[lk_[f]] = f;
     if (L.cols[lk_[f]].name == R.cols[rk_[f]].name) {
       drop_r[rk_[f]] = 1;
       coalesce_r[lk_[f]] = rk_[f];
     }
   }
-  for (const auto& c : L.cols) name_count[c.name]++;
+  // dictionary sidecars never enter the row loops: they are carried
+  // table-level metadata (dict length != row count), re-emitted under
+  // each surviving dict column's FINAL name at the end
+  for (const auto& c : L.cols)
+    if (!is_sidecar(c.name)) name_count[c.name]++;
   for (size_t j = 0; j < R.cols.size(); ++j)
-    if (!drop_r[j]) name_count[R.cols[j].name]++;
+    if (!drop_r[j] && !is_sidecar(R.cols[j].name))
+      name_count[R.cols[j].name]++;
+
+  auto width_of = [](const CatTable& t, const CatColumn& c) {
+    if (t.n_rows > 0) return (int64_t)c.data.size() / t.n_rows;
+    int tag = c.dtype & 0xFF;
+    return (int64_t)((tag == 2 || tag == 12 || tag == 13) ? 4 : 8);
+  };
+
+  // final name -> dictionary values to re-emit
+  std::vector<std::pair<std::string, std::vector<std::string>>> out_dicts;
 
   for (size_t ci = 0; ci < L.cols.size(); ++ci) {
-    CatColumn col = gather_col(L.cols[ci], li_out);
+    if (is_sidecar(L.cols[ci].name)) continue;
+    int32_t f = key_of_l[ci];
+    bool uni = f >= 0 && unified[f];
+    // unified dict keys join (and emit) in merged-code space: the
+    // shadow columns already hold merged ids for both sides
+    const CatColumn& lsrc = uni ? *lkv[f].col : L.cols[ci];
+    const int64_t w = uni ? 4 : width_of(L, L.cols[ci]);
+    CatColumn col = gather_col_w(lsrc, w, li_out);
+    col.name = L.cols[ci].name;
+    col.dtype = L.cols[ci].dtype;
     if (coalesce_r[ci] >= 0 && !col.validity.empty()) {
       // shared key: fill right-only rows from the right key column
-      const CatColumn& rc = R.cols[coalesce_r[ci]];
-      const int64_t w = cell_width(rc);
+      const CatColumn& rc = uni ? *rkv[f].col : R.cols[coalesce_r[ci]];
       for (size_t r = 0; r < li_out.size(); ++r) {
         if (li_out[r] >= 0 || ri_out[r] < 0) continue;
         if (!cell_valid(rc, ri_out[r])) continue;
@@ -1118,14 +1298,29 @@ int32_t cylon_catalog_join(const char* left_id, const char* right_id,
     }
     bool shared_key = coalesce_r[ci] >= 0;
     if (!shared_key && name_count[col.name] > 1) col.name += "_x";
+    if (uni) {
+      out_dicts.emplace_back(col.name, merged_vals[f]);
+    } else {
+      std::vector<std::string> dv;
+      if (key_class(L.cols[ci], L.n_rows) == 2
+          && extract_dict(L, L.cols[ci].name, &dv))
+        out_dicts.emplace_back(col.name, std::move(dv));
+    }
     out.cols.push_back(std::move(col));
   }
   for (size_t cj = 0; cj < R.cols.size(); ++cj) {
-    if (drop_r[cj]) continue;
-    CatColumn col = gather_col(R.cols[cj], ri_out);
+    if (drop_r[cj] || is_sidecar(R.cols[cj].name)) continue;
+    CatColumn col = gather_col_w(R.cols[cj], width_of(R, R.cols[cj]),
+                                 ri_out);
     if (name_count[col.name] > 1) col.name += "_y";
+    std::vector<std::string> dv;
+    if (key_class(R.cols[cj], R.n_rows) == 2
+        && extract_dict(R, R.cols[cj].name, &dv))
+      out_dicts.emplace_back(col.name, std::move(dv));
     out.cols.push_back(std::move(col));
   }
+  for (auto& kv : out_dicts)
+    append_dict_sidecars(&out, kv.first, kv.second);
   catalog()[out_id] = std::move(out);
   return 0;
 }

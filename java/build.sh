@@ -1,0 +1,42 @@
+#!/bin/sh
+# Build + run the Java binding (parity: the reference's build.sh --java
+# leg). Requires a JDK; the native host runtime (libcylon_host.so) is
+# built automatically by the Python package, or directly with:
+#   g++ -O3 -std=c++17 -shared -fPIC -pthread \
+#       -o cylon_tpu/native/libcylon_host.so cylon_tpu/native/cylon_host.cpp
+#
+# Usage: java/build.sh [run]
+set -e
+cd "$(dirname "$0")"
+REPO="$(cd .. && pwd)"
+LIBDIR="$REPO/cylon_tpu/native"
+OUT="$PWD/target"
+mkdir -p "$OUT/classes"
+
+: "${JAVA_HOME:=$(dirname "$(dirname "$(readlink -f "$(command -v javac)")")")}"
+
+# 1. host runtime (skip if fresh; header changes rebuild too — a stale
+#    .so against a new ABI would corrupt reads)
+if [ ! -f "$LIBDIR/libcylon_host.so" ] || \
+   [ "$LIBDIR/cylon_host.cpp" -nt "$LIBDIR/libcylon_host.so" ] || \
+   [ "$LIBDIR/cylon_host.h" -nt "$LIBDIR/libcylon_host.so" ]; then
+  g++ -O3 -std=c++17 -shared -fPIC -pthread \
+      -o "$LIBDIR/libcylon_host.so" "$LIBDIR/cylon_host.cpp"
+fi
+
+# 2. JNI bridge
+gcc -O2 -shared -fPIC \
+    -I"$JAVA_HOME/include" -I"$JAVA_HOME/include/linux" \
+    src/main/native/cylon_jni.c -o "$OUT/libcylon_jni.so" \
+    -L"$LIBDIR" -lcylon_host -Wl,-rpath,"$LIBDIR"
+
+# 3. Java classes
+javac -d "$OUT/classes" \
+    src/main/java/org/cylondata/cylon/*.java \
+    src/main/java/org/cylondata/cylon/examples/*.java
+
+# 4. optionally run the example
+if [ "$1" = "run" ]; then
+  CYLON_JNI_LIB="$OUT/libcylon_jni.so" \
+      java -cp "$OUT/classes" org.cylondata.cylon.examples.JoinExample
+fi

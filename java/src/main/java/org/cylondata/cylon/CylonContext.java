@@ -1,0 +1,65 @@
+package org.cylondata.cylon;
+
+/**
+ * Execution context for the Java binding (parity:
+ * {@code java/src/main/java/org/cylondata/cylon/CylonContext.java} of
+ * the reference — init/barrier/finalize over the native layer).
+ *
+ * <p>The native layer here is the host runtime's C ABI
+ * ({@code cylon_tpu/native/cylon_host.h}): a string-id table catalog
+ * plus host kernels, the same surface the reference's JNI bridge drives
+ * through {@code table_api} ({@code Table.java:289-307}). Device
+ * (TPU/mesh) execution stays on the Python/JAX side; the Java binding
+ * is a host-runtime consumer exactly like the reference's (whose JNI
+ * also never touches MPI directly — ranks come from the context).</p>
+ */
+public final class CylonContext {
+
+  private static boolean loaded = false;
+  private boolean finalized = false;
+
+  private CylonContext() {
+  }
+
+  /**
+   * Initialise the context, loading the JNI bridge
+   * ({@code libcylon_jni.so}). Library search order: the
+   * {@code CYLON_JNI_LIB} environment variable (full path), then
+   * {@code java.library.path}.
+   */
+  public static synchronized CylonContext init() {
+    if (!loaded) {
+      String explicit = System.getenv("CYLON_JNI_LIB");
+      if (explicit != null && !explicit.isEmpty()) {
+        System.load(explicit);
+      } else {
+        System.loadLibrary("cylon_jni");
+      }
+      loaded = true;
+    }
+    return new CylonContext();
+  }
+
+  /** Single-process host context: rank 0 of world 1 (parity:
+   *  {@code getRank}/{@code getWorldSize}). */
+  public int getRank() {
+    return 0;
+  }
+
+  public int getWorldSize() {
+    return 1;
+  }
+
+  /** No-op on the single-process host context (parity: Barrier). */
+  public void barrier() {
+  }
+
+  /** Parity: {@code CylonContext.finalizeCtx}. */
+  public void finalizeCtx() {
+    this.finalized = true;
+  }
+
+  public boolean isFinalized() {
+    return this.finalized;
+  }
+}

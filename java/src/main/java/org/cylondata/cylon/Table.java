@@ -1,0 +1,238 @@
+package org.cylondata.cylon;
+
+import java.util.UUID;
+
+/**
+ * Java consumer of the native table catalog (parity: the reference's
+ * {@code org.cylondata.cylon.Table}, {@code Table.java:43} — an
+ * id-keyed mediator whose data lives entirely in the native layer, with
+ * transformations dispatched through native methods,
+ * {@code Table.java:289-307}).
+ *
+ * <p>Tables are immutable; every transformation creates a new catalog
+ * entry under a fresh UUID, exactly like the reference's
+ * {@code nativeJoin(..., destination)} convention.</p>
+ *
+ * <p>Column dtypes mirror the catalog ABI ({@code cylon_host.h}):
+ * {@code 0} = int64, {@code 1} = float64, {@code 2} = int32 dictionary
+ * codes.</p>
+ */
+public final class Table {
+
+  public static final int DTYPE_INT64 = 0;
+  public static final int DTYPE_FLOAT64 = 1;
+  public static final int DTYPE_STRING_CODES = 2;
+
+  /** Join types, numbering shared with {@code cylon_catalog_join}. */
+  public enum JoinType {
+    INNER, LEFT, RIGHT, FULL_OUTER
+  }
+
+  private final String id;
+  private final CylonContext ctx;
+
+  private Table(String id, CylonContext ctx) {
+    this.id = id;
+    this.ctx = ctx;
+  }
+
+  public String getId() {
+    return id;
+  }
+
+  // ----------------- methods to generate a table -----------------
+
+  /**
+   * Load a CSV file through the native chunk-parallel reader and
+   * register it in the catalog (parity: {@code Table.fromCSV} →
+   * {@code nativeLoadCSV}, {@code Table.java:81-85,309}).
+   */
+  public static Table fromCSV(CylonContext ctx, String path) {
+    String uuid = UUID.randomUUID().toString();
+    nativeLoadCSV(path, uuid);
+    return new Table(uuid, ctx);
+  }
+
+  /** Register int64/float64 columns directly (column i is
+   *  {@code long[]} or {@code double[]}). */
+  public static Table fromColumns(CylonContext ctx, String[] names,
+                                  Object[] columns) {
+    String uuid = UUID.randomUUID().toString();
+    nativePutColumns(uuid, names, columns);
+    return new Table(uuid, ctx);
+  }
+
+  // ----------------- table properties -----------------
+
+  /**
+   * Parity: {@code getColumnCount} → {@code nativeColumnCount}. String
+   * columns carry their dictionaries in trailing sidecar entries
+   * (names containing {@code \u0001}); those are implementation
+   * columns, excluded here — user columns are always the leading
+   * indices.
+   */
+  public int getColumnCount() {
+    int nc = nativeColumnCount(id);
+    int real = 0;
+    for (int i = 0; i < nc; i++) {
+      if (nativeColumnName(id, i).indexOf('\u0001') < 0) {
+        real++;
+      }
+    }
+    return real;
+  }
+
+  /** Parity: {@code getRowCount} → {@code nativeRowCount}. Throws when
+   *  the (int64) native count exceeds {@code Integer.MAX_VALUE};
+   *  {@link #getRowCountLong()} has no such limit. */
+  public int getRowCount() {
+    long n = nativeRowCount(id);
+    if (n > Integer.MAX_VALUE) {
+      throw new ArithmeticException("row count " + n + " exceeds int");
+    }
+    return (int) n;
+  }
+
+  public long getRowCountLong() {
+    return nativeRowCount(id);
+  }
+
+  public String getColumnName(int col) {
+    return nativeColumnName(id, col);
+  }
+
+  /** One of the {@code DTYPE_*} constants. */
+  public int getColumnType(int col) {
+    return nativeColumnType(id, col);
+  }
+
+  // ----------------- data access -----------------
+
+  public long[] readLongColumn(int col) {
+    return nativeReadI64(id, col);
+  }
+
+  public double[] readDoubleColumn(int col) {
+    return nativeReadF64(id, col);
+  }
+
+  /** int32 dictionary codes of a string column. */
+  public int[] readCodesColumn(int col) {
+    return nativeReadCodes(id, col);
+  }
+
+  /** The dictionary values of a string column (null when the column
+   *  carries no dictionary sidecars). */
+  public String[] readDictValues(int col) {
+    return nativeReadDictValues(id, col);
+  }
+
+  /** Decoded string column: codes mapped through the dictionary
+   *  (null entries for invalid rows/codes). */
+  public String[] readStringColumn(int col) {
+    int[] codes = readCodesColumn(col);
+    String[] dict = readDictValues(col);
+    byte[] valid = readValidity(col);
+    String[] out = new String[codes.length];
+    for (int i = 0; i < codes.length; i++) {
+      boolean ok = valid == null || valid[i] != 0;
+      out[i] = (ok && dict != null && codes[i] >= 0
+                && codes[i] < dict.length) ? dict[codes[i]] : null;
+    }
+    return out;
+  }
+
+  /** Validity flags (1 = present), or null when the column has no
+   *  nulls. */
+  public byte[] readValidity(int col) {
+    return nativeReadValidity(id, col);
+  }
+
+  // ----------------- transformations -----------------
+
+  /**
+   * Native hash join on one key column per side (parity:
+   * {@code Table.join} → {@code nativeJoin},
+   * {@code Table.java:132-160,289}; algorithm fixed to hash — the
+   * build/probe of {@code join/hash_join.cpp:22-31} reimplemented in
+   * the host runtime).
+   */
+  public Table join(Table right, int leftCol, int rightCol,
+                    JoinType joinType) {
+    String uuid = UUID.randomUUID().toString();
+    int rc = nativeJoin(this.id, right.id, uuid, leftCol, rightCol,
+                        joinType.ordinal());
+    if (rc != 0) {
+      throw new RuntimeException("native join failed rc=" + rc);
+    }
+    return new Table(uuid, ctx);
+  }
+
+  /** Remove this table from the catalog (parity: {@code clear}). */
+  public void clear() {
+    nativeClear(id);
+  }
+
+  /** Host-side print of up to {@code maxRows} rows (parity:
+   *  {@code Table.print}). */
+  public void print(int maxRows) {
+    int nc = getColumnCount();
+    int nr = Math.min(getRowCount(), maxRows);
+    StringBuilder sb = new StringBuilder();
+    for (int c = 0; c < nc; c++) {
+      sb.append(getColumnName(c)).append(c + 1 < nc ? "," : "\n");
+    }
+    Object[] cols = new Object[nc];
+    for (int c = 0; c < nc; c++) {
+      int t = getColumnType(c);
+      cols[c] = t == DTYPE_FLOAT64 ? (Object) readDoubleColumn(c)
+          : t == DTYPE_STRING_CODES ? (Object) readCodesColumn(c)
+          : (Object) readLongColumn(c);
+    }
+    for (int r = 0; r < nr; r++) {
+      for (int c = 0; c < nc; c++) {
+        Object a = cols[c];
+        if (a instanceof double[]) {
+          sb.append(((double[]) a)[r]);
+        } else if (a instanceof int[]) {
+          sb.append(((int[]) a)[r]);
+        } else {
+          sb.append(((long[]) a)[r]);
+        }
+        sb.append(c + 1 < nc ? "," : "\n");
+      }
+    }
+    System.out.print(sb);
+  }
+
+  // ----------------- native methods (cylon_jni.c) -----------------
+
+  private static native void nativeLoadCSV(String path, String id);
+
+  private static native void nativePutColumns(String id, String[] names,
+                                              Object[] columns);
+
+  private static native int nativeColumnCount(String id);
+
+  private static native long nativeRowCount(String id);
+
+  private static native String nativeColumnName(String id, int col);
+
+  private static native int nativeColumnType(String id, int col);
+
+  private static native long[] nativeReadI64(String id, int col);
+
+  private static native double[] nativeReadF64(String id, int col);
+
+  private static native int[] nativeReadCodes(String id, int col);
+
+  private static native byte[] nativeReadValidity(String id, int col);
+
+  private static native String[] nativeReadDictValues(String id, int col);
+
+  private static native int nativeJoin(String left, String right,
+                                       String dest, int leftCol,
+                                       int rightCol, int joinType);
+
+  private static native void nativeClear(String id);
+}

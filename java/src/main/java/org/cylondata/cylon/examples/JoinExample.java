@@ -1,0 +1,53 @@
+package org.cylondata.cylon.examples;
+
+import org.cylondata.cylon.CylonContext;
+import org.cylondata.cylon.Table;
+
+/**
+ * The reference's canonical Java flow (its {@code examples/} join
+ * demos): build two tables, native hash join, read the result back.
+ * Exits 0 and prints {@code JAVA-OK <rows>} on success — the
+ * assertion the CI test checks.
+ */
+public final class JoinExample {
+
+  private JoinExample() {
+  }
+
+  public static void main(String[] args) {
+    CylonContext ctx = CylonContext.init();
+
+    Table orders = Table.fromColumns(ctx,
+        new String[] {"k", "amount"},
+        new Object[] {new long[] {1, 2, 2, 3, 5},
+                      new double[] {10.0, 20.0, 21.0, 30.0, 50.0}});
+    Table customers = Table.fromColumns(ctx,
+        new String[] {"k", "score"},
+        new Object[] {new long[] {2, 3, 4},
+                      new double[] {0.5, 0.25, 0.125}});
+
+    Table joined = orders.join(customers, 0, 0, Table.JoinType.INNER);
+    int rows = joined.getRowCount();
+    int cols = joined.getColumnCount();
+    // probe is left-driven: (2,20,.5), (2,21,.5), (3,30,.25)
+    long[] k = joined.readLongColumn(0);
+    double[] amount = joined.readDoubleColumn(1);
+    double[] score = joined.readDoubleColumn(2);
+    boolean ok = rows == 3 && cols == 3
+        && k[0] == 2 && k[1] == 2 && k[2] == 3
+        && amount[0] == 20.0 && amount[1] == 21.0 && amount[2] == 30.0
+        && score[0] == 0.5 && score[1] == 0.5 && score[2] == 0.25;
+
+    joined.print(10);
+    orders.clear();
+    customers.clear();
+    joined.clear();
+    ctx.finalizeCtx();
+
+    if (!ok) {
+      System.err.println("JAVA-FAIL");
+      System.exit(1);
+    }
+    System.out.println("JAVA-OK " + rows);
+  }
+}

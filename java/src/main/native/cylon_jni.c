@@ -1,0 +1,478 @@
+/* JNI bridge: org.cylondata.cylon.Table -> the host runtime C ABI.
+ *
+ * Parity: the reference's JNI layer
+ * (java/src/main/native/src/Table.cpp, driven by the native method
+ * declarations of Table.java:289-307) which forwards every call to the
+ * string-id table_api catalog. Here the catalog is
+ * cylon_tpu/native/cylon_host.h (cylon_catalog_*), shared with the
+ * Python ctypes binding and the pure-C client
+ * (examples/native/catalog_client.c) — three consumers, one ABI.
+ *
+ * Build (see java/build.sh):
+ *   gcc -O2 -shared -fPIC -I$JAVA_HOME/include -I$JAVA_HOME/include/linux \
+ *       cylon_jni.c -o libcylon_jni.so -L$LIBDIR -lcylon_host \
+ *       -Wl,-rpath,$LIBDIR
+ */
+#include <jni.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "../../../../cylon_tpu/native/cylon_host.h"
+
+static int col_info(JNIEnv *env, jstring jid, jint col, char *name_out,
+                    int32_t name_cap, int32_t *dtype, int64_t *nbytes,
+                    int32_t *has_valid);
+
+static void throw_runtime(JNIEnv *env, const char *msg) {
+  jclass cls = (*env)->FindClass(env, "java/lang/RuntimeException");
+  if (cls) (*env)->ThrowNew(env, cls, msg);
+}
+
+/* ------------------------------------------------------------- CSV */
+
+JNIEXPORT void JNICALL
+Java_org_cylondata_cylon_Table_nativeLoadCSV(JNIEnv *env, jclass cls,
+                                             jstring jpath, jstring jid) {
+  const char *path = (*env)->GetStringUTFChars(env, jpath, NULL);
+  const char *id = (*env)->GetStringUTFChars(env, jid, NULL);
+  void *r = cylon_csv_read(path, ',', 1, 0);
+  const char *err = cylon_csv_error(r);
+  if (err) {
+    throw_runtime(env, err);
+    goto done;
+  }
+  {
+    int64_t n = cylon_csv_num_rows(r);
+    int32_t nc = cylon_csv_num_cols(r);
+    /* string columns ship their dictionaries as the catalog's sidecar
+     * convention ("<col>\x01blob" utf8 bytes + "<col>\x01offs" int64
+     * offsets, shared with the Python binding) — without them, joins
+     * on string keys would compare per-file codes */
+    int32_t cap = nc * 3;
+    const char **names = malloc(sizeof(char *) * cap);
+    char **owned_names = calloc(cap, sizeof(char *));
+    int32_t *dtypes = malloc(sizeof(int32_t) * cap);
+    const void **bufs = malloc(sizeof(void *) * cap);
+    int64_t *lens = malloc(sizeof(int64_t) * cap);
+    const uint8_t **valids = malloc(sizeof(uint8_t *) * cap);
+    void **owned = calloc(cap, sizeof(void *));
+    uint8_t **ovalid = calloc(cap, sizeof(uint8_t *));
+    /* pass 1: the real columns occupy slots 0..nc-1 (sidecars append
+     * AFTER, so Java column indices == catalog indices) */
+    int32_t slot = 0;
+    for (int32_t c = 0; c < nc; c++) {
+      int32_t s = slot++;
+      names[s] = cylon_csv_col_name(r, c);
+      dtypes[s] = cylon_csv_col_type(r, c);
+      ovalid[s] = malloc((size_t) n);
+      cylon_csv_col_validity(r, c, ovalid[s]);
+      int all_valid = 1;
+      for (int64_t i = 0; i < n; i++)
+        if (!ovalid[s][i]) {
+          all_valid = 0;
+          break;
+        }
+      valids[s] = all_valid ? NULL : ovalid[s];
+      if (dtypes[s] == 0) {
+        owned[s] = malloc(sizeof(int64_t) * (size_t) n);
+        cylon_csv_col_i64(r, c, (int64_t *) owned[s]);
+        lens[s] = n * (int64_t) sizeof(int64_t);
+      } else if (dtypes[s] == 1) {
+        owned[s] = malloc(sizeof(double) * (size_t) n);
+        cylon_csv_col_f64(r, c, (double *) owned[s]);
+        lens[s] = n * (int64_t) sizeof(double);
+      } else {
+        owned[s] = malloc(sizeof(int32_t) * (size_t) n);
+        cylon_csv_col_codes(r, c, (int32_t *) owned[s]);
+        lens[s] = n * (int64_t) sizeof(int32_t);
+      }
+      bufs[s] = owned[s];
+    }
+    /* pass 2: dictionary sidecars for string columns */
+    for (int32_t c = 0; c < nc; c++) {
+      if (cylon_csv_col_type(r, c) != 2) continue;
+      const char *base = cylon_csv_col_name(r, c);
+      int32_t k = cylon_csv_dict_size(r, c);
+      int64_t *offs = malloc(sizeof(int64_t) * ((size_t) k + 1));
+      int64_t total = 0;
+      offs[0] = 0;
+      for (int32_t v = 0; v < k; v++) {
+        total += (int64_t) strlen(cylon_csv_dict_value(r, c, v));
+        offs[v + 1] = total;
+      }
+      char *blob = malloc(total ? (size_t) total : 1);
+      for (int32_t v = 0; v < k; v++) {
+        const char *val = cylon_csv_dict_value(r, c, v);
+        memcpy(blob + offs[v], val, (size_t) (offs[v + 1] - offs[v]));
+      }
+      size_t base_len = strlen(base);
+      int32_t bs = slot++;
+      owned_names[bs] = malloc(base_len + 7);
+      /* "\x01" kept as a separate literal: in C, "\x01b..." would
+       * munch following hex digits into the escape */
+      sprintf(owned_names[bs], "%s\x01" "blob", base);
+      names[bs] = owned_names[bs];
+      dtypes[bs] = 1;  /* Kind.UINT8 tag, Python-compatible */
+      owned[bs] = blob;
+      bufs[bs] = blob;
+      lens[bs] = total;
+      valids[bs] = NULL;
+      ovalid[bs] = NULL;
+      int32_t os = slot++;
+      owned_names[os] = malloc(base_len + 7);
+      sprintf(owned_names[os], "%s\x01" "offs", base);
+      names[os] = owned_names[os];
+      dtypes[os] = 8;  /* Kind.INT64 tag */
+      owned[os] = offs;
+      bufs[os] = offs;
+      lens[os] = ((int64_t) k + 1) * 8;
+      valids[os] = NULL;
+      ovalid[os] = NULL;
+    }
+    if (cylon_catalog_put(id, slot, names, dtypes, n, bufs, lens, valids))
+      throw_runtime(env, "catalog put failed");
+    for (int32_t c = 0; c < cap; c++) {
+      free(owned[c]);
+      free(ovalid[c]);
+      free(owned_names[c]);
+    }
+    free(names);
+    free(owned_names);
+    free(dtypes);
+    free(bufs);
+    free(lens);
+    free(valids);
+    free(owned);
+    free(ovalid);
+  }
+done:
+  cylon_csv_free(r);
+  (*env)->ReleaseStringUTFChars(env, jpath, path);
+  (*env)->ReleaseStringUTFChars(env, jid, id);
+}
+
+/* ------------------------------------------------- direct columns */
+
+JNIEXPORT void JNICALL
+Java_org_cylondata_cylon_Table_nativePutColumns(JNIEnv *env, jclass cls,
+                                                jstring jid,
+                                                jobjectArray jnames,
+                                                jobjectArray jcols) {
+  if ((*env)->GetArrayLength(env, jnames)
+      != (*env)->GetArrayLength(env, jcols)) {
+    throw_runtime(env, "fromColumns: names and columns length mismatch");
+    return;
+  }
+  const char *id = (*env)->GetStringUTFChars(env, jid, NULL);
+  jsize nc = (*env)->GetArrayLength(env, jnames);
+  const char **names = malloc(sizeof(char *) * nc);
+  jstring *jname_refs = malloc(sizeof(jstring) * nc);
+  int32_t *dtypes = malloc(sizeof(int32_t) * nc);
+  const void **bufs = malloc(sizeof(void *) * nc);
+  int64_t *lens = malloc(sizeof(int64_t) * nc);
+  void **owned = malloc(sizeof(void *) * nc);
+  int64_t n = -1;
+  int bad = 0;
+
+  jclass longArr = (*env)->FindClass(env, "[J");
+  jclass dblArr = (*env)->FindClass(env, "[D");
+  for (jsize c = 0; c < nc; c++) {
+    jname_refs[c] = (jstring) (*env)->GetObjectArrayElement(env, jnames, c);
+    if (jname_refs[c] == NULL) {
+      /* GetStringUTFChars(NULL) would segfault the JVM */
+      names[c] = "";
+      bad = 1;
+    } else {
+      names[c] = (*env)->GetStringUTFChars(env, jname_refs[c], NULL);
+    }
+    jobject col = (*env)->GetObjectArrayElement(env, jcols, c);
+    jsize len;
+    if (col == NULL) {
+      /* IsInstanceOf(NULL, cls) is JNI_TRUE per spec — a null column
+       * would otherwise segfault in GetArrayLength */
+      bad = 1;
+      owned[c] = NULL;
+      len = 0;
+      dtypes[c] = 0;
+      lens[c] = 0;
+    } else if ((*env)->IsInstanceOf(env, col, longArr)) {
+      len = (*env)->GetArrayLength(env, (jarray) col);
+      owned[c] = malloc(sizeof(int64_t) * (size_t) len);
+      (*env)->GetLongArrayRegion(env, (jlongArray) col, 0, len,
+                                 (jlong *) owned[c]);
+      dtypes[c] = 0;
+      lens[c] = (int64_t) len * 8;
+    } else if ((*env)->IsInstanceOf(env, col, dblArr)) {
+      len = (*env)->GetArrayLength(env, (jarray) col);
+      owned[c] = malloc(sizeof(double) * (size_t) len);
+      (*env)->GetDoubleArrayRegion(env, (jdoubleArray) col, 0, len,
+                                   (jdouble *) owned[c]);
+      dtypes[c] = 1;
+      lens[c] = (int64_t) len * 8;
+    } else {
+      bad = 1;
+      owned[c] = NULL;
+      len = 0;
+      dtypes[c] = 0;
+      lens[c] = 0;
+    }
+    bufs[c] = owned[c];
+    if (n < 0) n = len;
+    if (len != n) bad = 1;
+  }
+  if (bad) {
+    throw_runtime(env, "fromColumns: columns must be equal-length "
+                       "long[] or double[]");
+  } else if (cylon_catalog_put(id, (int32_t) nc, names, dtypes, n, bufs,
+                               lens, NULL)) {
+    throw_runtime(env, "catalog put failed");
+  }
+  for (jsize c = 0; c < nc; c++) {
+    free(owned[c]);
+    if (jname_refs[c] != NULL)
+      (*env)->ReleaseStringUTFChars(env, jname_refs[c], names[c]);
+  }
+  free(names);
+  free(jname_refs);
+  free(dtypes);
+  free(bufs);
+  free(lens);
+  free(owned);
+  (*env)->ReleaseStringUTFChars(env, jid, id);
+}
+
+/* --------------------------------------------------- properties */
+
+JNIEXPORT jint JNICALL
+Java_org_cylondata_cylon_Table_nativeColumnCount(JNIEnv *env, jclass cls,
+                                                 jstring jid) {
+  const char *id = (*env)->GetStringUTFChars(env, jid, NULL);
+  int32_t v = cylon_catalog_ncols(id);
+  (*env)->ReleaseStringUTFChars(env, jid, id);
+  return (jint) v;
+}
+
+JNIEXPORT jlong JNICALL
+Java_org_cylondata_cylon_Table_nativeRowCount(JNIEnv *env, jclass cls,
+                                              jstring jid) {
+  /* jlong: the catalog's row count is int64 by design — truncating to
+   * jint would silently wrap past 2^31 rows */
+  const char *id = (*env)->GetStringUTFChars(env, jid, NULL);
+  int64_t v = cylon_catalog_rows(id);
+  (*env)->ReleaseStringUTFChars(env, jid, id);
+  return (jlong) v;
+}
+
+JNIEXPORT jobjectArray JNICALL
+Java_org_cylondata_cylon_Table_nativeReadDictValues(JNIEnv *env, jclass cls,
+                                                    jstring jid, jint col) {
+  /* decode the "<col>\x01blob"/"\x01offs" sidecar pair (see
+   * nativeLoadCSV) into the column's dictionary values */
+  char base[512];
+  int32_t dt, hv;
+  int64_t nb;
+  if (col_info(env, jid, col, base, sizeof base, &dt, &nb, &hv)) {
+    throw_runtime(env, "bad column");
+    return NULL;
+  }
+  const char *id = (*env)->GetStringUTFChars(env, jid, NULL);
+  int32_t nc = cylon_catalog_ncols(id);
+  char want_blob[520], want_offs[520];
+  sprintf(want_blob, "%s\x01" "blob", base);
+  sprintf(want_offs, "%s\x01" "offs", base);
+  int bi = -1, oi = -1;
+  for (int32_t i = 0; i < nc; i++) {
+    char nm[520];
+    int32_t d2, h2;
+    int64_t n2;
+    if (cylon_catalog_col_info(id, i, nm, sizeof nm, &d2, &n2, &h2) < 0)
+      continue;
+    if (strcmp(nm, want_blob) == 0) bi = i;
+    if (strcmp(nm, want_offs) == 0) oi = i;
+  }
+  jobjectArray out = NULL;
+  if (bi >= 0 && oi >= 0) {
+    char nm[520];
+    int32_t d2, h2;
+    int64_t blob_len, offs_len;
+    cylon_catalog_col_info(id, bi, nm, sizeof nm, &d2, &blob_len, &h2);
+    cylon_catalog_col_info(id, oi, nm, sizeof nm, &d2, &offs_len, &h2);
+    char *blob = malloc(blob_len ? (size_t) blob_len : 1);
+    int64_t *offs = malloc((size_t) offs_len);
+    cylon_catalog_col_read(id, bi, blob, blob_len, NULL);
+    cylon_catalog_col_read(id, oi, offs, offs_len, NULL);
+    jsize k = (jsize) (offs_len / 8 - 1);
+    jclass strcls = (*env)->FindClass(env, "java/lang/String");
+    out = (*env)->NewObjectArray(env, k, strcls, NULL);
+    for (jsize v = 0; v < k; v++) {
+      int64_t a = offs[v], b = offs[v + 1];
+      char *tmp = malloc((size_t) (b - a) + 1);
+      memcpy(tmp, blob + a, (size_t) (b - a));
+      tmp[b - a] = 0;
+      jstring s = (*env)->NewStringUTF(env, tmp);
+      (*env)->SetObjectArrayElement(env, out, v, s);
+      free(tmp);
+    }
+    free(blob);
+    free(offs);
+  }
+  (*env)->ReleaseStringUTFChars(env, jid, id);
+  return out;  /* NULL: no dictionary for this column */
+}
+
+/* name/dtype/length/validity of column i via cylon_catalog_col_info */
+static int col_info(JNIEnv *env, jstring jid, jint col, char *name_out,
+                    int32_t name_cap, int32_t *dtype, int64_t *nbytes,
+                    int32_t *has_valid) {
+  const char *id = (*env)->GetStringUTFChars(env, jid, NULL);
+  int32_t rc = cylon_catalog_col_info(id, col, name_out, name_cap, dtype,
+                                      nbytes, has_valid);
+  (*env)->ReleaseStringUTFChars(env, jid, id);
+  return rc < 0 ? -1 : 0;
+}
+
+JNIEXPORT jstring JNICALL
+Java_org_cylondata_cylon_Table_nativeColumnName(JNIEnv *env, jclass cls,
+                                                jstring jid, jint col) {
+  char name[512];
+  int32_t dt, hv;
+  int64_t nb;
+  if (col_info(env, jid, col, name, sizeof name, &dt, &nb, &hv)) {
+    throw_runtime(env, "bad column");
+    return NULL;
+  }
+  return (*env)->NewStringUTF(env, name);
+}
+
+JNIEXPORT jint JNICALL
+Java_org_cylondata_cylon_Table_nativeColumnType(JNIEnv *env, jclass cls,
+                                                jstring jid, jint col) {
+  char name[512];
+  int32_t dt = -1, hv;
+  int64_t nb;
+  if (col_info(env, jid, col, name, sizeof name, &dt, &nb, &hv)) {
+    throw_runtime(env, "bad column");
+  }
+  return (jint) dt;
+}
+
+/* --------------------------------------------------- data readers */
+
+static void *read_col(JNIEnv *env, jstring jid, jint col, int64_t *nbytes,
+                      int32_t *dtype) {
+  char name[512];
+  int32_t hv;
+  if (col_info(env, jid, col, name, sizeof name, dtype, nbytes, &hv)) {
+    throw_runtime(env, "bad column");
+    return NULL;
+  }
+  void *buf = malloc((size_t) *nbytes);
+  const char *id = (*env)->GetStringUTFChars(env, jid, NULL);
+  int32_t rc = cylon_catalog_col_read(id, col, buf, *nbytes, NULL);
+  (*env)->ReleaseStringUTFChars(env, jid, id);
+  if (rc != 0) {
+    free(buf);
+    throw_runtime(env, "column read failed");
+    return NULL;
+  }
+  return buf;
+}
+
+JNIEXPORT jlongArray JNICALL
+Java_org_cylondata_cylon_Table_nativeReadI64(JNIEnv *env, jclass cls,
+                                             jstring jid, jint col) {
+  int64_t nbytes;
+  int32_t dt;
+  void *buf = read_col(env, jid, col, &nbytes, &dt);
+  if (!buf) return NULL;
+  jsize n = (jsize) (nbytes / 8);
+  jlongArray out = (*env)->NewLongArray(env, n);
+  (*env)->SetLongArrayRegion(env, out, 0, n, (const jlong *) buf);
+  free(buf);
+  return out;
+}
+
+JNIEXPORT jdoubleArray JNICALL
+Java_org_cylondata_cylon_Table_nativeReadF64(JNIEnv *env, jclass cls,
+                                             jstring jid, jint col) {
+  int64_t nbytes;
+  int32_t dt;
+  void *buf = read_col(env, jid, col, &nbytes, &dt);
+  if (!buf) return NULL;
+  jsize n = (jsize) (nbytes / 8);
+  jdoubleArray out = (*env)->NewDoubleArray(env, n);
+  (*env)->SetDoubleArrayRegion(env, out, 0, n, (const jdouble *) buf);
+  free(buf);
+  return out;
+}
+
+JNIEXPORT jintArray JNICALL
+Java_org_cylondata_cylon_Table_nativeReadCodes(JNIEnv *env, jclass cls,
+                                               jstring jid, jint col) {
+  int64_t nbytes;
+  int32_t dt;
+  void *buf = read_col(env, jid, col, &nbytes, &dt);
+  if (!buf) return NULL;
+  jsize n = (jsize) (nbytes / 4);
+  jintArray out = (*env)->NewIntArray(env, n);
+  (*env)->SetIntArrayRegion(env, out, 0, n, (const jint *) buf);
+  free(buf);
+  return out;
+}
+
+JNIEXPORT jbyteArray JNICALL
+Java_org_cylondata_cylon_Table_nativeReadValidity(JNIEnv *env, jclass cls,
+                                                  jstring jid, jint col) {
+  char name[512];
+  int32_t dt, hv;
+  int64_t nbytes;
+  if (col_info(env, jid, col, name, sizeof name, &dt, &nbytes, &hv)) {
+    throw_runtime(env, "bad column");
+    return NULL;
+  }
+  if (!hv) return NULL;
+  const char *id = (*env)->GetStringUTFChars(env, jid, NULL);
+  int64_t n = cylon_catalog_rows(id);
+  uint8_t *valid = malloc((size_t) n);
+  void *data = malloc((size_t) nbytes);
+  int32_t rc = cylon_catalog_col_read(id, col, data, nbytes, valid);
+  (*env)->ReleaseStringUTFChars(env, jid, id);
+  free(data);
+  if (rc != 0) {
+    free(valid);
+    throw_runtime(env, "column read failed");
+    return NULL;
+  }
+  jbyteArray out = (*env)->NewByteArray(env, (jsize) n);
+  (*env)->SetByteArrayRegion(env, out, 0, (jsize) n, (const jbyte *) valid);
+  free(valid);
+  return out;
+}
+
+/* ------------------------------------------------------------ join */
+
+JNIEXPORT jint JNICALL
+Java_org_cylondata_cylon_Table_nativeJoin(JNIEnv *env, jclass cls,
+                                          jstring jleft, jstring jright,
+                                          jstring jdest, jint leftCol,
+                                          jint rightCol, jint joinType) {
+  const char *l = (*env)->GetStringUTFChars(env, jleft, NULL);
+  const char *r = (*env)->GetStringUTFChars(env, jright, NULL);
+  const char *d = (*env)->GetStringUTFChars(env, jdest, NULL);
+  int32_t lk = (int32_t) leftCol, rk = (int32_t) rightCol;
+  int32_t rc = cylon_catalog_join(l, r, d, 1, &lk, &rk, (int32_t) joinType);
+  (*env)->ReleaseStringUTFChars(env, jleft, l);
+  (*env)->ReleaseStringUTFChars(env, jright, r);
+  (*env)->ReleaseStringUTFChars(env, jdest, d);
+  return (jint) rc;
+}
+
+JNIEXPORT void JNICALL
+Java_org_cylondata_cylon_Table_nativeClear(JNIEnv *env, jclass cls,
+                                           jstring jid) {
+  const char *id = (*env)->GetStringUTFChars(env, jid, NULL);
+  cylon_catalog_remove(id);
+  (*env)->ReleaseStringUTFChars(env, jid, id);
+}

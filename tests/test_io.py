@@ -339,7 +339,6 @@ def test_csv_post_close_quotes_are_literal(tmp_path, engine):
 def test_csv_quoted_carriage_return_preserved(tmp_path, engine):
     """A \\r INSIDE quotes is data; only the line-ending CRLF \\r is
     trimmed."""
-    p = _write(tmp_path, "cr.csv", 'a,b\n1,"x\r"\r\n2,"y\r",3\n'
-               .replace(",3\n", "\n"))
+    p = _write(tmp_path, "cr.csv", 'a,b\n1,"x\r"\r\n2,"y\r"\n')
     df = read_csv(p, engine=engine)
     assert df.to_dict()["b"] == ["x\r", "y\r"]

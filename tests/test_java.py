@@ -1,0 +1,92 @@
+"""Java binding (parity: the reference's java/ JNI layer,
+``Table.java:289-307`` -> ``table_api``).
+
+Two gates:
+
+* With a JDK present: build the whole leg (host runtime, JNI bridge,
+  classes) and run ``JoinExample`` — the reference's CI pattern
+  (``.github/workflows/c-cpp.yml`` java step).
+* Always: compile-check ``cylon_jni.c`` against a minimal stub
+  ``jni.h`` (this image ships no JDK) so C-level breakage against the
+  catalog ABI is caught regardless.
+"""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+HAS_JDK = bool(shutil.which("javac") and shutil.which("java"))
+
+# Minimal JNI declarations covering exactly what cylon_jni.c uses —
+# a compile-check stand-in for <jni.h> (type-compatible by design of
+# the JNI spec; this is NOT a vendored header).
+_STUB_JNI_H = r"""
+#ifndef STUB_JNI_H
+#define STUB_JNI_H
+#include <stdint.h>
+typedef int32_t jint;  typedef int64_t jlong;  typedef int8_t jbyte;
+typedef double jdouble; typedef jint jsize;
+typedef void *jobject;  typedef jobject jclass;  typedef jobject jstring;
+typedef jobject jarray; typedef jarray jobjectArray;
+typedef jarray jlongArray; typedef jarray jdoubleArray;
+typedef jarray jintArray;  typedef jarray jbyteArray;
+typedef unsigned char jboolean;
+struct JNINativeInterface_;
+typedef const struct JNINativeInterface_ *JNIEnv;
+struct JNINativeInterface_ {
+  jclass (*FindClass)(JNIEnv *, const char *);
+  jint (*ThrowNew)(JNIEnv *, jclass, const char *);
+  const char *(*GetStringUTFChars)(JNIEnv *, jstring, jboolean *);
+  void (*ReleaseStringUTFChars)(JNIEnv *, jstring, const char *);
+  jstring (*NewStringUTF)(JNIEnv *, const char *);
+  jsize (*GetArrayLength)(JNIEnv *, jarray);
+  jobject (*GetObjectArrayElement)(JNIEnv *, jobjectArray, jsize);
+  jboolean (*IsInstanceOf)(JNIEnv *, jobject, jclass);
+  void (*GetLongArrayRegion)(JNIEnv *, jlongArray, jsize, jsize, jlong *);
+  void (*GetDoubleArrayRegion)(JNIEnv *, jdoubleArray, jsize, jsize,
+                               jdouble *);
+  jlongArray (*NewLongArray)(JNIEnv *, jsize);
+  void (*SetLongArrayRegion)(JNIEnv *, jlongArray, jsize, jsize,
+                             const jlong *);
+  jdoubleArray (*NewDoubleArray)(JNIEnv *, jsize);
+  void (*SetDoubleArrayRegion)(JNIEnv *, jdoubleArray, jsize, jsize,
+                               const jdouble *);
+  jintArray (*NewIntArray)(JNIEnv *, jsize);
+  void (*SetIntArrayRegion)(JNIEnv *, jintArray, jsize, jsize,
+                            const jint *);
+  jbyteArray (*NewByteArray)(JNIEnv *, jsize);
+  void (*SetByteArrayRegion)(JNIEnv *, jbyteArray, jsize, jsize,
+                             const jbyte *);
+  jobjectArray (*NewObjectArray)(JNIEnv *, jsize, jclass, jobject);
+  void (*SetObjectArrayElement)(JNIEnv *, jobjectArray, jsize, jobject);
+};
+#define JNIEXPORT __attribute__((visibility("default")))
+#define JNICALL
+#endif
+"""
+
+
+def test_jni_shim_compiles(tmp_path):
+    """cylon_jni.c must stay in sync with the catalog ABI — compile it
+    (syntax+types, incl. cylon_host.h signatures) without a JDK."""
+    inc = tmp_path / "include"
+    inc.mkdir()
+    (inc / "jni.h").write_text(_STUB_JNI_H)
+    src = os.path.join(REPO, "java/src/main/native/cylon_jni.c")
+    proc = subprocess.run(
+        ["gcc", "-fsyntax-only", "-Wall", "-Werror", f"-I{inc}", src],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stderr
+
+
+@pytest.mark.skipif(not HAS_JDK, reason="no JDK in this image")
+def test_java_join_example_end_to_end():
+    proc = subprocess.run(["sh", os.path.join(REPO, "java/build.sh"),
+                           "run"], capture_output=True, text=True,
+                          timeout=300)
+    assert proc.returncode == 0, proc.stderr
+    assert "JAVA-OK 3" in proc.stdout, proc.stdout

@@ -463,3 +463,65 @@ def test_native_join_differing_key_names():
              for x, av, y, bv in zip(aout, aval, bout, bval)}
     assert pairs == {(1, None), (2, 2), (3, None), (None, 4)}
     lib.cylon_catalog_clear()
+
+
+def test_native_catalog_join_string_keys_unifies_dictionaries():
+    """String-key joins must compare VALUES, not table-local codes:
+    independently ingested tables assign different codes to the same
+    string (left {'a','c'} -> 0,1; right {'b','c'} -> 0,1) — a raw code
+    compare would match 'a' with 'b' and miss 'c'=='c'. The catalog
+    join remaps both sides onto a merged dictionary (sidecar columns,
+    the Python/JNI wire convention) and re-emits the merged dictionary
+    on the output."""
+    import ctypes as c
+
+    import cylon_tpu as ct
+    from cylon_tpu import native
+    from cylon_tpu.native import catalog_get, catalog_put
+
+    lib = native._load()
+    native.catalog_clear()
+    lt = ct.Table.from_pydict({"k": np.array(["a", "c", "c"], object),
+                               "v": np.array([1.0, 2.0, 3.0])})
+    rt = ct.Table.from_pydict({"k": np.array(["b", "c"], object),
+                               "w": np.array([10.0, 20.0])})
+    catalog_put("L", lt)
+    catalog_put("R", rt)
+    key = (c.c_int32 * 1)(0)
+    assert lib.cylon_catalog_join(b"L", b"R", b"J", 1, key, key, 0) == 0
+    out = catalog_get("J").to_pandas()
+    want = (lt.to_pandas().merge(rt.to_pandas(), on="k", how="inner"))
+    got = out.sort_values(["k", "v"]).reset_index(drop=True)
+    want = want.sort_values(["k", "v"]).reset_index(drop=True)
+    pd.testing.assert_frame_equal(got, want)
+    # the coalesced key column keeps a usable dictionary
+    assert set(got["k"]) == {"c"}
+    native.catalog_clear()
+
+
+def test_native_catalog_join_dict_value_columns_survive():
+    """Non-key string columns pass through a join with their
+    dictionaries intact (sidecars are table metadata — they must never
+    be row-gathered)."""
+    import ctypes as c
+
+    import cylon_tpu as ct
+    from cylon_tpu import native
+    from cylon_tpu.native import catalog_get, catalog_put
+
+    lib = native._load()
+    native.catalog_clear()
+    lt = ct.Table.from_pydict({"k": np.arange(4, dtype=np.int64),
+                               "name": np.array(["x", "y", "x", "z"],
+                                                object)})
+    rt = ct.Table.from_pydict({"k": np.array([2, 3, 5], np.int64),
+                               "tag": np.array(["p", "q", "r"], object)})
+    catalog_put("L", lt)
+    catalog_put("R", rt)
+    key = (c.c_int32 * 1)(0)
+    assert lib.cylon_catalog_join(b"L", b"R", b"J", 1, key, key, 0) == 0
+    got = catalog_get("J").to_pandas().sort_values("k").reset_index(drop=True)
+    want = lt.to_pandas().merge(rt.to_pandas(), on="k", how="inner") \
+        .sort_values("k").reset_index(drop=True)
+    pd.testing.assert_frame_equal(got, want)
+    native.catalog_clear()
