@@ -29,6 +29,14 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 # "world" of MPI ranks (ctx/cylon_context.hpp:101 GetWorldSize).
 WORKER_AXIS = "w"
 
+# Outer mesh axis for hierarchical (multi-slice) topologies: slices are
+# connected by DCN, workers within a slice by ICI. The analog of the
+# reference's second transport tier (UCX vs MPI,
+# net/ucx/ucx_communicator.cpp:50-97) — here the tiers are physical
+# link classes of ONE mesh, and the shuffle stages across them
+# (parallel/shuffle.py hierarchical path) instead of selecting a backend.
+SLICE_AXIS = "s"
+
 
 class CommConfig:
     """Parity: ``net/comm_config.hpp`` base; subclasses select the backend
@@ -59,6 +67,14 @@ class TPUConfig(CommConfig):
     coordinator_address: Optional[str] = None
     num_processes: Optional[int] = None
     process_id: Optional[int] = None
+    #: hierarchical (slice × worker) topology. ``hierarchical=None``
+    #: auto-selects: a DCN-spanning mesh (multiple processes) becomes
+    #: (n_slices, devices_per_slice) with one slice per process, so
+    #: table shuffles stage intra-slice (ICI) before inter-slice (DCN).
+    #: ``devices_per_slice`` overrides the split (e.g. to test the
+    #: hierarchical path on a single-process CPU mesh).
+    hierarchical: Optional[bool] = None
+    devices_per_slice: Optional[int] = None
 
 
 # MPIConfig name kept as an alias so PyCylon scripts port mechanically.
@@ -89,9 +105,39 @@ class CylonEnv:
                 else jax.devices()
             if getattr(config, "n_devices", None):
                 devices = devices[: config.n_devices]
-        self._mesh = Mesh(np.array(devices), (WORKER_AXIS,))
+        per_slice = self._slice_split(config, devices, distributed)
+        if per_slice:
+            # one slice per process on multihost: sort so each mesh row
+            # is one process's local devices (the ICI domain) and rows
+            # talk over DCN
+            devices = sorted(devices,
+                             key=lambda d: (d.process_index, d.id))
+            arr = np.array(devices).reshape(-1, per_slice)
+            self._mesh = Mesh(arr, (SLICE_AXIS, WORKER_AXIS))
+        else:
+            self._mesh = Mesh(np.array(devices), (WORKER_AXIS,))
         self._finalized = False
         self._kv: dict[str, str] = {}
+
+    @staticmethod
+    def _slice_split(config, devices, distributed) -> int:
+        """devices-per-slice for a hierarchical mesh, or 0 for flat."""
+        if isinstance(config, LocalConfig) or not distributed \
+                or not isinstance(config, TPUConfig) or len(devices) < 2:
+            return 0
+        dps = config.devices_per_slice
+        hier = config.hierarchical
+        if hier is None:
+            hier = dps is not None or jax.process_count() > 1
+        if not hier:
+            return 0
+        if dps is None:
+            dps = max(1, len(devices) // jax.process_count())
+        if dps <= 0 or len(devices) % dps:
+            raise ValueError(
+                f"devices_per_slice={dps} does not divide the "
+                f"{len(devices)}-device world")
+        return dps if dps < len(devices) else 0
 
     # -- string KV config store (parity: ctx/cylon_context.hpp:32,69-77
     #    AddConfig/GetConfig/GetConfigs) ---------------------------------
@@ -125,6 +171,30 @@ class CylonEnv:
     def world_size(self) -> int:
         return self._mesh.devices.size
 
+    # -- hierarchical topology (the second transport tier) ---------------
+    @property
+    def is_hierarchical(self) -> bool:
+        """True when the mesh has a (slice, worker) axis split — table
+        shuffles then stage intra-slice (ICI) before inter-slice (DCN)."""
+        return len(self._mesh.axis_names) > 1
+
+    @property
+    def world_axes(self):
+        """Mesh axis name(s) spanning the whole world: ``"w"`` on a flat
+        mesh, ``("s", "w")`` on a hierarchical one. JAX collectives
+        accept either form; ``axis_index(("s", "w"))`` is the linear
+        global rank (slice-major), matching the row-shard order."""
+        names = self._mesh.axis_names
+        return names if len(names) > 1 else names[0]
+
+    @property
+    def n_slices(self) -> int:
+        return self._mesh.shape[SLICE_AXIS] if self.is_hierarchical else 1
+
+    @property
+    def devices_per_slice(self) -> int:
+        return self._mesh.shape[WORKER_AXIS]
+
     @property
     def rank(self) -> int:
         """Host process index (0 on single-controller). Inside shard_map the
@@ -150,8 +220,9 @@ class CylonEnv:
     # -- sharding helpers -------------------------------------------------
     @property
     def row_spec(self) -> PartitionSpec:
-        """Rows partitioned over the world axis."""
-        return PartitionSpec(WORKER_AXIS)
+        """Rows partitioned over the world axis (both axes when
+        hierarchical — shard i of W lives on device rank i either way)."""
+        return PartitionSpec(self.world_axes)
 
     @property
     def row_sharding(self) -> NamedSharding:

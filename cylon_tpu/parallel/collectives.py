@@ -29,10 +29,30 @@ class ReduceOp(enum.Enum):
     BOR = "bor"
 
 
+def _resolve_axes(axis_name):
+    """Default axes = ALL manual axes of the ambient shard_map mesh, in
+    mesh (slice-major) order — so these helpers reduce over the whole
+    world on hierarchical (slice × worker) meshes too, instead of
+    silently reducing within one slice. Explicit names pass through."""
+    if axis_name is not None:
+        return axis_name
+    try:
+        mesh = jax.sharding.get_abstract_mesh()
+        manual = tuple(n for n, t in zip(mesh.axis_names, mesh.axis_types)
+                       if t == jax.sharding.AxisType.Manual)
+        if manual:
+            return manual if len(manual) > 1 else manual[0]
+    except Exception:
+        pass
+    return WORKER_AXIS
+
+
 def all_reduce(x, op: ReduceOp | str = ReduceOp.SUM,
-               axis_name: str = WORKER_AXIS):
-    """AllReduce over the mesh axis (parity: ``mpi::AllReduce``,
-    ``net/mpi/mpi_operations.cpp:37``)."""
+               axis_name=None):
+    """AllReduce over the mesh axis/axes (parity: ``mpi::AllReduce``,
+    ``net/mpi/mpi_operations.cpp:37``). ``axis_name=None`` spans the
+    whole world — both axes of a hierarchical mesh."""
+    axis_name = _resolve_axes(axis_name)
     op = ReduceOp(op) if not isinstance(op, ReduceOp) else op
     if op == ReduceOp.SUM:
         return jax.lax.psum(x, axis_name)
@@ -62,11 +82,13 @@ def _fold_gather(x, axis_name, fn):
     return out
 
 
-def rank(axis_name: str = WORKER_AXIS):
-    """This shard's worker index (parity: ``CylonContext::GetRank``)."""
-    return jax.lax.axis_index(axis_name)
+def rank(axis_name=None):
+    """This shard's GLOBAL worker index (parity:
+    ``CylonContext::GetRank``) — slice-major linear rank on a
+    hierarchical mesh when ``axis_name`` is left default."""
+    return jax.lax.axis_index(_resolve_axes(axis_name))
 
 
-def world(axis_name: str = WORKER_AXIS) -> int:
-    """Static world size inside shard_map."""
-    return jax.lax.axis_size(axis_name)
+def world(axis_name=None) -> int:
+    """Static world size inside shard_map (all mesh axes by default)."""
+    return jax.lax.axis_size(_resolve_axes(axis_name))

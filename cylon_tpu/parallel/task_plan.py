@@ -111,6 +111,7 @@ def task_shuffle(env: CylonEnv, table: Table, task_ids,
     lookup = jnp.asarray(plan.worker_of())
     out_l = _out_cap_local(env, work, out_capacity=out_capacity)
     w = env.world_size
+    ax = env.world_axes
 
     def body(t):
         lt, inof = _checked_local(t)
@@ -121,9 +122,10 @@ def task_shuffle(env: CylonEnv, table: Table, task_ids,
         # result rather than silently dropping/misrouting the rows
         vmask = kernels.valid_mask(lt.capacity, lt.nrows)
         bad = vmask & ((tcol < 0) | (tcol >= lookup.shape[0]) | (pid < 0))
-        me = jax.lax.axis_index(WORKER_AXIS).astype(pid.dtype)
+        me = jax.lax.axis_index(ax).astype(pid.dtype)
         pid = jnp.where(bad, me, pid)
-        res, of = checked_recv(shuffle_local(lt, pid, out_l), out_l)
+        res, of = checked_recv(shuffle_local(lt, pid, out_l, axis_name=ax),
+                               out_l)
         return _shard_view(poison(res, inof, of, bad.any()))
 
     out = _smap(env, body, 1)(work)

@@ -29,6 +29,17 @@ def main():
                              num_processes=nproc, process_id=pid))
     assert env.world_size == 2 * nproc, env.world_size
     assert env.rank == pid
+    # multiple processes auto-select the hierarchical (slice × worker)
+    # topology: one slice per process, DCN between slices — the
+    # second-transport tier (reference: UCX vs MPI backends,
+    # net/ucx/ucx_communicator.cpp:50-97)
+    assert env.is_hierarchical, env.mesh
+    assert env.n_slices == nproc
+    assert env.devices_per_slice == 2
+    # a flat DCN-spanning mesh remains available on request
+    env_flat = CylonEnv(TPUConfig(hierarchical=False))
+    assert not env_flat.is_hierarchical
+    assert env_flat.world_size == env.world_size
 
     # identical data in every process (single-program SPMD: device_put
     # of the full host array places only this process's shards)
@@ -41,15 +52,21 @@ def main():
     left = Table.from_pydict({"k": lk, "a": a})
     right = Table.from_pydict({"k": rk, "b": b})
 
+    want = len(pd.DataFrame({"k": lk}).merge(pd.DataFrame({"k": rk}),
+                                             on="k"))
+    # hierarchical path: intra-slice exchange then inter-slice exchange
     j = dist_join(env, left, right, on="k", how="inner",
                   out_capacity=64 * n, shuffle_capacity=8 * n)
     got = dist_num_rows(j)
-    want = len(pd.DataFrame({"k": lk}).merge(pd.DataFrame({"k": rk}),
-                                             on="k"))
     assert got == want, (got, want)
+    # flat path over the same DCN-spanning device set agrees
+    jf = dist_join(env_flat, left, right, on="k", how="inner",
+                   out_capacity=64 * n, shuffle_capacity=8 * n)
+    got_flat = dist_num_rows(jf)
+    assert got_flat == want, (got_flat, want)
     env.barrier()
-    print(f"MULTIHOST-OK rank={pid} world={env.world_size} rows={got}",
-          flush=True)
+    print(f"MULTIHOST-OK rank={pid} world={env.world_size} rows={got} "
+          f"hier_slices={env.n_slices}", flush=True)
 
 
 if __name__ == "__main__":

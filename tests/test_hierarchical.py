@@ -1,0 +1,194 @@
+"""Hierarchical (slice × worker) topology: the second transport tier.
+
+The reference ships two interchangeable transports — MPI and UCX
+(``net/ucx/ucx_communicator.cpp:50-97``) — selected by CommConfig. The
+TPU analog is one mesh with two link classes: ICI within a slice, DCN
+between slices. These tests build a 2-slice × 4-worker mesh out of the
+8 virtual CPU devices and drive every distributed operator family
+through the two-stage exchange (``parallel/shuffle._exchange_hier``),
+asserting exact pandas parity — the same oracle the flat-mesh tests use
+(reference model: the same test body at world {1,2,4},
+``cpp/test/CMakeLists.txt:44-50``).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pandas as pd
+import pytest
+
+from cylon_tpu import CylonEnv, Table, TPUConfig
+from cylon_tpu.context import SLICE_AXIS, WORKER_AXIS
+from cylon_tpu.parallel import (dist_aggregate, dist_groupby, dist_join,
+                                dist_num_rows, dist_sort, dist_to_pandas,
+                                dist_union, dist_unique, repartition,
+                                scatter_table, shuffle)
+
+
+@pytest.fixture(scope="module")
+def henv():
+    """2 slices × 4 workers over the 8 virtual CPU devices."""
+    return CylonEnv(TPUConfig(devices_per_slice=4))
+
+
+def test_topology(henv):
+    assert henv.is_hierarchical
+    assert henv.world_size == 8
+    assert henv.n_slices == 2
+    assert henv.devices_per_slice == 4
+    assert henv.world_axes == (SLICE_AXIS, WORKER_AXIS)
+    assert dict(henv.mesh.shape) == {SLICE_AXIS: 2, WORKER_AXIS: 4}
+
+
+def test_flat_default_unchanged(env8):
+    assert not env8.is_hierarchical
+    assert env8.world_axes == WORKER_AXIS
+
+
+def _tables(rng, n=2000, nkeys=120):
+    lk = rng.integers(0, nkeys, n).astype(np.int64)
+    rk = rng.integers(0, nkeys, n).astype(np.int64)
+    a = rng.normal(size=n)
+    b = rng.normal(size=n)
+    left = Table.from_pydict({"k": lk, "a": a})
+    right = Table.from_pydict({"k": rk, "b": b})
+    lp = pd.DataFrame({"k": lk, "a": a})
+    rp = pd.DataFrame({"k": rk, "b": b})
+    return left, right, lp, rp
+
+
+def test_hier_shuffle_colocates_and_preserves_rows(henv, rng):
+    left, _, lp, _ = _tables(rng)
+    sh = shuffle(henv, left, ["k"])
+    assert dist_num_rows(sh) == len(lp)
+    got = dist_to_pandas(henv, sh)
+    # same multiset of rows
+    pd.testing.assert_frame_equal(
+        got.sort_values(["k", "a"]).reset_index(drop=True),
+        lp.sort_values(["k", "a"]).reset_index(drop=True),
+        check_dtype=False)
+    # equal keys co-located: each key appears in exactly one shard block
+    counts = np.asarray(sh.nrows)
+    cap_l = sh.capacity // henv.world_size
+    kv = np.asarray(jnp.asarray(sh.column("k").data))
+    owners = {}
+    for s in range(henv.world_size):
+        blk = kv[s * cap_l: s * cap_l + counts[s]]
+        for key in np.unique(blk):
+            assert owners.setdefault(key, s) == s
+    # the exchange must actually have used both stages: >1 slice
+    assert henv.n_slices > 1
+
+
+@pytest.mark.parametrize("how", ["inner", "left", "outer"])
+def test_hier_join_parity(henv, rng, how):
+    left, right, lp, rp = _tables(rng)
+    j = dist_join(henv, left, right, on="k", how=how)
+    got = dist_to_pandas(henv, j)
+    want = lp.merge(rp, on="k", how=how)
+    cols = ["k", "a", "b"]
+    pd.testing.assert_frame_equal(
+        got[cols].sort_values(cols).reset_index(drop=True),
+        want[cols].sort_values(cols).reset_index(drop=True),
+        check_dtype=False)
+
+
+def test_hier_groupby_parity(henv, rng):
+    left, _, lp, _ = _tables(rng)
+    g = dist_groupby(henv, left, ["k"],
+                     [("a", "sum"), ("a", "count"), ("a", "min")])
+    got = dist_to_pandas(henv, g).sort_values("k").reset_index(drop=True)
+    want = lp.groupby("k", as_index=False).agg(
+        a_sum=("a", "sum"), a_count=("a", "count"), a_min=("a", "min"))
+    assert (got["k"].values == want["k"].values).all()
+    np.testing.assert_allclose(got["a_sum"], want["a_sum"])
+    assert (got["a_count"].values == want["a_count"].values).all()
+    np.testing.assert_allclose(got["a_min"], want["a_min"])
+
+
+def test_hier_sort_globally_ordered(henv, rng):
+    left, _, lp, _ = _tables(rng)
+    s = dist_sort(henv, left, "k")
+    got = dist_to_pandas(henv, s)
+    assert (got["k"].values == np.sort(lp["k"].values)).all()
+
+
+def test_hier_setops_and_unique(henv, rng):
+    n = 600
+    a = rng.integers(0, 50, n).astype(np.int64)
+    b = rng.integers(25, 75, n).astype(np.int64)
+    ta = Table.from_pydict({"x": a})
+    tb = Table.from_pydict({"x": b})
+    u = dist_to_pandas(henv, dist_union(henv, ta, tb))
+    want = np.union1d(a, b)
+    assert (np.sort(u["x"].values) == want).all()
+    uq = dist_to_pandas(henv, dist_unique(henv, ta))
+    assert (np.sort(uq["x"].values) == np.unique(a)).all()
+
+
+def test_hier_aggregate_and_repartition(henv, rng):
+    left, _, lp, _ = _tables(rng)
+    s = dist_aggregate(henv, left, "a", "sum")
+    np.testing.assert_allclose(float(np.asarray(s)), lp["a"].sum())
+    n = dist_aggregate(henv, left, "a", "count")
+    assert int(np.asarray(n)) == len(lp)
+    rp = repartition(henv, left)
+    counts = np.asarray(rp.nrows)
+    assert counts.sum() == len(lp)
+    assert counts.max() - counts.min() <= 1
+
+
+def test_hier_stage1_overflow_poisons_globally(henv, rng):
+    """All rows hash to one destination: stage-1 gateways overflow a
+    deliberately tiny out_capacity, and the poison must surface as
+    OutOfCapacity even though the regrow ladder is bypassed."""
+    from cylon_tpu.errors import OutOfCapacity
+
+    n = 512
+    t = Table.from_pydict({"k": np.zeros(n, np.int64),
+                           "v": rng.normal(size=n)})
+    with pytest.raises(OutOfCapacity):
+        sh = shuffle(henv, t, ["k"], out_capacity=64)
+        dist_num_rows(sh)
+
+
+def test_collectives_default_spans_hierarchical_world(henv, env8):
+    """parallel.collectives helpers with the default axis must span the
+    WHOLE world on a hierarchical mesh (slice-major global rank), not
+    one slice."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from cylon_tpu.parallel.collectives import all_reduce, rank, world
+
+    for env in (henv, env8):
+        def body(x):
+            r = rank()
+            w = jnp.int32(world())
+            s = all_reduce(x.sum())
+            return r[None], w[None], s[None]
+
+        x = jnp.ones(env.world_size, jnp.int32)
+        spec = P(env.world_axes)
+        ranks, ws, sums = jax.jit(jax.shard_map(
+            body, mesh=env.mesh, in_specs=(spec,),
+            out_specs=(spec, spec, spec)))(x)
+        assert np.asarray(ranks).tolist() == list(range(env.world_size))
+        assert np.asarray(ws).tolist() == [env.world_size] * env.world_size
+        assert np.asarray(sums).tolist() == [env.world_size] * env.world_size
+
+
+def test_hier_compiled_query(henv, rng):
+    """Whole-query compilation traces through the two-stage exchange."""
+    from cylon_tpu import plan
+
+    left, right, lp, rp = _tables(rng, n=800, nkeys=60)
+
+    def q(l, r):
+        j = dist_join(henv, l, r, on="k", how="inner")
+        return dist_aggregate(henv, j, "a", "sum")
+
+    compiled = plan.compile_query(q)
+    got = float(np.asarray(compiled(scatter_table(henv, left),
+                                    scatter_table(henv, right))))
+    want = lp.merge(rp, on="k")["a"].sum()
+    np.testing.assert_allclose(got, want)
