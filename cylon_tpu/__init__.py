@@ -78,7 +78,8 @@ from cylon_tpu.errors import (
 from cylon_tpu.table import Table
 from cylon_tpu.series import Series
 from cylon_tpu.frame import DataFrame, GroupByDataFrame, concat, merge, read_csv
-from cylon_tpu.io import read_csv_sharded
+from cylon_tpu.io import (read_csv_chunks, read_csv_sharded,
+                          read_parquet_chunks)
 from cylon_tpu.indexing import IndexingType
 
 __version__ = "0.1.0"
@@ -111,5 +112,7 @@ __all__ = [
     "dtypes",
     "merge",
     "read_csv",
+    "read_csv_chunks",
     "read_csv_sharded",
+    "read_parquet_chunks",
 ]

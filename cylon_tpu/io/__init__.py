@@ -40,7 +40,8 @@ def _column_types_arrow(column_types):
     return out or None
 
 
-def _arrow_csv_read(path, options: CSVReadOptions):
+def _arrow_csv_opts(options: CSVReadOptions):
+    """(ReadOptions, ParseOptions, ConvertOptions) for pyarrow.csv."""
     import pyarrow.csv as pacsv
 
     read_opts = pacsv.ReadOptions(
@@ -76,6 +77,13 @@ def _arrow_csv_read(path, options: CSVReadOptions):
     if options.false_values is not None:
         convert_kw["false_values"] = list(options.false_values)
     convert = pacsv.ConvertOptions(**convert_kw)
+    return read_opts, parse_opts, convert
+
+
+def _arrow_csv_read(path, options: CSVReadOptions):
+    import pyarrow.csv as pacsv
+
+    read_opts, parse_opts, convert = _arrow_csv_opts(options)
     return pacsv.read_csv(path, read_options=read_opts,
                           parse_options=parse_opts, convert_options=convert)
 
@@ -372,6 +380,103 @@ def read_csv_sharded(paths: Sequence[str], env,
         [jax.device_put(np.asarray([counts[s]], np.int32), devs[s])
          for s in mine])
     return DataFrame._wrap(Table(cols, nrows))
+
+
+def read_csv_chunks(path, chunk_rows: int,
+                    options: CSVReadOptions | None = None):
+    """Out-of-core CSV source: yield fixed-capacity ``Table`` chunks
+    without ever materialising the file on the host.
+
+    The reference's streaming op-graph exists to process inputs larger
+    than memory as chunks arrive (``ops/dis_join_op.cpp:21-72`` fed by
+    arrow record batches; incremental receive reassembly in
+    ``arrow_all_to_all.cpp:173-214``). This is the ingest end of that
+    pipeline: pyarrow's incremental CSV reader parses one block at a
+    time, rows are re-packed into chunks of EXACTLY ``chunk_rows``
+    capacity (every chunk shape-identical, so the downstream per-chunk
+    shuffle/pre-combine programs compile once and are reused), and host
+    memory stays O(block + chunk) regardless of file size.
+
+    Feed the chunks to :class:`cylon_tpu.ops_graph.DisJoinOp` /
+    ``GroupByOp`` etc. — with ``env=`` they hash-shuffle over the mesh
+    as they arrive, so no single host ever holds the dataset.
+
+    String columns dictionary-encode per chunk; downstream concat /
+    join unify dictionaries (``ops/dictenc.py``), and mesh shuffles
+    hash dictionary VALUES, so per-chunk code spaces are safe.
+    """
+    import pyarrow.csv as pacsv
+
+    # validate and open EAGERLY (this is not a generator function):
+    # bad arguments or a missing file raise at the call site, not at
+    # some distant first next() inside a streaming loop
+    if chunk_rows <= 0:
+        raise IOError_(f"chunk_rows must be positive, got {chunk_rows}")
+    options = options or CSVReadOptions()
+    read_opts, parse_opts, convert = _arrow_csv_opts(options)
+    try:
+        reader = pacsv.open_csv(path, read_options=read_opts,
+                                parse_options=parse_opts,
+                                convert_options=convert)
+    except Exception as e:
+        raise IOError_(f"csv chunk read failed: {e}") from e
+    return _csv_chunk_iter(reader, chunk_rows)
+
+
+def _csv_chunk_iter(reader, chunk_rows: int):
+    import pyarrow as pa
+
+    pending: list = []   # record batches, together < chunk_rows + block
+    npend = 0
+    try:
+        with reader:
+            for batch in reader:
+                if batch.num_rows == 0:
+                    continue
+                pending.append(batch)
+                npend += batch.num_rows
+                while npend >= chunk_rows:
+                    tbl = pa.Table.from_batches(pending)
+                    yield Table.from_arrow(tbl.slice(0, chunk_rows),
+                                           capacity=chunk_rows)
+                    rest = tbl.slice(chunk_rows)
+                    pending = rest.to_batches() if rest.num_rows else []
+                    npend = rest.num_rows
+    except Exception as e:
+        raise IOError_(f"csv chunk read failed: {e}") from e
+    if npend:
+        yield Table.from_arrow(pa.Table.from_batches(pending),
+                               capacity=chunk_rows)
+
+
+def read_parquet_chunks(path, chunk_rows: int,
+                        columns: Sequence[str] | None = None):
+    """Out-of-core Parquet source: ``chunk_rows``-capacity chunks via
+    pyarrow's row-group/batch iterator — the Parquet twin of
+    :func:`read_csv_chunks` (parity surface: ``FromParquet``,
+    table.cpp:1121, streamed instead of materialised)."""
+    import pyarrow.parquet as pq
+
+    if chunk_rows <= 0:
+        raise IOError_(f"chunk_rows must be positive, got {chunk_rows}")
+    try:
+        pf = pq.ParquetFile(path)   # eager: missing file raises here
+    except Exception as e:
+        raise IOError_(f"parquet chunk read failed: {e}") from e
+    return _parquet_chunk_iter(pf, chunk_rows, columns)
+
+
+def _parquet_chunk_iter(pf, chunk_rows: int, columns):
+    import pyarrow as pa
+
+    try:
+        for batch in pf.iter_batches(batch_size=chunk_rows,
+                                     columns=columns):
+            if batch.num_rows:
+                yield Table.from_arrow(pa.Table.from_batches([batch]),
+                                       capacity=chunk_rows)
+    except Exception as e:
+        raise IOError_(f"parquet chunk read failed: {e}") from e
 
 
 def write_csv(df, path, options: CSVWriteOptions | None = None):
