@@ -696,10 +696,23 @@ class GroupByDataFrame:
         self._by = list(by)
         self._env = env
 
-    def agg(self, spec, out_capacity: int | None = None) -> DataFrame:
-        """spec: {col: op | [ops]} (pandas style) or [(col, op[, name])]."""
+    def agg(self, spec=None, out_capacity: int | None = None,
+            **named) -> DataFrame:
+        """spec: {col: op | [ops]} (pandas style), [(col, op[, name])],
+        or pandas named aggregation — ``agg(out=("col", "op"), ...)``."""
         aggs = []
-        if isinstance(spec, Mapping):
+        if named:
+            if spec is not None:
+                raise InvalidArgument(
+                    "pass either a spec or named aggregations, not both")
+            for name, co in named.items():
+                if not isinstance(co, (tuple, list)) or len(co) != 2:
+                    raise InvalidArgument(
+                        f"named aggregation {name}=... must be a "
+                        f"(column, op) pair, got {type(co).__name__}")
+                col, op = co
+                aggs.append((col, op, name))
+        elif isinstance(spec, Mapping):
             for col, ops in spec.items():
                 ops = [ops] if isinstance(ops, str) else list(ops)
                 for op in ops:

@@ -1023,16 +1023,50 @@ void append_dict_sidecars(CatTable* out, const std::string& base,
 
 struct KeyCol {
   const CatColumn* col;
-  int cls;  // 0 = 8-byte int image, 1 = f64, 2 = int32 codes
+  int cls;    // 0 = int image, 1 = f64, 2 = int32 codes, 3 = f32
+  int width;  // bytes per element (class 0; others fixed 4/8)
 };
 
-inline int key_class(const CatColumn& c, int64_t n_rows) {
-  int64_t w = n_rows > 0 ? (int64_t)c.data.size() / n_rows : 0;
+// Resolve a key column's physical interpretation from its tag AND its
+// measured element width. The raw C-client tags (0 int64 / 1 f64 /
+// 2 codes) collide with Kind values (BOOL=0 / UINT8=1 / INT8=2); the
+// width disambiguates: a Kind-tagged narrow column is 1 byte/row, the
+// C-client meanings are 8/8/4. Every class validates the buffer size
+// against n_rows so an under-sized or mis-tagged buffer is rejected
+// (-1 -> join status -4) instead of read out of bounds.
+struct KeyClass {
+  int cls;    // -1 = unsupported/mis-sized
+  int width;
+};
+
+inline KeyClass key_class(const CatColumn& c, int64_t n_rows) {
   int tag = c.dtype & 0xFF;
-  if (tag == 2 || tag == 12 || tag == 13) return 2;
-  if (tag == 1 || tag == 11) return 1;
-  if (w != 0 && w != 8) return -1;  // unsupported physical key width
-  return 0;
+  if (n_rows <= 0) return {0, 0};  // no reads ever issued
+  if ((int64_t)c.data.size() % n_rows != 0) return {-1, 0};
+  int64_t w = (int64_t)c.data.size() / n_rows;
+  if (tag == 12 || tag == 13) return w == 4 ? KeyClass{2, 4} : KeyClass{-1, 0};
+  if (tag == 11) return w == 8 ? KeyClass{1, 8} : KeyClass{-1, 0};
+  if (tag == 10) return w == 4 ? KeyClass{3, 4} : KeyClass{-1, 0};
+  if (tag == 9) return {-1, 0};  // f16 keys: raw-bit compare would get
+                                 // -0.0/NaN wrong; unsupported (as before)
+  if (tag == 2) {   // C-client codes (4) vs Kind.INT8 (1)
+    if (w == 4) return {2, 4};
+    if (w == 1) return {0, 1};
+    return {-1, 0};
+  }
+  if (tag == 1) {   // C-client f64 (8) vs Kind.UINT8 (1)
+    if (w == 8) return {1, 8};
+    if (w == 1) return {0, 1};
+    return {-1, 0};
+  }
+  if (tag == 0) {   // C-client int64 (8) vs Kind.BOOL (1)
+    if (w == 8) return {0, 8};
+    if (w == 1) return {0, 1};
+    return {-1, 0};
+  }
+  // remaining int/temporal kinds: raw little-endian image of their width
+  if (w == 1 || w == 2 || w == 4 || w == 8) return {0, (int)w};
+  return {-1, 0};
 }
 
 inline int64_t key_bits(const KeyCol& k, int64_t i) {
@@ -1051,9 +1085,20 @@ inline int64_t key_bits(const KeyCol& k, int64_t i) {
     std::memcpy(&v, &d, 8);
     return v;
   }
-  int64_t v;
-  std::memcpy(&v, c.data.data() + i * 8, 8);
-  return v;
+  if (k.cls == 3) {
+    float f;
+    std::memcpy(&f, c.data.data() + i * 4, 4);
+    if (f == 0.0f) f = 0.0f;                    // -0.0 -> +0.0
+    if (f != f) f = std::numeric_limits<float>::quiet_NaN();
+    int32_t v;
+    std::memcpy(&v, &f, 4);
+    return v;
+  }
+  // int image, zero-extended: both sides share the exact dtype tag
+  // (enforced before key setup), so equal bits <=> equal values
+  uint64_t v = 0;
+  std::memcpy(&v, c.data.data() + i * k.width, (size_t)k.width);
+  return (int64_t)v;
 }
 
 // composite row-key hash over the key views (null == null: validity
@@ -1136,7 +1181,17 @@ int32_t cylon_catalog_join(const char* left_id, const char* right_id,
     // of DIFFERENT logical types (timestamp[s] vs [ms], raw codes vs
     // Kind-tagged codes) must not join on bit coincidence
     if (L.cols[lk_[i]].dtype != R.cols[rk_[i]].dtype) return -4;
-    if (key_class(L.cols[lk_[i]], L.n_rows) < 0) return -4;
+    KeyClass lkc = key_class(L.cols[lk_[i]], L.n_rows);
+    KeyClass rkc = key_class(R.cols[rk_[i]], R.n_rows);
+    if (lkc.cls < 0 || rkc.cls < 0) return -4;
+    // equal AMBIGUOUS tags can still resolve to different physical
+    // interpretations (raw C-client codes vs Kind.INT8, f64 vs uint8):
+    // matching on bit coincidence across classes/widths is meaningless.
+    // Empty sides (n_rows == 0, width 0) match anything: no reads occur
+    // and the join degenerates per join type.
+    if (L.n_rows > 0 && R.n_rows > 0 &&
+        (lkc.cls != rkc.cls || lkc.width != rkc.width))
+      return -4;
   }
 
   // dictionary-aware keys: codes are TABLE-LOCAL (each ingest assigns
@@ -1152,8 +1207,10 @@ int32_t cylon_catalog_join(const char* left_id, const char* right_id,
   for (int32_t f = 0; f < n_keys; ++f) {
     const CatColumn& lc = L.cols[lk_[f]];
     const CatColumn& rc = R.cols[rk_[f]];
-    int cls = key_class(lc, L.n_rows);
-    if (cls == 2) {
+    KeyClass lkc = key_class(lc, L.n_rows);
+    KeyClass rkc = key_class(rc, R.n_rows);
+    int cls = lkc.cls;
+    if (cls == 2 && rkc.cls == 2) {
       std::vector<std::string> lv, rv;
       if (extract_dict(L, lc.name, &lv) && extract_dict(R, rc.name, &rv)) {
         std::vector<std::string> merged = lv;
@@ -1185,15 +1242,15 @@ int32_t cylon_catalog_join(const char* left_id, const char* right_id,
           shadows.push_back(std::move(s));
           return &shadows.back();
         };
-        lkv.push_back({shadow(lc, L.n_rows, lm), 2});
-        rkv.push_back({shadow(rc, R.n_rows, rm), 2});
+        lkv.push_back({shadow(lc, L.n_rows, lm), 2, 4});
+        rkv.push_back({shadow(rc, R.n_rows, rm), 2, 4});
         unified[f] = 1;
         merged_vals[f] = std::move(merged);
         continue;
       }
     }
-    lkv.push_back({&lc, cls});
-    rkv.push_back({&rc, cls});
+    lkv.push_back({&lc, cls, lkc.width});
+    rkv.push_back({&rc, rkc.cls, rkc.width});
   }
 
   // build on the right, probe from the left (hash_join.cpp builds on
@@ -1302,7 +1359,7 @@ int32_t cylon_catalog_join(const char* left_id, const char* right_id,
       out_dicts.emplace_back(col.name, merged_vals[f]);
     } else {
       std::vector<std::string> dv;
-      if (key_class(L.cols[ci], L.n_rows) == 2
+      if (key_class(L.cols[ci], L.n_rows).cls == 2
           && extract_dict(L, L.cols[ci].name, &dv))
         out_dicts.emplace_back(col.name, std::move(dv));
     }
@@ -1314,7 +1371,7 @@ int32_t cylon_catalog_join(const char* left_id, const char* right_id,
                                  ri_out);
     if (name_count[col.name] > 1) col.name += "_y";
     std::vector<std::string> dv;
-    if (key_class(R.cols[cj], R.n_rows) == 2
+    if (key_class(R.cols[cj], R.n_rows).cls == 2
         && extract_dict(R, R.cols[cj].name, &dv))
       out_dicts.emplace_back(col.name, std::move(dv));
     out.cols.push_back(std::move(col));

@@ -525,3 +525,70 @@ def test_native_catalog_join_dict_value_columns_survive():
         .sort_values("k").reset_index(drop=True)
     pd.testing.assert_frame_equal(got, want)
     native.catalog_clear()
+
+
+def test_native_catalog_join_narrow_int_keys():
+    """Kind-tagged narrow keys (int8=2, uint8=1, bool=0, int16=4)
+    collide with the raw C-client tags (codes=2, f64=1, int64=0);
+    key_class must disambiguate by measured element width — before the
+    width-aware fix these read 4-8 bytes per 1-byte element (OOB heap
+    reads, garbage join output)."""
+    import ctypes as c
+
+    import cylon_tpu as ct
+    from cylon_tpu import native
+    from cylon_tpu.native import catalog_get, catalog_put
+
+    lib = native._load()
+    if lib is None:
+        pytest.skip("native lib unavailable")
+    rng = np.random.default_rng(23)
+    for dt in (np.int8, np.uint8, np.bool_, np.int16, np.int32):
+        native.catalog_clear()
+        n, m = 400, 300
+        lk = rng.integers(0, 2 if dt == np.bool_ else 50, n).astype(dt)
+        rk = rng.integers(0, 2 if dt == np.bool_ else 50, m).astype(dt)
+        lt = ct.Table.from_pydict({"k": lk,
+                                   "v": rng.normal(size=n)})
+        rt = ct.Table.from_pydict({"k": rk,
+                                   "w": rng.normal(size=m)})
+        catalog_put("L", lt)
+        catalog_put("R", rt)
+        key = (c.c_int32 * 1)(0)
+        assert lib.cylon_catalog_join(b"L", b"R", b"J", 1, key, key, 0) == 0
+        got = catalog_get("J").to_pandas()
+        want = pd.DataFrame({"k": lk}).merge(pd.DataFrame({"k": rk}),
+                                             on="k", how="inner")
+        assert len(got) == len(want), dt
+        gk = got["k"].astype(np.int64).values
+        assert sorted(gk.tolist()) == sorted(
+            want["k"].astype(np.int64).tolist()), dt
+    native.catalog_clear()
+
+
+def test_native_catalog_join_rejects_missized_key():
+    """A key buffer shorter than n_rows*width must fail the join with
+    status -4, not read out of bounds."""
+    import ctypes as c
+
+    from cylon_tpu import native
+
+    lib = native._load()
+    if lib is None:
+        pytest.skip("native lib unavailable")
+    native.catalog_clear()
+    short = np.arange(3, dtype=np.int64)  # 3 rows of data...
+    names = (c.c_char_p * 1)(b"k")
+    dt = (c.c_int32 * 1)(0)
+    data = (c.c_void_p * 1)(short.ctypes.data_as(c.c_void_p))
+    lens = (c.c_int64 * 1)(short.nbytes - 5)  # ...but a truncated buffer
+    assert lib.cylon_catalog_put(b"L", 1, names, dt, 3, data, lens,
+                                 None) == 0
+    ok = np.arange(3, dtype=np.int64)
+    data2 = (c.c_void_p * 1)(ok.ctypes.data_as(c.c_void_p))
+    lens2 = (c.c_int64 * 1)(ok.nbytes)
+    assert lib.cylon_catalog_put(b"R", 1, names, dt, 3, data2, lens2,
+                                 None) == 0
+    key = (c.c_int32 * 1)(0)
+    assert lib.cylon_catalog_join(b"L", b"R", b"J", 1, key, key, 0) == -4
+    native.catalog_clear()
