@@ -701,6 +701,10 @@ class GroupByDataFrame:
         """spec: {col: op | [ops]} (pandas style), [(col, op[, name])],
         or pandas named aggregation — ``agg(out=("col", "op"), ...)``."""
         aggs = []
+        if spec is None and not named:
+            raise InvalidArgument(
+                "agg() needs a spec ({col: op}, [(col, op[, name])]) or "
+                "named aggregations (out=(col, op))")
         if named:
             if spec is not None:
                 raise InvalidArgument(
