@@ -10,12 +10,16 @@ per-rank rate.
 
 Config: BASELINE.json config 2 — two int64-keyed tables with float64
 values, hash inner join, measured steady-state on the real chip.
-Steady state means a pipeline of ``CYLON_BENCH_PIPELINE`` (default 4)
-back-to-back joins inside one XLA program — distinct value columns per
-stage so nothing CSEs — timed over ``CYLON_BENCH_REPS`` dispatches;
-this amortises per-dispatch RPC/host overhead exactly as a streaming
-workload would (the reference's 4.0 s / 64-rank number likewise spans
-many overlapped exchanges, not one cold call).
+Steady state means a pipeline of ``CYLON_BENCH_PIPELINE`` (default 12)
+back-to-back joins inside one XLA program — distinct key AND value
+columns per stage so nothing CSEs — timed over ``CYLON_BENCH_REPS``
+dispatches; this amortises per-dispatch RPC/host overhead exactly as a
+streaming workload would (the reference's 4.0 s / 64-rank number
+likewise spans many overlapped exchanges, not one cold call). Depth 12
+is where the measurement saturates on the tunneled v5e (per-dispatch
+RPC is ~110 ms against ~12 ms of device time per join; beyond 12 the
+number stops moving, i.e. it is the DEVICE being measured, not the
+tunnel).
 
 Emits ONE json line: {"metric", "value", "unit", "vs_baseline"}.
 """
@@ -36,7 +40,7 @@ def main():
 
     n = int(os.environ.get("CYLON_BENCH_ROWS", 1_000_000))
     reps = int(os.environ.get("CYLON_BENCH_REPS", 5))
-    depth = int(os.environ.get("CYLON_BENCH_PIPELINE", 4))
+    depth = int(os.environ.get("CYLON_BENCH_PIPELINE", 12))
     # E[output rows] == n for uniform keys; 2x headroom stays safe while
     # keeping the capacity-bounded buffers (and their gathers) tight
     out_cap = 2 * n

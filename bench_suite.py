@@ -104,6 +104,20 @@ def main():
                 lambda: out["g"].nrows, reps)
     _emit("groupby_agg_rows_per_sec", 10 * n / t, "rows/s")
 
+    # 3b. high-cardinality groupby: ~0.6 groups per row — the shape
+    # where XLA's segment lowering collapses and the TPU segmented-scan
+    # path (kernels.segmented_totals) carries the load
+    hk = max(n * 6 // 10, 1)
+    ht = Table.from_pydict({
+        "k": rng.integers(0, hk, n).astype(np.int64),
+        "v": rng.normal(size=n)})
+    f3b = jax.jit(lambda tt: groupby_aggregate(
+        tt, ["k"], [("v", "sum"), ("v", "mean"), ("v", "count")],
+        out_capacity=hk + 1))
+    t = _timeit(lambda: out.__setitem__("h", f3b(ht)),
+                lambda: out["h"].nrows, reps)
+    _emit("groupby_highcard_rows_per_sec", n / t, "rows/s")
+
     # 4. sort + union ------------------------------------------------------
     st = Table.from_pydict({"k": rng.integers(0, 2**40, n).astype(np.int64)})
     f4 = jax.jit(lambda tt: sort_table(tt, ["k"]))

@@ -164,6 +164,21 @@ def sort_perm(keys: Sequence[jax.Array], nrows, *, ascending=True,
 
     Parity: ``SortIndicesMultiColumns`` (``arrow_kernels.hpp:134-140``) and
     ``util::SortTableMultiColumns`` (``util/arrow_utils.hpp:63-118``).
+
+    Why there is NO custom (Pallas radix/bucket) sort here, measured on
+    v5e at 1M rows: ``lax.sort`` of one u64 operand is ~0-1 ms and a
+    3-operand (u64 key + f64 + i32 payload) sort ~3 ms — while a
+    same-size random f64 gather is ~17 ms, a scatter ~135 ms and one
+    f64 segment op ~97 ms. XLA:TPU's sort is already within a small
+    factor of memory bandwidth, and any radix implementation must
+    apply its permutations through exactly the gathers/scatters that
+    dominate those numbers — i.e. on this hardware a hand-written sort
+    attacks the one primitive that is NOT the bottleneck. The wins the
+    reference gets from its custom ``util/sort.hpp`` were instead
+    realised where this platform actually bleeds: payload-carrying
+    sorts (no post-sort gathers), operand packing (below), and the
+    segmented-scan + compaction-sort aggregation path
+    (:func:`segmented_totals`) that removes segment ops entirely.
     """
     cap = keys[0].shape[0]
     iota = jnp.arange(cap, dtype=jnp.int32)
