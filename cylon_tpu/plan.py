@@ -128,14 +128,83 @@ def _result_tables(out):
     return found
 
 
-def _check_overflow(out) -> None:
+#: arrays this small ride the overflow check's batched fetch (below a
+#: page of f64s — scalars and tiny vectors, never column buffers)
+_PREFETCH_ELEMS = 512
+
+
+def _result_scalars(out):
+    """Small bare jax arrays in a query result (scalar aggregates, tiny
+    vectors) — NOT table columns. Prefetched with the overflow check so
+    the caller's own ``float(np.asarray(x))`` hits the host cache
+    instead of paying a second tunnel round trip (q6/q14/q17-shaped
+    queries return only scalars)."""
+    found = []
+
+    def visit(x):
+        if isinstance(x, jax.Array):
+            if x.size <= _PREFETCH_ELEMS and \
+                    getattr(x, "is_fully_addressable", True):
+                found.append(x)
+            return
+        if hasattr(x, "table") or hasattr(x, "columns"):
+            return  # tables fetch via nrows; columns via to_pandas
+        if isinstance(x, (list, tuple)):
+            for v in x:
+                visit(v)
+        elif isinstance(x, dict):
+            for v in x.values():
+                visit(v)
+
+    visit(out)
+    return found
+
+
+#: result tables whose buffers total at most this many bytes ride the
+#: overflow check's batched transfer too — a later ``to_pandas`` then
+#: reads host caches instead of paying its own tunnel round trip
+_PREFETCH_TABLE_BYTES = 4 << 20
+
+
+def _check_overflow(out, bad=None) -> None:
     """Host-side: raise OutOfCapacity if any result shard overflowed
-    (poisoned nrows > local capacity — see ``parallel.shuffle.poison``)."""
+    (poisoned nrows > local capacity — see ``parallel.shuffle.poison``)
+    or the registered poison flag ``bad`` fired.
+
+    ONE batched device->host transfer covers the flag, every result
+    table's row counts, small result scalars, and the column buffers of
+    small (bucket-sliced) result tables (async copies issued together,
+    then gathered — the ``Table.to_pandas`` pattern): on a tunneled
+    device each separate ``np.asarray`` is a ~100-120 ms round trip,
+    and this check + result fetch used to pay three of them per
+    compiled-query call."""
     import numpy as np
 
     from cylon_tpu.parallel import dtable
 
-    for t in _result_tables(out):
+    tables = _result_tables(out)
+    leaves = [t.nrows for t in tables
+              if getattr(t.nrows, "is_fully_addressable", True)]
+    leaves.extend(_result_scalars(out))
+    for t in tables:
+        if dtable.is_distributed(t):
+            continue
+        nbytes = sum(c.data.size * c.data.dtype.itemsize
+                     + (c.validity.size if c.validity is not None else 0)
+                     for c in t.columns.values())
+        if nbytes <= _PREFETCH_TABLE_BYTES:
+            for c in t.columns.values():
+                leaves.append(c.data)
+                if c.validity is not None:
+                    leaves.append(c.validity)
+    if bad is not None:
+        leaves.append(bad)
+    jax.device_get(leaves)   # batch; host values now cached per array
+    if bad is not None and bool(np.asarray(bad)):
+        raise OutOfCapacity(
+            "an op inside the compiled query overflowed its "
+            "capacity bound")
+    for t in tables:
         if dtable.is_distributed(t):
             dtable.dist_num_rows(t)
         else:
@@ -143,6 +212,29 @@ def _check_overflow(out) -> None:
             if n > t.capacity:
                 raise OutOfCapacity(
                     f"result rows {n} exceed capacity {t.capacity}")
+
+
+def _map_result_tables(out, fn):
+    """Rebuild a query-result pytree with ``fn`` applied to every Table
+    (DataFrames re-wrapped). Visits tables in the same order as
+    :func:`_result_tables`."""
+    from cylon_tpu.table import Table
+
+    def walk(x):
+        if isinstance(x, Table):
+            return fn(x)
+        t = getattr(x, "table", None)
+        if isinstance(t, Table) and hasattr(type(x), "_wrap"):
+            return type(x)._wrap(fn(t), getattr(x, "_index", None))
+        if isinstance(x, list):
+            return [walk(v) for v in x]
+        if isinstance(x, tuple):
+            return tuple(walk(v) for v in x)
+        if isinstance(x, dict):
+            return {k: walk(v) for k, v in x.items()}
+        return x
+
+    return walk(out)
 
 
 def _shrink_results(out):
@@ -155,7 +247,6 @@ def _shrink_results(out):
     check, so this costs no extra sync; distributed tables keep their
     shard layout (the mesh contract)."""
     from cylon_tpu.parallel import dtable
-    from cylon_tpu.table import Table
 
     import os
 
@@ -169,21 +260,28 @@ def _shrink_results(out):
         # shrink_to_fit's num_rows read costs no extra device sync
         return t.shrink_to_fit(only_above=0)
 
-    def walk(x):
-        if isinstance(x, Table):
-            return shrink(x)
-        t = getattr(x, "table", None)
-        if isinstance(t, Table) and hasattr(type(x), "_wrap"):
-            return type(x)._wrap(shrink(t), getattr(x, "_index", None))
-        if isinstance(x, list):
-            return [walk(v) for v in x]
-        if isinstance(x, tuple):
-            return tuple(walk(v) for v in x)
-        if isinstance(x, dict):
-            return {k: walk(v) for k, v in x.items()}
-        return x
+    return _map_result_tables(out, shrink)
 
-    return walk(out)
+
+def _apply_buckets(out, buckets):
+    """Device-side: slice each local result table to its memoized
+    power-of-2 bucket capacity (nrows kept — a result that outgrew its
+    bucket reads nrows > capacity on the host, which retries with the
+    observed size). Distributed tables keep their shard layout."""
+    from cylon_tpu.parallel import dtable
+
+    it = iter(buckets)
+
+    def cut(t):
+        b = next(it)
+        if b is None or dtable.is_distributed(t) or b >= t.capacity:
+            return t
+        # with_capacity clamps nrows to the new capacity — restore the
+        # TRUE count so an outgrown bucket reads nrows > capacity on
+        # the host instead of silently truncating the result
+        return t.with_capacity(b).with_nrows(t.nrows)
+
+    return _map_result_tables(out, cut)
 
 
 class CompiledQuery:
@@ -199,8 +297,16 @@ class CompiledQuery:
         self._fn = fn
         self._check = check
         self._scale_memo: dict = {}  # static key -> known-good scale
+        #: static key -> per-result-table pow2 capacity buckets. After
+        #: the first call observes the result sizes, later calls
+        #: compile a variant that emits bucket-sized output buffers —
+        #: so the overflow check's ONE batched transfer also carries
+        #: the (small) result columns and a following to_pandas reads
+        #: host caches: one tunnel round trip per call instead of three
+        self._size_memo: dict = {}
 
-        def traced(scale, static_pos, static_kw, dyn_pos, **dyn_kw):
+        def traced(scale, buckets, static_pos, static_kw, dyn_pos,
+                   **dyn_kw):
             import jax.numpy as jnp
 
             n = len(static_pos) + len(dyn_pos)
@@ -211,38 +317,68 @@ class CompiledQuery:
             with capacity_scale(scale), _collect_flags(flags):
                 out = fn(*(slots[i] for i in range(n)),
                          **dict(static_kw), **dyn_kw)
+            if buckets is not None:
+                out = _apply_buckets(out, buckets)
             bad = functools.reduce(jax.numpy.logical_or, flags,
                                    jnp.zeros((), bool))
             return out, bad
 
-        self._jitted = jax.jit(traced, static_argnums=(0, 1, 2))
+        self._jitted = jax.jit(traced, static_argnums=(0, 1, 2, 3))
 
     def __call__(self, *args, **kwargs):
         import numpy as np
 
+        from cylon_tpu.parallel import dtable
+        from cylon_tpu.utils import pow2_bucket
+
         dyn_pos, static_pos, static_kw, dyn_kw = _split_args(args, kwargs)
         key = (static_pos, static_kw)
         scale = self._scale_memo.get(key, 1)
+        buckets = self._size_memo.get(key) if self._check else None
         while True:
-            out, bad = self._jitted(scale, static_pos, static_kw,
-                                    tuple(dyn_pos), **dyn_kw)
+            out, bad = self._jitted(scale, buckets, static_pos,
+                                    static_kw, tuple(dyn_pos), **dyn_kw)
             if not self._check:
                 return out
             try:
-                # registered flags first (covers scalar-only results and
-                # intermediate poison masked by downstream ops), then the
-                # result-table nrows scan
-                if bool(np.asarray(bad)):
-                    raise OutOfCapacity(
-                        "an op inside the compiled query overflowed its "
-                        "capacity bound")
-                _check_overflow(out)
+                # registered flags (covers scalar-only results and
+                # intermediate poison masked by downstream ops) + the
+                # result-table nrows scan + small result buffers, all
+                # fetched in ONE transfer
+                _check_overflow(out, bad)
             except OutOfCapacity:
+                if buckets is not None and not bool(np.asarray(bad)):
+                    # maybe only the memoized result buckets were
+                    # outgrown — but an UNFLAGGED genuine overflow
+                    # (nrows-poison from a local op, a distributed
+                    # shard bound) reads exactly the same here, so
+                    # re-run unbucketed as ground truth: success
+                    # observes the true sizes; failure falls through
+                    # to the scale ladder on the next iteration
+                    buckets = None
+                    continue
+                # genuine op overflow: regrow the capacity budget
                 if scale >= MAX_SCALE:
                     raise
                 scale *= 2
+                buckets = None
                 continue
             self._scale_memo[key] = scale
+            observed = tuple(
+                None if dtable.is_distributed(t)
+                else pow2_bucket(int(np.asarray(t.nrows)))
+                for t in _result_tables(out))
+            old = self._size_memo.get(key)
+            if old is not None:
+                # widen-only: shrinking the memo would make every
+                # later larger-result call pay a wasted bucketed
+                # dispatch + overflow round trip before widening back
+                observed = tuple(
+                    None if n is None
+                    else (n if o is None else max(o, n))
+                    for o, n in zip(old, observed))
+            if observed != old:
+                self._size_memo[key] = observed
             return _shrink_results(out)
 
 

@@ -221,3 +221,79 @@ def test_eager_local_merge_regrows(rng):
     got = l.merge(r, on="k").to_pandas()
     exp = l.to_pandas().merge(r.to_pandas(), on="k")
     pd.testing.assert_frame_equal(got, exp)  # exact pandas order locally
+
+
+def test_compiled_query_result_bucket_memo(rng):
+    """Second and later calls of a compiled query emit BUCKET-SIZED
+    result buffers (plan._size_memo) so the check's one batched fetch
+    carries the result too; when later data outgrows the memoized
+    bucket, the call transparently re-runs with a wider one."""
+    from cylon_tpu import plan
+
+    def q(t):
+        return groupby_aggregate(t, ["k"], [("v", "sum")])
+
+    c = compile_query(q)
+    small = Table.from_pydict({
+        "k": rng.integers(0, 8, 512).astype(np.int64),
+        "v": rng.normal(size=512)})
+    r1 = c(small)
+    assert r1.num_rows == 8
+    r2 = c(small)                       # bucketed re-run
+    assert r2.capacity <= 1024          # not the input-capacity buffer
+    pd.testing.assert_frame_equal(r1.to_pandas(), r2.to_pandas())
+    # same compiled query, new data with far more groups than the
+    # memoized bucket: must widen and still be exact
+    big = Table.from_pydict({
+        "k": rng.integers(0, 400, 512).astype(np.int64),
+        "v": rng.normal(size=512)})
+    got = c(big).to_pandas().sort_values("k").reset_index(drop=True)
+    want = (pd.DataFrame({"k": np.asarray(big.column("k").data[:512]),
+                          "v": np.asarray(big.column("v").data[:512])})
+            .groupby("k", as_index=False).agg(v_sum=("v", "sum")))
+    assert (got["k"].values == want["k"].values).all()
+    np.testing.assert_allclose(got["v_sum"], want["v_sum"])
+
+
+def test_compiled_query_bucketed_unflagged_overflow_terminates(rng):
+    """An UNFLAGGED genuine overflow (nrows-poison from an explicit
+    out_capacity) arriving AFTER buckets were memoized must raise, not
+    loop: the retry first drops the buckets (ground truth), then walks
+    the scale ladder to the terminal raise."""
+    from cylon_tpu import plan
+
+    def q(l, r):
+        return join(l, r, on="k", how="inner", out_capacity=64)
+
+    c = compile_query(q)
+    n = 48
+    ones = Table.from_pydict({"k": np.arange(n, dtype=np.int64),
+                              "v": rng.normal(size=n)})
+    r1 = c(ones, ones)                 # 1:1 -> fits, memoizes buckets
+    assert r1.num_rows == n
+    assert c._size_memo
+    r1b = c(ones, ones)                # bucketed path exercised
+    assert r1b.num_rows == n
+    dup = Table.from_pydict({"k": np.zeros(n, np.int64),
+                             "v": rng.normal(size=n)})
+    with pytest.raises(OutOfCapacity):
+        c(dup, dup)                    # 48x48 >> 64, capacity explicit
+
+
+def test_compiled_query_bucket_memo_widen_only(rng):
+    """A smaller result must not shrink the memoized buckets — big
+    calls after small ones would otherwise always pay a wasted
+    bucketed dispatch + overflow retry."""
+    def q(t):
+        return groupby_aggregate(t, ["k"], [("v", "sum")])
+
+    c = compile_query(q)
+    big = Table.from_pydict({"k": rng.integers(0, 300, 512).astype(np.int64),
+                             "v": rng.normal(size=512)})
+    small = Table.from_pydict({"k": rng.integers(0, 4, 512).astype(np.int64),
+                               "v": rng.normal(size=512)})
+    nb = c(big).num_rows
+    wide = next(iter(c._size_memo.values()))
+    assert c(small).num_rows <= 4
+    assert next(iter(c._size_memo.values())) == wide  # not shrunk
+    assert c(big).num_rows == nb                       # still exact
