@@ -614,9 +614,27 @@ class DataFrame:
         return self.where(inv, other)
 
     def equals(self, other: "DataFrame") -> bool:
-        """Exact frame equality (schema + values; NaN == NaN)."""
-        a, b = self.to_pandas(), other.to_pandas()
-        return bool(a.equals(b))
+        """Exact frame equality (schema + values; NaN == NaN).
+
+        Runs device-side (``ops.setops.equal_tables(ordered=True)`` —
+        one fused compare + one scalar fetch) instead of materialising
+        both frames; frames carrying a value index keep the pandas
+        path, since the index participates in pandas equality."""
+        if not isinstance(other, DataFrame):
+            return False
+        if self._index is not None or other._index is not None:
+            a, b = self.to_pandas(), other.to_pandas()
+            return bool(a.equals(b))
+        from cylon_tpu.ops.setops import equal_tables
+        from cylon_tpu.parallel import dtable
+
+        ta = dtable.gather_table(None, self._table)
+        tb = dtable.gather_table(None, other._table)
+        for n in ta.column_names:
+            if n not in tb.column_names or \
+                    ta.column(n).dtype != tb.column(n).dtype:
+                return False
+        return equal_tables(ta, tb, ordered=True)
 
     def isin(self, values: Sequence) -> "DataFrame":
         """Parity: frame.py isin (membership per element)."""

@@ -168,19 +168,57 @@ def equal_tables(a: Table, b: Table, ordered: bool = False) -> bool:
     """Row equality — the test oracle role of ``cpp/test/test_utils.hpp:
     36-60`` Verify (which only checks counts + set-subtract; this is
     stricter). Multiset-exact when ``ordered`` is False (per-row-value
-    multiplicities must match), positional when True."""
+    multiplicities must match), positional when True.
+
+    The ordered compare runs DEVICE-SIDE as one fused program + a
+    single scalar fetch (NaN == NaN, both-null == both-null via the
+    order-key canonicalisation) — materialising both tables costs two
+    full host transfers on a tunneled device."""
     if a.column_names != b.column_names:
-        return False
-    if a.num_rows != b.num_rows:
         return False
     if ordered:
         import numpy as np
 
+        from cylon_tpu.errors import OutOfCapacity
+        from cylon_tpu.ops.dictenc import unify_dictionaries
+
         for n in a.column_names:
-            x = a.column(n).to_numpy(a.num_rows)
-            y = b.column(n).to_numpy(b.num_rows)
-            if not np.array_equal(x, y):
+            ca, cb = a.column(n), b.column(n)
+            if ca.dtype.is_dictionary != cb.dtype.is_dictionary:
                 return False
-        return True
+            if ca.dtype.is_dictionary and ca.dictionary != cb.dictionary:
+                ca, cb = unify_dictionaries([ca, cb])
+                a = a.add_column(n, ca)
+                b = b.add_column(n, cb)
+        # counts + poison + the fused compare in ONE batched transfer
+        # (count equality is folded into the compiled program too)
+        na, nb, eq = jax.device_get(
+            [a.nrows, b.nrows, _ordered_equal_compiled(a, b)])
+        for t, n in ((a, na), (b, nb)):
+            if int(n) > t.capacity:
+                raise OutOfCapacity(
+                    f"table rows {int(n)} exceed capacity {t.capacity}")
+        return bool(eq)
+    if a.num_rows != b.num_rows:
+        return False
     _, _, _, cnt_a, cnt_b, _ = _two_table_gids(a, b, None)
     return bool((cnt_a == cnt_b).all())
+
+
+@platform_jit
+def _ordered_equal_compiled(a: Table, b: Table):
+    m = min(a.capacity, b.capacity)   # valid rows fit both prefixes
+    mask = kernels.valid_mask(m, jnp.minimum(a.nrows, m))
+    eq = a.nrows == b.nrows
+    for n in a.column_names:
+        ca, cb = a.column(n), b.column(n)
+        ka = kernels.order_key(ca.data[:m])
+        kb = kernels.order_key(cb.data[:m])
+        va = (jnp.ones(m, bool) if ca.validity is None
+              else ca.validity[:m])
+        vb = (jnp.ones(m, bool) if cb.validity is None
+              else cb.validity[:m])
+        same = (va == vb) & (~va | (ka == kb).reshape(
+            (m, -1)).all(axis=1))
+        eq = eq & jnp.where(mask, same, True).all()
+    return eq

@@ -61,25 +61,42 @@ def all_reduce(x, op: ReduceOp | str = ReduceOp.SUM,
     if op == ReduceOp.MAX:
         return jax.lax.pmax(x, axis_name)
     if op == ReduceOp.PROD:
-        # no pprod primitive: log-sum-exp style via all_gather product
-        return jax.lax.all_gather(x, axis_name).prod(axis=0)
+        return _tree_reduce(x, axis_name, jnp.multiply)
     if op in (ReduceOp.LAND, ReduceOp.BAND):
         return jax.lax.all_gather(x, axis_name).all(axis=0) \
             if op == ReduceOp.LAND \
-            else _fold_gather(x, axis_name, jnp.bitwise_and)
+            else _tree_reduce(x, axis_name, jnp.bitwise_and)
     if op in (ReduceOp.LOR, ReduceOp.BOR):
         return jax.lax.all_gather(x, axis_name).any(axis=0) \
             if op == ReduceOp.LOR \
-            else _fold_gather(x, axis_name, jnp.bitwise_or)
+            else _tree_reduce(x, axis_name, jnp.bitwise_or)
     raise ValueError(op)
 
 
-def _fold_gather(x, axis_name, fn):
-    g = jax.lax.all_gather(x, axis_name)
-    out = g[0]
-    for i in range(1, g.shape[0]):
-        out = fn(out, g[i])
-    return out
+def _tree_reduce(x, axis_name, fn):
+    """All-reduce for ops XLA has no primitive for (prod, bitwise):
+    a log2(W) recursive-doubling butterfly over ``ppermute`` when every
+    axis size is a power of two, otherwise one all_gather + an O(W)
+    fold (the former O(W)-fold-only path — fine for small worlds, W
+    unrolled program ops for large ones)."""
+    names = axis_name if isinstance(axis_name, (tuple, list)) \
+        else (axis_name,)
+    sizes = [jax.lax.axis_size(n) for n in names]
+    if any(s & (s - 1) for s in sizes):
+        g = jax.lax.all_gather(x, axis_name)
+        out = g[0]
+        for i in range(1, g.shape[0]):
+            out = fn(out, g[i])
+        return out
+    # butterfly per axis: combining fully over one axis then the next
+    # reduces over the full product world
+    for n, s in zip(names, sizes):
+        step = 1
+        while step < s:
+            perm = [(i, i ^ step) for i in range(s)]
+            x = fn(x, jax.lax.ppermute(x, n, perm))
+            step <<= 1
+    return x
 
 
 def rank(axis_name=None):

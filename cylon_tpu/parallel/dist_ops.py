@@ -615,8 +615,12 @@ def dist_sort(env: CylonEnv, table: Table, by: Sequence[str] | str,
     ``searchsorted`` — same statistical guarantees, one collective.
 
     Globally sorted result: shard s holds the s-th key range; equal
-    first-key values never straddle shards, so multi-column lexorder
-    holds globally."""
+    first-key values never straddle shards for MULTI-key sorts, so the
+    lower-priority columns' lexorder holds globally; single-key sorts
+    salt the ranges with the local row index instead, so a dominant
+    key value load-balances across consecutive shards (the reference
+    ships the whole hot key to one rank) while the global key order is
+    unchanged."""
     by = [by] if isinstance(by, str) else list(by)
     if isinstance(ascending, bool):
         asc0 = ascending
@@ -693,13 +697,43 @@ def _sort_body(env, table, by, asc0, asc, nsamp, nbins, out_l, w):
             samples = jnp.where(n > 0, sk[take_i],
                                 jnp.asarray(dtypes.sentinel_high(key.dtype),
                                             key.dtype))
-            allsamp = jax.lax.all_gather(samples, ax).reshape(-1)
-            allsamp = jnp.sort(allsamp)
-            tot = allsamp.shape[0]
-            cut = (jnp.arange(1, w, dtype=jnp.int32) * tot) // w
-            splitters = allsamp[cut]
-            pid = jnp.searchsorted(splitters, key,
-                                   side="left").astype(jnp.int32)
+            if len(by) == 1:
+                # SALTED ranges: splitters are (key, local-row) PAIRS,
+                # so a dominant key value splits across adjacent shards
+                # instead of landing whole on one (the reference — and
+                # r2 here — shipped the whole hot key to one rank and
+                # leaned on memory headroom, SortOptions semantics of
+                # arrow_partition_kernels.cpp:334-421). Sound for
+                # single-key sorts only: the salt ranks below the key,
+                # and there are no lower-priority sort columns whose
+                # cross-shard order it could scramble. Global key
+                # order still holds — equal keys occupy consecutive
+                # shards.
+                salt = jnp.arange(cap_l, dtype=jnp.uint32)
+                ssamp = jnp.where(n > 0, perm[take_i].astype(jnp.uint32),
+                                  jnp.uint32(0xFFFFFFFF))
+                ak = jax.lax.all_gather(samples, ax).reshape(-1)
+                asalt = jax.lax.all_gather(ssamp, ax).reshape(-1)
+                ak, asalt = jax.lax.sort((ak, asalt), num_keys=2)
+                tot = ak.shape[0]
+                cut = (jnp.arange(1, w, dtype=jnp.int32) * tot) // w
+                spk, sps = ak[cut], asalt[cut]
+                # pid = #splitter-pairs lexicographically < (key, salt)
+                less = (spk[:, None] < key[None, :]) | (
+                    (spk[:, None] == key[None, :])
+                    & (sps[:, None] < salt[None, :]))
+                pid = less.sum(axis=0, dtype=jnp.int32)
+            else:
+                # multi-key: equal FIRST-key rows must stay together —
+                # lower-priority sort columns order across shards only
+                # because ranges never split a first-key value
+                allsamp = jax.lax.all_gather(samples, ax).reshape(-1)
+                allsamp = jnp.sort(allsamp)
+                tot = allsamp.shape[0]
+                cut = (jnp.arange(1, w, dtype=jnp.int32) * tot) // w
+                splitters = allsamp[cut]
+                pid = jnp.searchsorted(splitters, key,
+                                       side="left").astype(jnp.int32)
         sh, of = checked_recv(shuffle_local(lt, pid, out_l, axis_name=ax),
                               out_l)
         return _shard_view(poison(_sort_table(sh, by, ascending=asc),

@@ -377,7 +377,10 @@ class CompiledQuery:
                     None if n is None
                     else (n if o is None else max(o, n))
                     for o, n in zip(old, observed))
-            if observed != old:
+            if observed != old and any(b is not None for b in observed):
+                # all-None/empty buckets (scalar-only or distributed
+                # results) would recompile an identical program for a
+                # no-op _apply_buckets — leave the memo unset
                 self._size_memo[key] = observed
             return _shrink_results(out)
 

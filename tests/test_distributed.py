@@ -396,3 +396,34 @@ def test_transport_64bit_split_roundtrip():
     # off-TPU: native dtypes pass through untouched
     parts, restore = _transportable(jnp.asarray(np.arange(4, dtype=np.int64)))
     assert len(parts) == 1 and parts[0].dtype == jnp.int64
+
+
+def test_dist_sort_hot_key_balances(env8, rng):
+    """90% of rows share one key: salted single-key ranges must spread
+    the hot value over shards (the reference ships it whole to one
+    rank) while the output stays globally sorted."""
+    n = 4096
+    k = np.where(rng.random(n) < 0.9, 42,
+                 rng.integers(0, 10_000, n)).astype(np.int64)
+    dt = scatter_table(env8, Table.from_pydict({"k": k}))
+    s = dist_sort(env8, dt, "k")
+    counts = np.asarray(s.nrows)
+    assert counts.sum() == n
+    # balanced: no shard holds more than ~2x the fair share (the hot
+    # key alone is 0.9n — unsalted it all lands on one shard)
+    assert counts.max() <= 2 * n // env8.world_size, counts.tolist()
+    got = dist_to_pandas(env8, s)["k"].values
+    assert (got == np.sort(k)).all()
+
+
+def test_dist_sort_multikey_keeps_cohorts(env8, rng):
+    """Multi-key sorts keep equal first-key rows on one shard (their
+    secondary order must hold across shards) and stay pandas-exact."""
+    n = 1000
+    df = pd.DataFrame({"a": rng.integers(0, 12, n),
+                       "b": rng.normal(size=n)})
+    dt = scatter_table(env8, Table.from_pandas(df))
+    s = dist_sort(env8, dt, ["a", "b"])
+    got = dist_to_pandas(env8, s).reset_index(drop=True)
+    want = df.sort_values(["a", "b"]).reset_index(drop=True)
+    pd.testing.assert_frame_equal(got, want, check_dtype=False)

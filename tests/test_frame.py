@@ -179,3 +179,34 @@ def test_drop_duplicates_keep_last_distributed(env8):
                              out_capacity=24).to_pandas()
     got = got.sort_values("k").reset_index(drop=True)
     assert got["v"].tolist() == [20, 30]
+
+
+def test_equals_device_side(env8, rng):
+    """DataFrame.equals runs on-device (no pandas round trip): exact on
+    values incl. NaN == NaN and nulls; False on any difference in
+    schema, dtype, order, or values; distributed frames gather first."""
+    import numpy as np
+
+    df = pd.DataFrame({"k": rng.integers(0, 9, 50),
+                       "v": rng.normal(size=50),
+                       "s": rng.choice(["a", "b", None], 50)})
+    df.loc[3, "v"] = np.nan
+    a = DataFrame(df)
+    b = DataFrame(df.copy())
+    assert a.equals(b)
+    assert not a.equals(DataFrame(df.rename(columns={"v": "w"})))
+    df2 = df.copy()
+    df2.loc[7, "v"] += 1.0
+    assert not a.equals(DataFrame(df2))
+    df3 = df.copy()
+    df3.loc[2, "s"] = None
+    assert not a.equals(DataFrame(df3))
+    assert not a.equals(DataFrame(df.astype({"k": np.int32})))
+    assert not a.equals(DataFrame(df.iloc[:40]))
+    # distributed layout gathers then compares
+    from cylon_tpu.parallel import scatter_table
+
+    d = DataFrame._wrap(scatter_table(env8, a.table))
+    assert d.equals(b)
+    # matches pandas' own verdicts on the same inputs
+    assert df.equals(df.copy()) == a.equals(b)

@@ -165,16 +165,20 @@ def test_collectives_default_spans_hierarchical_world(henv, env8):
             r = rank()
             w = jnp.int32(world())
             s = all_reduce(x.sum())
-            return r[None], w[None], s[None]
+            p = all_reduce(x.sum() + 1, "prod")       # ppermute butterfly
+            bo = all_reduce(jnp.int32(1) << (r % 8), "bor")
+            return r[None], w[None], s[None], p[None], bo[None]
 
         x = jnp.ones(env.world_size, jnp.int32)
         spec = P(env.world_axes)
-        ranks, ws, sums = jax.jit(jax.shard_map(
+        ranks, ws, sums, prods, bors = jax.jit(jax.shard_map(
             body, mesh=env.mesh, in_specs=(spec,),
-            out_specs=(spec, spec, spec)))(x)
+            out_specs=(spec,) * 5))(x)
         assert np.asarray(ranks).tolist() == list(range(env.world_size))
         assert np.asarray(ws).tolist() == [env.world_size] * env.world_size
         assert np.asarray(sums).tolist() == [env.world_size] * env.world_size
+        assert np.asarray(prods).tolist() == [2 ** env.world_size] * env.world_size
+        assert np.asarray(bors).tolist() == [255] * env.world_size
 
 
 def test_hier_compiled_query(henv, rng):
