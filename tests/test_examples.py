@@ -13,7 +13,8 @@ _EX = os.path.join(os.path.dirname(os.path.dirname(
 
 @pytest.mark.parametrize("script", ["dataframe_ops.py", "catalog_ffi.py",
                                     "op_graph.py", "distributed_join.py",
-                                    "tpch_demo.py", "whole_query.py"])
+                                    "tpch_demo.py", "whole_query.py",
+                                    "scale_out.py"])
 def test_example_runs(script):
     env = dict(os.environ)
     env.pop("CYLON_EXAMPLES_TPU", None)

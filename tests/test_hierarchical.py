@@ -181,6 +181,29 @@ def test_collectives_default_spans_hierarchical_world(henv, env8):
         assert np.asarray(bors).tolist() == [255] * env.world_size
 
 
+def test_hier_streaming_graph(henv, rng):
+    """The streaming op-graph's per-chunk mesh exchange rides the
+    two-stage hierarchical shuffle transparently."""
+    from cylon_tpu.ops_graph import DisJoinOp
+    from cylon_tpu.ops_graph.graph import chunk_stream
+
+    n = 1200
+    lp = pd.DataFrame({"k": rng.integers(0, 60, n), "a": rng.normal(size=n)})
+    rp = pd.DataFrame({"k": rng.integers(0, 60, n), "b": rng.normal(size=n)})
+    g = DisJoinOp("k", how="inner", env=henv)
+    for c in chunk_stream(Table.from_pandas(lp), 256):
+        g.insert_left(c)
+    for c in chunk_stream(Table.from_pandas(rp), 256):
+        g.insert_right(c)
+    got = dist_to_pandas(henv, g.result())
+    want = lp.merge(rp, on="k")
+    cols = ["k", "a", "b"]
+    pd.testing.assert_frame_equal(
+        got[cols].sort_values(cols).reset_index(drop=True),
+        want[cols].sort_values(cols).reset_index(drop=True),
+        check_dtype=False)
+
+
 def test_hier_compiled_query(henv, rng):
     """Whole-query compilation traces through the two-stage exchange."""
     from cylon_tpu import plan
