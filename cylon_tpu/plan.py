@@ -305,8 +305,7 @@ class CompiledQuery:
         #: host caches: one tunnel round trip per call instead of three
         self._size_memo: dict = {}
 
-        def traced(scale, buckets, static_pos, static_kw, dyn_pos,
-                   **dyn_kw):
+        def traced(scale, static_pos, static_kw, dyn_pos, **dyn_kw):
             import jax.numpy as jnp
 
             n = len(static_pos) + len(dyn_pos)
@@ -317,13 +316,19 @@ class CompiledQuery:
             with capacity_scale(scale), _collect_flags(flags):
                 out = fn(*(slots[i] for i in range(n)),
                          **dict(static_kw), **dyn_kw)
-            if buckets is not None:
-                out = _apply_buckets(out, buckets)
             bad = functools.reduce(jax.numpy.logical_or, flags,
                                    jnp.zeros((), bool))
             return out, bad
 
-        self._jitted = jax.jit(traced, static_argnums=(0, 1, 2, 3))
+        self._jitted = jax.jit(traced, static_argnums=(0, 1, 2))
+        # the bucket slice is a SEPARATE tiny program composed after
+        # the main one (an extra async dispatch, ~free): folding it
+        # into `traced` would recompile the whole query — minutes of
+        # XLA time for a big TPC-H program — the first time its result
+        # sizes are known
+        self._slicer = jax.jit(
+            lambda buckets, out: _apply_buckets(out, buckets),
+            static_argnums=0)
 
     def __call__(self, *args, **kwargs):
         import numpy as np
@@ -336,33 +341,42 @@ class CompiledQuery:
         scale = self._scale_memo.get(key, 1)
         buckets = self._size_memo.get(key) if self._check else None
         while True:
-            out, bad = self._jitted(scale, buckets, static_pos,
-                                    static_kw, tuple(dyn_pos), **dyn_kw)
+            raw, bad = self._jitted(scale, static_pos, static_kw,
+                                    tuple(dyn_pos), **dyn_kw)
             if not self._check:
-                return out
+                return raw
+            out = self._slicer(buckets, raw) if buckets is not None \
+                else raw
             try:
                 # registered flags (covers scalar-only results and
                 # intermediate poison masked by downstream ops) + the
                 # result-table nrows scan + small result buffers, all
                 # fetched in ONE transfer
                 _check_overflow(out, bad)
-            except OutOfCapacity:
+            except OutOfCapacity as err:
                 if buckets is not None and not bool(np.asarray(bad)):
                     # maybe only the memoized result buckets were
                     # outgrown — but an UNFLAGGED genuine overflow
                     # (nrows-poison from a local op, a distributed
-                    # shard bound) reads exactly the same here, so
-                    # re-run unbucketed as ground truth: success
-                    # observes the true sizes; failure falls through
-                    # to the scale ladder on the next iteration
+                    # shard bound) reads exactly the same here. The
+                    # UNBUCKETED ground truth is already in hand (the
+                    # slicer is post-hoc): check it directly, no
+                    # re-dispatch
                     buckets = None
+                    try:
+                        _check_overflow(raw, bad)
+                        out = raw
+                    except OutOfCapacity as err2:
+                        err = err2
+                        out = None
+                else:
+                    out = None
+                if out is None:
+                    # genuine op overflow: regrow the capacity budget
+                    if scale >= MAX_SCALE:
+                        raise err
+                    scale *= 2
                     continue
-                # genuine op overflow: regrow the capacity budget
-                if scale >= MAX_SCALE:
-                    raise
-                scale *= 2
-                buckets = None
-                continue
             self._scale_memo[key] = scale
             observed = tuple(
                 None if dtable.is_distributed(t)
