@@ -284,12 +284,14 @@ def _aggregate_scan(stab: Table, orig_table: Table, by, specs, gid_s,
             plans.append((name, lambda o, i=i, c=c: Column(
                 o[i][0], gvalid & o[i][1], c.dtype, c.dictionary)))
         elif op == "nunique":
-            plans.append((name, functools.partial(
-                _nunique_scan, stab, src, gid_s, gvalid, out_cap)))
+            plans.append((name, lambda _o, a=(stab, src, gid_s, gvalid,
+                                              out_cap):
+                          _nunique_scan(*a)))
         elif op in ("median", "quantile"):
             qq = 0.5 if op == "median" else q
-            plans.append((name, functools.partial(
-                _quantile_scan, stab, src, gid_s, gvalid, out_cap, qq)))
+            plans.append((name, lambda _o, a=(stab, src, gid_s, gvalid,
+                                              out_cap, qq):
+                          _quantile_scan(*a)))
         else:  # pragma: no cover — specs pre-validated
             raise InvalidArgument(f"unhandled aggregation {op!r}")
 
@@ -307,7 +309,7 @@ def _aggregate_scan(stab: Table, orig_table: Table, by, specs, gid_s,
     return out
 
 
-def _nunique_scan(stab, src, gid_s, gvalid, out_cap: int, _o=None) -> Column:
+def _nunique_scan(stab, src, gid_s, gvalid, out_cap: int) -> Column:
     """nunique on the scan path: sort rows by (gid, null-last, value),
     count per-group value-run starts via scan+compact."""
     c = stab.column(src)
@@ -332,8 +334,8 @@ def _nunique_scan(stab, src, gid_s, gvalid, out_cap: int, _o=None) -> Column:
     return Column(outputs[0][0].astype(jnp.int64), None, dtypes.int64)
 
 
-def _quantile_scan(stab, src, gid_s, gvalid, out_cap: int, q: float,
-                   _o=None) -> Column:
+def _quantile_scan(stab, src, gid_s, gvalid, out_cap: int,
+                   q: float) -> Column:
     """Per-group quantile on the scan path: one (gid, null-last, value)
     sort; group sizes and non-null counts via scan+compact; two
     [out_cap]-row gathers pick the interpolation endpoints."""

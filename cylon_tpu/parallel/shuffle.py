@@ -227,7 +227,7 @@ def _exchange_padded_chunked(arrays, pid_sorted, order, n_recv_true,
     recv_mat = cmat_rounds[:, :, me]                        # [W, C]
     # final offset of (sender s, round c)'s first row on this shard
     sender_tot = recv_mat.sum(axis=1)
-    base = jnp.cumsum(sender_tot) - sender_tot              # [W]
+    base = kernels.exclusive_cumsum(sender_tot)             # [W]
     already = jnp.cumsum(recv_mat, axis=1) - recv_mat       # [W, C]
     row_base = base[:, None] + already                      # [W, C]
 
@@ -252,7 +252,7 @@ def _exchange_padded_chunked(arrays, pid_sorted, order, n_recv_true,
         sl = slice(c * b, (c + 1) * b)
         pidc = pid_pad[sl]
         countsc = counts_cd[c]
-        startc = jnp.cumsum(countsc) - countsc
+        startc = kernels.exclusive_cumsum(countsc)
         pidc_safe = jnp.clip(pidc, 0, w - 1)
         within = jnp.arange(b, dtype=jnp.int32) - startc[pidc_safe]
         slot = jnp.where(pidc < w, pidc_safe * b + within, w * b)
