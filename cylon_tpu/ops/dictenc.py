@@ -31,14 +31,43 @@ def unify_dictionaries(cols: list[Column]) -> list[Column]:
     # need no remap
     if first is not None and all(d == first for d in dicts):
         return cols
-    merged = np.unique(np.concatenate([d.values for d in dicts]))
+    # ONE factorize merges + remaps: uniques come back in pandas
+    # safe-sorted order — the same ordering ingest uses
+    # (column.from_numpy's factorize(sort=True)) — which, unlike
+    # np.unique/searchsorted, also handles mixed-type object values
+    # (e.g. an int column that picked up Nones and ingested as a
+    # dictionary of ints + the "" null placeholder)
+    import pandas as pd
+
+    vals = [(d.values if d is not None else np.asarray([], object))
+            for d in dicts]
+    # use_na_sentinel=False: a NaN dictionary VALUE (reachable via
+    # Series.map producing NaN) must stay a real code — the default -1
+    # sentinel would wrap on the next gather and read as another value
+    flat_codes, merged = pd.factorize(np.concatenate(vals), sort=True,
+                                      use_na_sentinel=False)
+    merged = np.asarray(merged, dtype=object)
+    flat_codes = np.asarray(flat_codes)
+    na = np.asarray(pd.isna(merged))
+    if na.any():
+        # keep the order-preserving invariant (code order == value
+        # order, NA last — where ingest's "" placeholder and np.unique
+        # both rank missing)
+        order = np.concatenate([np.flatnonzero(~na), np.flatnonzero(na)])
+        inv = np.empty(len(order), np.int64)
+        inv[order] = np.arange(len(order))
+        merged = merged[order]
+        flat_codes = inv[flat_codes]
     shared = Dictionary(merged)
+    offsets = np.cumsum([0] + [len(v) for v in vals])
     out = []
+    di = 0
     for c in cols:
         if not c.dtype.is_dictionary:
             out.append(c)
             continue
-        remap = np.searchsorted(merged, c.dictionary.values).astype(np.int32)
+        remap = flat_codes[offsets[di]:offsets[di + 1]].astype(np.int32)
+        di += 1
         if len(remap):
             codes = jnp.asarray(remap)[jnp.clip(c.data, 0, len(remap) - 1)]
         else:
