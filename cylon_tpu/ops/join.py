@@ -224,8 +224,8 @@ def _join_indices(lkeys, lvals, lrows, rkeys, rvals, rrows, how, out_cap,
     is_end = valid_s & (jnp.roll(boundary, -1) | ~jnp.roll(valid_s, -1)
                         | (iota_c == ncomb - 1))
 
-    cum_r = jnp.cumsum(is_r.astype(jnp.int32))
-    cum_l = jnp.cumsum(is_l.astype(jnp.int32))
+    cum_r = kernels.fast_cumsum(is_r.astype(jnp.int32))
+    cum_l = kernels.fast_cumsum(is_l.astype(jnp.int32))
     s_g = kernels.forward_fill(boundary, iota_c)
     rb = kernels.forward_fill(boundary, cum_r - is_r)
     lb = kernels.forward_fill(boundary, cum_l - is_l)
@@ -251,7 +251,7 @@ def _join_indices(lkeys, lvals, lrows, rkeys, rvals, rrows, how, out_cap,
     start = jnp.where(ecounts > 0, offs, out_cap).astype(jnp.int32)
     mark = jnp.full(out_cap, -1, jnp.int32).at[start].max(iota_c,
                                                           mode="drop")
-    parent = jnp.clip(jax.lax.cummax(mark), 0, max(ncomb - 1, 0))
+    parent = jnp.clip(kernels.fast_cummax(mark), 0, max(ncomb - 1, 0))
     # the order-key gid column rides the packed gather only when the
     # fullouter restore needs it (gathers are priced ~10x elementwise)
     pcols = [offs.astype(jnp.int32), match_counts, right_start, orig_s]

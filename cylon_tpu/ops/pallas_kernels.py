@@ -24,6 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 # re-exported: dist ops wrap tracing in on_platform(mesh platform)
 from cylon_tpu.platform import current_platform, on_platform
@@ -213,3 +214,90 @@ def segment_sum_ok(num_segments: int) -> bool:
     """Policy gate: MXU path wins only while the dense one-hot traffic
     stays below the sort-based lowering's."""
     return enabled() and num_segments <= SEGSUM_MAX_GROUPS
+
+
+# ------------------------------------------------------------------ scan
+#: lanes per scan tile; tile = 8 x _SCAN_LANES elements, VMEM-resident
+_SCAN_LANES = 2048
+
+def _scan_ident(kind: str, dtype):
+    """Identity element: 0 for add; the dtype's minimum for max."""
+    if kind == "add":
+        return np.zeros((), dtype)[()]
+    if jnp.issubdtype(dtype, jnp.floating):
+        return np.array(-np.inf, dtype)[()]
+    return np.iinfo(dtype).min
+
+
+def _scan_kernel(kind: str, L: int, ident, x_ref, out_ref, carry_ref):
+    """Per-ROW inclusive scan of one [8, L] tile + a running [8, 1]
+    carry: Hillis-Steele along lanes only (Mosaic has no sublane
+    shifts); each sublane scans an independent 1/8th of the array, and
+    the tiny cross-row combine happens outside the kernel in XLA. ONE
+    pass over HBM vs the ~log n passes of XLA's reduce-window lowering
+    (measured 3.7 ms -> sub-ms for a 2M i32 cumsum)."""
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        carry_ref[...] = jnp.full_like(carry_ref, ident)
+
+    def op(a, b):
+        return a + b if kind == "add" else jnp.maximum(a, b)
+
+    x = x_ref[...]
+    idf = jnp.asarray(ident, x.dtype)
+    sh = 1
+    while sh < L:
+        shifted = jnp.concatenate(
+            [jnp.full((x.shape[0], sh), idf, x.dtype), x[:, :-sh]], axis=1)
+        x = op(x, shifted)
+        sh *= 2
+    x = op(x, carry_ref[...])
+    out_ref[...] = x
+    carry_ref[...] = x[:, L - 1:L]
+
+
+@functools.partial(jax.jit, static_argnames=("kind", "interpret"))
+def _scan32_impl(x: jax.Array, kind: str, interpret: bool) -> jax.Array:
+    n = x.shape[0]
+    r, L = _SUBLANES, _SCAN_LANES
+    ident = _scan_ident(kind, x.dtype)
+    per_row = -(-n // r)
+    m = max(-(-per_row // L), 1) * L         # lanes per row, L-padded
+    npad = r * m
+    # GLOBAL row-major split: sublane j scans rows [j*m, (j+1)*m)
+    xp = _pad_to(x, npad, ident).reshape(r, m)
+    with jax.enable_x64(False):
+        out = pl.pallas_call(
+            functools.partial(_scan_kernel, kind, L, ident),
+            grid=(m // L,),
+            in_specs=[pl.BlockSpec((r, L), lambda i: (0, i))],
+            out_specs=pl.BlockSpec((r, L), lambda i: (0, i)),
+            out_shape=_out_struct((r, m), x.dtype, xp),
+            scratch_shapes=[pltpu.VMEM((r, 1), x.dtype)],
+            interpret=interpret,
+        )(xp)
+    # cross-row combine: 8 row totals, exclusive-scanned in XLA
+    tot = out[:, -1]
+    if kind == "add":
+        excl = jnp.cumsum(tot) - tot
+        out = out + excl[:, None]
+    else:
+        excl = jax.lax.cummax(tot)
+        excl = jnp.concatenate([jnp.full((1,), ident, x.dtype), excl[:-1]])
+        out = jnp.maximum(out, excl[:, None])
+    return out.reshape(npad)[:n]
+
+
+def scan32(x: jax.Array, kind: str) -> jax.Array:
+    """Inclusive 1-D scan ("add" or "max") for 32-bit dtypes — the
+    replacement for ``jnp.cumsum``/``lax.cummax`` on the TPU hot paths
+    (join run-length expansion, fill broadcasts, group boundaries).
+    Callers gate on :func:`scan32_ok`."""
+    return _scan32_impl(x, kind, _interpret())
+
+
+def scan32_ok(x) -> bool:
+    return (x.ndim == 1 and x.dtype.itemsize == 4
+            and x.dtype != jnp.bool_ and usable_for(x))

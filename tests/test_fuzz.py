@@ -77,6 +77,8 @@ def test_fuzz_join_groupby_sort(env8, henv, seed):
         np.testing.assert_allclose(
             gs["f_sum"].astype(float), ws["f_sum"].astype(float))
         assert (gs["f_count"].values == ws["f_count"].values).all()
+        assert (gs["i_max"].astype(np.int64).values
+                == ws["i_max"].astype(np.int64).values).all()
 
         got = dist_to_pandas(env, dist_sort(env, lt, "i"))
         assert (got["i"].values == np.sort(lp["i"].values)).all()

@@ -99,3 +99,57 @@ def test_segment_sum_multiblock(rng, pallas_interpret):
     want = jax.ops.segment_sum(vals, gid, num_segments=segs)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("kind,np_ref", [
+    ("add", np.cumsum),
+    ("max", np.maximum.accumulate),
+])
+def test_scan32_parity(rng, pallas_interpret, kind, np_ref):
+    for n in (3, 8192, 16384 + 7, 60_001):
+        for dt in (np.int32, np.uint32, np.float32):
+            lo = 0 if dt == np.uint32 else -100
+            x = rng.integers(lo, 100, n).astype(dt) if dt != np.float32 \
+                else rng.normal(size=n).astype(np.float32)
+            got = np.asarray(pk.scan32(jnp.asarray(x), kind))
+            if dt == np.float32 and kind == "add":
+                # tile-wise association differs from sequential order;
+                # compare against the exact (f64) prefix sums with a
+                # reassociation-sized tolerance
+                want64 = np.cumsum(x.astype(np.float64))
+                tol = np.abs(x).sum() * 1e-6 + 1e-4
+                np.testing.assert_allclose(got, want64, atol=tol)
+            else:
+                np.testing.assert_array_equal(got, np_ref(x).astype(dt))
+
+
+def test_fast_cumsum_cummax_fallback(rng):
+    """Off-TPU (no interpret), fast_* must be the plain XLA ops."""
+    from cylon_tpu.ops import kernels
+
+    x = jnp.asarray(rng.integers(-50, 50, 999), jnp.int32)
+    np.testing.assert_array_equal(np.asarray(kernels.fast_cumsum(x)),
+                                  np.cumsum(np.asarray(x)))
+    np.testing.assert_array_equal(np.asarray(kernels.fast_cummax(x)),
+                                  np.maximum.accumulate(np.asarray(x)))
+
+
+def test_join_parity_with_scan_kernel(rng, pallas_interpret):
+    """The join's expansion scans ride scan32 under interpret mode —
+    results must match the pure-XLA path exactly."""
+    import pandas as pd
+
+    from cylon_tpu import Table
+    from cylon_tpu.ops.join import join
+
+    n = 500
+    lp = pd.DataFrame({"k": rng.integers(0, 40, n), "a": rng.normal(size=n)})
+    rp = pd.DataFrame({"k": rng.integers(0, 40, n), "b": rng.normal(size=n)})
+    got = join(Table.from_pandas(lp), Table.from_pandas(rp), on="k",
+               how="inner", out_capacity=16 * n).to_pandas()
+    want = lp.merge(rp, on="k")
+    cols = ["k", "a", "b"]
+    pd.testing.assert_frame_equal(
+        got[cols].sort_values(cols).reset_index(drop=True),
+        want[cols].sort_values(cols).reset_index(drop=True),
+        check_dtype=False)
