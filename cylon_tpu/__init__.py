@@ -79,7 +79,7 @@ from cylon_tpu.table import Table
 from cylon_tpu.series import Series
 from cylon_tpu.frame import DataFrame, GroupByDataFrame, concat, merge, read_csv
 from cylon_tpu.io import (read_csv_chunks, read_csv_sharded,
-                          read_parquet_chunks)
+                          read_parquet_chunks, write_csv_sharded)
 from cylon_tpu.indexing import IndexingType
 
 __version__ = "0.1.0"
@@ -115,4 +115,5 @@ __all__ = [
     "read_csv_chunks",
     "read_csv_sharded",
     "read_parquet_chunks",
+    "write_csv_sharded",
 ]

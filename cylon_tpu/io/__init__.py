@@ -487,6 +487,73 @@ def write_csv(df, path, options: CSVWriteOptions | None = None):
                header=options.include_header)
 
 
+def write_csv_sharded(df, paths: Sequence[str], env,
+                      options: CSVWriteOptions | None = None) -> list:
+    """Scale-out egress: ONE FILE PER MESH WORKER — shard ``s``'s rows
+    go to ``paths[s]``, no host ever assembles the whole table.
+
+    The write-side mirror of :func:`read_csv_sharded` and the parity of
+    the reference's per-rank ``WriteCSV`` (every rank writes its own
+    output file, ``cpp/test/test_utils.hpp`` golden files are per-rank
+    for exactly this reason). Under ``jax.distributed`` each process
+    writes only the shards it can address, so egress memory and IO
+    scale out with hosts. Returns the paths this process wrote.
+    """
+    import jax
+    import numpy as np
+
+    from cylon_tpu.errors import InvalidArgument
+    from cylon_tpu.parallel import dtable
+    from cylon_tpu.table import Table
+
+    options = options or CSVWriteOptions()
+    t: Table = df.table if hasattr(df, "table") else df
+    t = dtable.scatter_table(env, t)
+    w = env.world_size
+    paths = list(paths)
+    if len(paths) != w:
+        raise InvalidArgument(
+            f"write_csv_sharded needs exactly one path per worker "
+            f"({w}), got {len(paths)}")
+    dtable.dist_num_rows(t)             # raises on poisoned shards
+    counts = dtable.host_counts(t)      # cached by the check above
+    devs = list(env.mesh.devices.flat)
+    pid = jax.process_index()
+    mine = [s for s in range(w) if devs[s].process_index == pid]
+
+    def shard_buf(arr, dev):
+        # this device's block only — never the global buffer
+        return next(s for s in arr.addressable_shards
+                    if s.device == dev).data
+
+    # ONE batched transfer for every shard block this process writes
+    # (per-buffer fetches pay a fixed round trip each on a tunneled
+    # device — the Table._host_columns convention)
+    fetches = {}
+    for s in mine:
+        for name, c in t.columns.items():
+            fetches[(s, name, "d")] = shard_buf(c.data, devs[s])
+            if c.validity is not None:
+                fetches[(s, name, "v")] = shard_buf(c.validity, devs[s])
+    fetched = dict(zip(fetches, jax.device_get(list(fetches.values()))))
+
+    import pandas as pd
+
+    written = []
+    for s in mine:
+        cols = {}
+        for name, c in t.columns.items():
+            data = fetched[(s, name, "d")][:counts[s]]
+            validity = (fetched[(s, name, "v")][:counts[s]]
+                        if c.validity is not None else None)
+            cols[name] = c.decode_host(data, validity)
+        pd.DataFrame(cols).to_csv(paths[s], sep=options.delimiter,
+                                  index=False,
+                                  header=options.include_header)
+        written.append(paths[s])
+    return written
+
+
 def read_parquet(paths, env=None, capacity: int | None = None,
                  columns: Sequence[str] | None = None):
     """Parity: ``FromParquet`` (table.cpp:1121, behind CYLON_PARQUET —
