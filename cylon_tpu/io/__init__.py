@@ -500,7 +500,6 @@ def write_csv_sharded(df, paths: Sequence[str], env,
     scale out with hosts. Returns the paths this process wrote.
     """
     import jax
-    import numpy as np
 
     from cylon_tpu.errors import InvalidArgument
     from cylon_tpu.parallel import dtable
@@ -515,8 +514,16 @@ def write_csv_sharded(df, paths: Sequence[str], env,
         raise InvalidArgument(
             f"write_csv_sharded needs exactly one path per worker "
             f"({w}), got {len(paths)}")
-    dtable.dist_num_rows(t)             # raises on poisoned shards
-    counts = dtable.host_counts(t)      # cached by the check above
+    # one fetch serves both the poison check and the per-shard counts
+    # (dist_num_rows would fetch a second time; message kept identical)
+    counts = dtable.host_counts(t)
+    cap_l = dtable.local_capacity(t)
+    if (counts > cap_l).any():
+        from cylon_tpu.errors import OutOfCapacity
+
+        raise OutOfCapacity(
+            f"shard row counts {counts.tolist()} exceed local capacity "
+            f"{cap_l}; re-run with a larger out_capacity / skew factor")
     devs = list(env.mesh.devices.flat)
     pid = jax.process_index()
     mine = [s for s in range(w) if devs[s].process_index == pid]

@@ -501,11 +501,26 @@ def forward_fill(mark: jax.Array, val: jax.Array) -> jax.Array:
     mark wins); positions before the first mark get 0.
 
     This is the segmented-scan building block that replaces random
-    gathers of per-group values: one ``cummax`` over (position, value)
-    encoded into a u64 — an elementwise scan, ~10x cheaper than a
-    same-size gather on TPU.
+    gathers of per-group values: one running max over (position, value)
+    pairs — an elementwise scan, ~10x cheaper than a same-size gather
+    on TPU. On TPU the pair rides the Pallas lex-max scan
+    (``pallas_kernels.pair_max_scan``); elsewhere it packs into a u64
+    ``cummax`` (bit-identical ordering — u64 compare IS the (hi, lo)
+    lexicographic compare). The u64 form under the TPU's x64 emulation
+    was the join's single hottest op (3.7 ms per fill at 2M rows vs
+    ~0.1 ms for the kernel).
     """
+    from cylon_tpu.ops import pallas_kernels as pk
+
     cap = val.shape[0]
+    # both operands must clear the gate: inside interpret-mode
+    # shard_map either may be device-varying (usable_for excludes that)
+    if pk.scan32_ok(val) and pk.usable_for(mark):
+        hi = jnp.where(mark, jnp.arange(cap, dtype=jnp.uint32),
+                       jnp.uint32(0))
+        lo = jnp.where(mark, val.astype(jnp.uint32), jnp.uint32(0))
+        _, filled = pk.pair_max_scan(hi, lo)
+        return filled.astype(jnp.int32)
     iota = jnp.arange(cap, dtype=jnp.uint64)
     enc = jnp.where(mark,
                     (iota << jnp.uint64(32))
