@@ -118,15 +118,27 @@ class Column:
 
     # -- constructors ----------------------------------------------------
     @staticmethod
-    def from_numpy(arr: np.ndarray, capacity: int | None = None) -> "Column":
-        """Host array -> Column. Dictionary-encodes strings/objects; extracts
-        a validity mask from NaN/None. Pads to ``capacity`` if given."""
+    def from_numpy(arr: np.ndarray, capacity: int | None = None,
+                   string_storage: str = "dict") -> "Column":
+        """Host array -> Column. Strings/objects get one of two device
+        layouts per ``string_storage``: ``"dict"`` (int32 codes + host
+        dictionary — low-cardinality default), ``"bytes"`` (device-native
+        packed byte words, :mod:`cylon_tpu.ops.bytescol` — no host
+        dictionary, scales to unique-per-row columns), or ``"auto"``
+        (sampled-cardinality choice). Extracts a validity mask from
+        NaN/None. Pads to ``capacity`` if given."""
         arr = np.asarray(arr)
         validity = None
 
         if arr.dtype.kind in ("U", "S", "O"):
             import pandas as pd
 
+            from cylon_tpu.ops import bytescol
+
+            if string_storage == "auto":
+                string_storage = bytescol.choose_storage(arr)
+            if string_storage == "bytes":
+                return bytescol.from_numpy(arr, capacity)
             # pd.isna handles None / float nan / pd.NA / NaT uniformly
             # (vectorised; a python per-element loop is seconds at 1M rows)
             isnull = np.asarray(pd.isna(arr))
@@ -197,6 +209,11 @@ class Column:
         fetches are a fixed ~100 ms round trip on a tunneled device, so
         tables fetch every column in ONE transfer and decode here."""
         n = len(data)
+        if self.dtype.is_bytes:
+            from cylon_tpu.ops import bytescol
+
+            out = bytescol.decode_host(data, validity)
+            return out
         if self.dtype.is_dictionary:
             if self.dictionary is None:
                 raise TypeError_("dictionary column without dictionary")
@@ -224,6 +241,33 @@ class Column:
 
     def astype(self, dtype: dtypes.DType) -> "Column":
         """Cast (parity: ``table.pyx:2446`` astype)."""
+        if self.dtype.is_bytes or dtype.is_bytes:
+            from cylon_tpu.ops import bytescol
+
+            if self.dtype.is_dictionary and dtype.is_bytes:
+                return bytescol.dict_to_bytes(
+                    self, None if dtype.bytes_width is None
+                    else dtype.bytes_width)
+            if self.dtype.is_bytes and dtype.is_bytes:
+                nw = dtype.bytes_width // 4
+                cur = self.data.shape[1]
+                if nw > cur:
+                    pad = jnp.zeros((self.capacity, nw - cur), jnp.uint32)
+                    data = jnp.concatenate([self.data, pad], axis=1)
+                elif nw < cur:
+                    # narrowing TRUNCATES content to the declared width
+                    # (documented; raising would break schema
+                    # normalisation before concat/join)
+                    data = self.data[:, :nw]
+                else:
+                    return self
+                return Column(data, self.validity,
+                              dtypes.string_bytes(nw * 4), None)
+            if self.dtype.is_bytes and dtype.is_dictionary:
+                return bytescol.bytes_to_dict(self, self.capacity)
+            raise TypeError_(
+                "cast between string bytes and non-string requires "
+                "host round-trip")
         if self.dtype.is_dictionary != dtype.is_dictionary:
             raise TypeError_(
                 "cast between string and non-string requires host round-trip")

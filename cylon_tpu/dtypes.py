@@ -84,14 +84,24 @@ _PHYSICAL = {
 
 @dataclasses.dataclass(frozen=True)
 class DType:
-    """Logical dtype. Parity: ``cylon::DataType`` (``data_types.hpp:94-139``)."""
+    """Logical dtype. Parity: ``cylon::DataType`` (``data_types.hpp:94-139``).
+
+    STRING/BINARY columns have two device layouts (the rebuild of the
+    reference's variable-width ``Layout``, ``data_types.hpp:141``):
+    dictionary codes (``bytes_width is None`` — int32 codes + host
+    dictionary) or device bytes (``bytes_width`` set — [cap, nwords]
+    big-endian uint32 words, :mod:`cylon_tpu.ops.bytescol`).
+    """
 
     kind: Kind
     unit: str | None = None  # temporal unit ("s"/"ms"/"us"/"ns") when applicable
+    bytes_width: int | None = None  # device-bytes string: padded byte width
 
     @property
     def physical(self) -> jnp.dtype:
         """Device representation dtype."""
+        if self.bytes_width is not None:
+            return jnp.dtype(jnp.uint32)
         return jnp.dtype(_PHYSICAL[self.kind])
 
     @property
@@ -103,7 +113,13 @@ class DType:
     @property
     def is_dictionary(self) -> bool:
         """True if the device array holds dictionary codes."""
-        return self.kind in (Kind.STRING, Kind.BINARY)
+        return (self.kind in (Kind.STRING, Kind.BINARY)
+                and self.bytes_width is None)
+
+    @property
+    def is_bytes(self) -> bool:
+        """True if the device array holds packed big-endian byte words."""
+        return self.bytes_width is not None
 
     @property
     def is_numeric(self) -> bool:
@@ -118,6 +134,8 @@ class DType:
         return self.kind in (Kind.HALF_FLOAT, Kind.FLOAT, Kind.DOUBLE)
 
     def __repr__(self):
+        if self.bytes_width is not None:
+            return f"{self.kind.name.lower()}[bytes:{self.bytes_width}]"
         u = f"[{self.unit}]" if self.unit else ""
         return f"{self.kind.name.lower()}{u}"
 
@@ -139,6 +157,14 @@ string = DType(Kind.STRING)
 binary = DType(Kind.BINARY)
 date32 = DType(Kind.DATE32)
 date64 = DType(Kind.DATE64)
+
+
+def string_bytes(width: int) -> DType:
+    """Device-bytes string dtype (``width`` padded bytes per row; the
+    device array is [cap, width/4] big-endian uint32 words)."""
+    if width % 4:
+        width += 4 - width % 4
+    return DType(Kind.STRING, None, int(width))
 
 
 def timestamp(unit: str = "ns") -> DType:

@@ -62,7 +62,7 @@ class DataFrame:
     """Columnar dataframe on device (parity: pycylon ``DataFrame``)."""
 
     def __init__(self, data=None, env: CylonEnv | None = None,
-                 capacity: int | None = None):
+                 capacity: int | None = None, string_storage="dict"):
         index = None
         if isinstance(data, DataFrame):
             self._table = data._table
@@ -72,12 +72,13 @@ class DataFrame:
         elif data is None:
             self._table = Table({}, 0)
         elif isinstance(data, Mapping):
-            self._table = Table.from_pydict(data, capacity)
+            self._table = Table.from_pydict(data, capacity, string_storage)
         else:
             import pandas as pd
 
             if isinstance(data, pd.DataFrame):
-                self._table = Table.from_pandas(data, capacity)
+                self._table = Table.from_pandas(data, capacity,
+                                                string_storage)
             elif isinstance(data, np.ndarray):
                 names = [f"c{i}" for i in range(data.shape[1])]
                 self._table = Table.from_numpy(names, list(data.T), capacity)
@@ -86,7 +87,8 @@ class DataFrame:
                     import pyarrow as pa
 
                     if isinstance(data, pa.Table):
-                        self._table = Table.from_arrow(data, capacity)
+                        self._table = Table.from_arrow(data, capacity,
+                                                       string_storage)
                     else:
                         raise TypeError
                 except TypeError:
@@ -454,7 +456,20 @@ class DataFrame:
         t = self._materialized().table
         cols = {}
         nrows = t.nrows
+        # bytes columns need host values; fetch them all in ONE batched
+        # transfer (per-column fetches pay a ~100 ms RPC each on a
+        # tunneled device)
+        host_cols = (t._host_columns()
+                     if any(c.dtype.is_bytes for c in t.columns.values())
+                     else {})
         for name, c in t.columns.items():
+            if c.dtype.is_bytes:
+                host = np.array([fn(v) for v in host_cols[name]], object)
+                st = ("bytes" if all(isinstance(v, str) or v is None
+                                     for v in host) else "dict")
+                cols[name] = Column.from_numpy(host, t.capacity,
+                                               string_storage=st)
+                continue
             if c.dtype.is_dictionary:
                 cols[name] = reencode_values(
                     c, [fn(v) for v in c.dictionary.values])
@@ -508,6 +523,11 @@ class DataFrame:
         t = self._table
         cols = {}
         for name, c in t.columns.items():
+            if c.dtype.is_bytes:
+                from cylon_tpu.ops import bytescol
+
+                cols[name] = bytescol.fill_value(c, value)
+                continue
             if c.dtype.is_dictionary:
                 if c.validity is None:
                     cols[name] = c
@@ -576,7 +596,16 @@ class DataFrame:
                 m = jnp.asarray(cond, bool)
             base = (jnp.ones(t.capacity, bool) if c.validity is None
                     else c.validity)
-            if c.dtype.is_dictionary:
+            if c.dtype.is_bytes:
+                if nan_fill:
+                    cols[name] = Column(c.data, base & m, c.dtype)
+                else:
+                    from cylon_tpu.ops import bytescol
+
+                    validity = None if c.validity is None else (base | ~m)
+                    cols[name] = bytescol.replace_where(c, m, other,
+                                                        validity)
+            elif c.dtype.is_dictionary:
                 if nan_fill:
                     cols[name] = Column(c.data, base & m, c.dtype,
                                         c.dictionary)
@@ -642,6 +671,12 @@ class DataFrame:
         cols = {}
         vset = set(values)
         for name, c in t.columns.items():
+            if c.dtype.is_bytes:
+                from cylon_tpu.ops import bytescol
+
+                mask = bytescol.isin(c, list(vset))
+                cols[name] = Column(mask, None, dtypes.bool_)
+                continue
             if c.dtype.is_dictionary:
                 codes = [i for i, v in enumerate(c.dictionary.values)
                          if v in vset]

@@ -1,0 +1,431 @@
+"""Device-native variable-length string columns.
+
+The reference moves arbitrary variable-length data through its whole
+stack: validity/offsets/data buffers ride the wire protocol
+(``cpp/src/cylon/arrow/arrow_all_to_all.cpp:100-108,173-214``), binary
+comparators sort/hash it (``arrow/arrow_comparator.cpp`` binary paths)
+and ``ArrowBinaryHashIndex`` indexes it (``indexing/index.hpp:246``).
+Arrow's (offsets, data) layout is exactly what XLA cannot compile:
+per-row dynamic extents. The TPU-native layout here is
+
+    data: [capacity, nwords] uint32 — each row's UTF-8 bytes, zero-padded
+    to a static per-column byte width and packed BIG-ENDIAN into words.
+
+Big-endian packing makes unsigned word order equal byte order, so
+
+- **unsigned lexicographic comparison of the word tuple IS string
+  comparison** (zero padding ranks a proper prefix before its
+  extensions, matching bytewise string order);
+- every existing sort/group/join/partition kernel consumes a bytes
+  column as ``nwords`` extra u32 key operands — no new comparator code
+  (``kernels.pack_order_keys``/``group_sort`` expand 2-D operands);
+- the shuffle moves it like any other [cap, d] array: no host
+  dictionary to unify, no wire protocol, no 64-bit split.
+
+The representable set: NUL-free byte strings (checked at ingest — a
+value containing ``\\x00`` is indistinguishable from its padded form;
+such data should use dictionary encoding instead). Row length is
+recovered as the offset of the last non-zero byte, so no separate
+length buffer is needed.
+
+Contrast with dictionary encoding (:mod:`cylon_tpu.ops.dictenc`): codes
+win for low-cardinality columns (4 bytes/row + tiny host dictionary),
+bytes win when the value set scales with the data (TPC-H ``*_comment``:
+the host dictionary would BE the dataset and every op would serialise
+on one host). ``string_storage="auto"`` samples cardinality at ingest
+and picks per column.
+"""
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from cylon_tpu import dtypes
+from cylon_tpu.column import Column
+from cylon_tpu.errors import InvalidArgument, TypeError_
+
+# Bound compiled-shape proliferation: byte widths are rounded up to the
+# next multiple of one word (4 bytes). 2^31-ish max is implicit.
+WORD = 4
+
+
+def width_words(nbytes: int) -> int:
+    return max(1, -(-int(nbytes) // WORD))
+
+
+# --------------------------------------------------------------- host codec
+def encode_host(values: np.ndarray, width: int | None = None
+                ) -> tuple[np.ndarray, np.ndarray | None, int]:
+    """Object/str array -> ([n, nwords] uint32 big-endian words,
+    validity|None, byte_width). Nulls (None/NaN) become all-zero rows
+    with validity False. Raises for embedded NUL bytes (not
+    representable — use dictionary storage)."""
+    import pandas as pd
+
+    arr = np.asarray(values, dtype=object)
+    isnull = np.asarray(pd.isna(arr))
+    if isnull.ndim == 0:
+        isnull = np.broadcast_to(isnull, arr.shape).copy()
+    filled = np.where(isnull, "", arr)
+    # np.char.encode handles non-ASCII (utf-8); plain .astype("S") does not
+    sbytes = np.char.encode(filled.astype(str), "utf-8")
+    maxlen = sbytes.dtype.itemsize
+    if width is not None:
+        if maxlen > width:
+            raise InvalidArgument(
+                f"string of {maxlen} bytes exceeds declared width {width}")
+        maxlen = width
+    nw = width_words(maxlen)
+    n = len(sbytes)
+    # pad every value to nw*4 bytes, then view as big-endian u32 words
+    padded = np.zeros((n, nw * WORD), np.uint8)
+    if n:
+        raw = sbytes.astype(f"S{nw * WORD}")  # zero-pads (numpy S semantics)
+        padded = np.frombuffer(raw.tobytes(), np.uint8).reshape(n, nw * WORD)
+    if _embedded_nul(padded).any():
+        raise TypeError_(
+            "string contains NUL byte; device-bytes storage cannot "
+            "represent it — use string_storage='dict'")
+    words = padded.view(">u4").astype(np.uint32)
+    validity = None
+    if isnull.any():
+        validity = ~isnull
+        words = np.where(isnull[:, None], np.uint32(0), words)
+    return words, validity, nw * WORD
+
+
+def _embedded_nul(padded: np.ndarray) -> np.ndarray:
+    """[n] bool: rows whose byte run has a zero byte before a non-zero
+    byte (an embedded NUL — indistinguishable from padding)."""
+    if padded.size == 0:
+        return np.zeros(padded.shape[0], bool)
+    nz = padded != 0
+    # any non-zero byte strictly AFTER position j
+    suf = np.flip(np.maximum.accumulate(np.flip(nz, 1), 1), 1)
+    later = np.concatenate(
+        [suf[:, 1:], np.zeros((padded.shape[0], 1), bool)], axis=1)
+    return ((padded == 0) & later).any(axis=1)
+
+
+def decode_host(words: np.ndarray, validity: np.ndarray | None
+                ) -> np.ndarray:
+    """[n, nwords] uint32 -> object array of str (trailing NULs
+    stripped; null rows -> None)."""
+    n, nw = words.shape
+    be = np.ascontiguousarray(words.astype(np.uint32)).astype(">u4")
+    raw = be.tobytes()
+    sarr = np.frombuffer(raw, dtype=f"S{nw * WORD}")  # strips trailing NUL
+    out = np.asarray(np.char.decode(sarr, "utf-8"), dtype=object)
+    if validity is not None and (~validity).any():
+        out[~validity] = None
+    return out
+
+
+def encode_scalar(value: str, nwords: int) -> np.ndarray:
+    """One value -> [nwords] uint32 (zero-padded), for device compares."""
+    b = str(value).encode("utf-8")
+    if b"\x00" in b:
+        raise TypeError_("NUL byte in comparison value")
+    if len(b) > nwords * WORD:
+        # longer than any stored value can be; caller handles via length
+        raise InvalidArgument(
+            f"value of {len(b)} bytes exceeds column width {nwords * WORD}")
+    padded = b + b"\x00" * (nwords * WORD - len(b))
+    return np.frombuffer(padded, ">u4").astype(np.uint32)
+
+
+# ----------------------------------------------------------- column factory
+def from_numpy(arr: np.ndarray, capacity: int | None = None,
+               width: int | None = None) -> Column:
+    """Host string array -> device-bytes Column."""
+    words, validity, bw = encode_host(arr, width)
+    dtype = dtypes.string_bytes(bw)
+    return Column._pad(words, validity, dtype, None, capacity)
+
+
+def dict_to_bytes(col: Column, width: int | None = None) -> Column:
+    """Dictionary-encoded column -> device-bytes column: the dictionary
+    VALUES are encoded host-side once ([ndict, nwords] — tiny), then one
+    device gather maps codes -> word rows. Nulls stay nulls."""
+    if not col.dtype.is_dictionary:
+        raise TypeError_("dict_to_bytes on non-dictionary column")
+    vals = (col.dictionary.values if col.dictionary is not None
+            else np.asarray([], object))
+    if len(vals):
+        words, dvalid, bw = encode_host(vals, width)
+        if dvalid is not None:
+            # a null dictionary VALUE (rare: Series.map producing NaN)
+            words = np.where(dvalid[:, None], words, np.uint32(0))
+    else:
+        bw = width or WORD
+        words = np.zeros((0, width_words(bw)), np.uint32)
+    nw = width_words(bw if width is None else width)
+    if words.shape[1] < nw:
+        words = np.pad(words, ((0, 0), (0, nw - words.shape[1])))
+    table = jnp.asarray(words)
+    hi = max(len(vals) - 1, 0)
+    if len(vals):
+        data = table[jnp.clip(col.data, 0, hi)]
+    else:
+        data = jnp.zeros((col.capacity, nw), jnp.uint32)
+    validity = col.validity
+    if validity is not None:
+        data = jnp.where(validity[:, None], data, jnp.uint32(0))
+    return Column(data, validity, dtypes.string_bytes(nw * WORD), None)
+
+
+def bytes_to_dict(col: Column, nrows: int) -> Column:
+    """Device-bytes -> dictionary column (host round trip — builds the
+    global dictionary this layout exists to avoid; only for explicit
+    casts and mixed-storage fallbacks on small data)."""
+    host = col.to_numpy(nrows)
+    out = Column.from_numpy(host, col.capacity)
+    return out
+
+
+def align_widths(cols: Sequence[Column]) -> list[Column]:
+    """Pad every device-bytes column to the widest word count (zero
+    words compare below any byte, so padding never changes order)."""
+    bcols = [c for c in cols if c.dtype.is_bytes]
+    if not bcols:
+        return list(cols)
+    nw = max(c.data.shape[1] for c in bcols)
+    out = []
+    for c in cols:
+        if c.dtype.is_bytes and c.data.shape[1] < nw:
+            pad = jnp.zeros((c.capacity, nw - c.data.shape[1]), jnp.uint32)
+            out.append(Column(jnp.concatenate([c.data, pad], axis=1),
+                              c.validity, dtypes.string_bytes(nw * WORD),
+                              None))
+        else:
+            out.append(c)
+    return out
+
+
+def align_storages(cols: Sequence[Column]) -> list[Column]:
+    """Bring STRING columns of mixed storage to a common device layout:
+    if any is device-bytes, dictionary peers convert to bytes (device
+    gather through their host-encoded values — cheap); widths align."""
+    if not any(c.dtype.is_bytes for c in cols):
+        return list(cols)
+    conv = []
+    for c in cols:
+        if c.dtype.is_dictionary:
+            conv.append(dict_to_bytes(c))
+        else:
+            conv.append(c)
+    return align_widths(conv)
+
+
+def align_table_strings(tables):
+    """Column-name-wise mixed-storage string alignment across tables
+    (the bytes-layout analog of ``dictenc.unify_table_dictionaries``):
+    any column that is device-bytes in one table becomes device-bytes
+    in all, at a shared width."""
+    from cylon_tpu.table import Table
+
+    tables = list(tables)
+    if len(tables) < 2:
+        return tables
+    names = tables[0].column_names
+    touched = [n for n in names
+               if any(n in t and t.column(n).dtype.is_bytes for t in tables)]
+    if not touched:
+        return tables
+    new_cols = [dict(t.columns) for t in tables]
+    for name in touched:
+        aligned = align_storages([t.column(name) for t in tables])
+        for i, c in enumerate(aligned):
+            new_cols[i][name] = c
+    return [Table(new_cols[i], t.nrows) for i, t in enumerate(tables)]
+
+
+# ------------------------------------------------------------ device kernels
+def byte_matrix(data: jax.Array) -> jax.Array:
+    """[cap, nwords] u32 -> [cap, nwords*4] int32 byte values (0..255).
+    int32 (not u8): XLA vectorises 32-bit compares natively on TPU."""
+    shifts = jnp.asarray([24, 16, 8, 0], jnp.uint32)
+    b = (data[:, :, None] >> shifts[None, None, :]) & jnp.uint32(0xFF)
+    return b.reshape(data.shape[0], -1).astype(jnp.int32)
+
+
+def row_lengths(data: jax.Array) -> jax.Array:
+    """[cap] int32 byte length per row (offset of last non-zero byte)."""
+    b = byte_matrix(data)
+    width = b.shape[1]
+    idx = jnp.arange(1, width + 1, dtype=jnp.int32)
+    return jnp.max(jnp.where(b != 0, idx, 0), axis=1)
+
+
+def _pat_bytes(pat: str) -> np.ndarray:
+    b = str(pat).encode("utf-8")
+    if b"\x00" in b:
+        raise TypeError_("NUL byte in pattern")
+    return np.frombuffer(b, np.uint8).astype(np.int32)
+
+
+def startswith(col: Column, prefix: str) -> jax.Array:
+    """[cap] bool — rows whose value starts with ``prefix``. A windowed
+    compare on the leading bytes (parity role: the dictionary-predicate
+    path of ``series._dict_pred`` without any host dictionary)."""
+    pat = _pat_bytes(prefix)
+    m = len(pat)
+    if m == 0:
+        return _all_valid(col)
+    b = byte_matrix(col.data)
+    if m > b.shape[1]:
+        return jnp.zeros(col.capacity, bool)
+    mask = (b[:, :m] == jnp.asarray(pat)[None, :]).all(axis=1)
+    return _and_valid(col, mask)
+
+
+def endswith(col: Column, suffix: str) -> jax.Array:
+    pat = _pat_bytes(suffix)
+    m = len(pat)
+    if m == 0:
+        return _all_valid(col)
+    b = byte_matrix(col.data)
+    if m > b.shape[1]:
+        return jnp.zeros(col.capacity, bool)
+    ln = row_lengths(col.data)
+    # per-row window [ln-m, ln): one take_along_axis of m lanes
+    pos = ln[:, None] - m + jnp.arange(m, dtype=jnp.int32)[None, :]
+    safe = jnp.clip(pos, 0, b.shape[1] - 1)
+    window = jnp.take_along_axis(b, safe, axis=1)
+    mask = (window == jnp.asarray(pat)[None, :]).all(axis=1) & (ln >= m)
+    return _and_valid(col, mask)
+
+
+def contains(col: Column, pat: str) -> jax.Array:
+    """Literal substring search: all O(width) shifted windows compared at
+    once — elementwise work on the MXU-adjacent VPU, no per-row loop."""
+    patb = _pat_bytes(pat)
+    m = len(patb)
+    if m == 0:
+        return _all_valid(col)
+    b = byte_matrix(col.data)
+    width = b.shape[1]
+    if m > width:
+        return jnp.zeros(col.capacity, bool)
+    nwin = width - m + 1
+    acc = jnp.ones((col.capacity, nwin), bool)
+    for j in range(m):
+        acc = acc & (b[:, j:j + nwin] == jnp.int32(patb[j]))
+    # a match may not extend into the zero padding: start <= len - m
+    ln = row_lengths(col.data)
+    ok = jnp.arange(nwin, dtype=jnp.int32)[None, :] <= (ln[:, None] - m)
+    mask = (acc & ok).any(axis=1)
+    return _and_valid(col, mask)
+
+
+def cmp_scalar(col: Column, value: str) -> tuple[jax.Array, jax.Array]:
+    """(lt, eq) masks of rows vs a scalar, by big-endian word order
+    (== bytewise string order). Values longer than the column width
+    compare via their truncated prefix then rank greater on equality."""
+    nw = col.data.shape[1]
+    b = str(value).encode("utf-8")
+    truncated = len(b) > nw * WORD
+    sw = np.frombuffer((b + b"\x00" * (nw * WORD))[:nw * WORD],
+                       ">u4").astype(np.uint32)
+    lt = jnp.zeros(col.capacity, bool)
+    eq = jnp.ones(col.capacity, bool)
+    for i in range(nw):
+        w = col.data[:, i]
+        s = jnp.uint32(sw[i])
+        lt = lt | (eq & (w < s))
+        eq = eq & (w == s)
+    if truncated:  # equal-to-prefix rows are < the longer scalar
+        lt = lt | eq
+        eq = jnp.zeros_like(eq)
+    return lt, eq
+
+
+def isin(col: Column, values) -> jax.Array:
+    vals = [v for v in values if isinstance(v, str)]
+    if not vals:
+        return jnp.zeros(col.capacity, bool)
+    nw = col.data.shape[1]
+    rows = []
+    for v in vals:
+        try:
+            rows.append(encode_scalar(v, nw))
+        except InvalidArgument:
+            pass  # longer than any stored value: no match possible
+    if not rows:
+        return jnp.zeros(col.capacity, bool)
+    probe = jnp.asarray(np.stack(rows))                     # [k, nw]
+    mask = (col.data[:, None, :] == probe[None, :, :]).all(-1).any(1)
+    return _and_valid(col, mask)
+
+
+def replace_where(col: Column, keep: jax.Array, value: str,
+                  validity) -> Column:
+    """Rows where ``keep`` is False take ``value`` (widening the column
+    if the replacement is longer than the current width). Shared by
+    fillna (keep = validity) and DataFrame.where (keep = cond)."""
+    b = str(value).encode("utf-8")
+    nw = max(col.data.shape[1], width_words(len(b)))
+    data = col.data
+    if nw > data.shape[1]:
+        pad = jnp.zeros((col.capacity, nw - data.shape[1]), jnp.uint32)
+        data = jnp.concatenate([data, pad], axis=1)
+    sw = jnp.asarray(encode_scalar(value, nw))
+    data = jnp.where(keep[:, None], data, sw[None, :])
+    return Column(data, validity, dtypes.string_bytes(nw * WORD), None)
+
+
+def fill_value(col: Column, value: str) -> Column:
+    """fillna: null rows take ``value``."""
+    if col.validity is None:
+        return col
+    return replace_where(col, col.validity, value, None)
+
+
+def _all_valid(col: Column) -> jax.Array:
+    if col.validity is None:
+        return jnp.ones(col.capacity, bool)
+    return col.validity
+
+
+def _and_valid(col: Column, mask: jax.Array) -> jax.Array:
+    if col.validity is not None:
+        mask = mask & col.validity
+    return mask
+
+
+# --------------------------------------------------------------- auto policy
+def choose_storage(arr: np.ndarray, sample: int = 8192,
+                   card_threshold: float = 0.5) -> str:
+    """Sample-based ingest policy for ``string_storage="auto"``: a column
+    whose sampled distinct-value ratio exceeds ``card_threshold`` gets
+    device bytes (the dictionary would scale with the data); otherwise
+    dictionary codes (4 bytes/row beats padded width). The sample bounds
+    the decision cost — no global factorize before the choice is made."""
+    import pandas as pd
+
+    n = len(arr)
+    if n == 0:
+        return "dict"
+    take = arr[:sample] if n > sample else arr
+    try:
+        uniq = pd.unique(take[~np.asarray(pd.isna(take))])
+    except Exception:
+        return "dict"
+    ratio = len(uniq) / max(len(take), 1)
+    if ratio <= card_threshold:
+        return "dict"
+    # NUL bytes force dictionary storage (checked on the sample; ingest
+    # re-checks the full column and raises with guidance)
+    try:
+        sb = np.char.encode(np.where(pd.isna(take), "", take).astype(str),
+                            "utf-8")
+        w = sb.dtype.itemsize or 1
+        flat = np.frombuffer(sb.astype(f"S{w}").tobytes(),
+                             np.uint8).reshape(len(sb), w)
+        if _embedded_nul(flat).any():
+            return "dict"
+    except Exception:
+        return "dict"
+    return "bytes"

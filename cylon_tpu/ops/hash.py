@@ -50,6 +50,12 @@ def _fmix32(h):
 def _words32(data: jax.Array) -> list[jax.Array]:
     """Column -> list of uint32 word arrays (canonicalised)."""
     dt = data.dtype
+    if data.ndim == 2:
+        # device-bytes string column ([cap, nwords] u32, bytescol):
+        # the words are already the content — hashing them by CONTENT
+        # means independently ingested relations co-locate equal keys
+        # with no dictionary value-hash table at all
+        return [data[:, i] for i in range(data.shape[1])]
     if dt == jnp.bool_:
         return [data.astype(jnp.uint32)]
     if jnp.issubdtype(dt, jnp.floating):

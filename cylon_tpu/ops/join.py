@@ -147,6 +147,21 @@ def _aligned_keys(left, right, left_on, right_on):
     lkeys, rkeys, lvals, rvals = [], [], [], []
     for ln, rn in zip(left_on, right_on):
         lc, rc = left.column(ln), right.column(rn)
+        if lc.dtype.is_bytes or rc.dtype.is_bytes:
+            from cylon_tpu.ops.bytescol import align_storages
+
+            if not (lc.dtype.is_bytes or lc.dtype.is_dictionary) or \
+                    not (rc.dtype.is_bytes or rc.dtype.is_dictionary):
+                raise InvalidArgument(
+                    f"join key {ln}/{rn}: string vs non-string")
+            lc, rc = align_storages([lc, rc])
+            left = left.add_column(ln, lc)
+            right = right.add_column(rn, rc)
+            lkeys.append(lc.data)
+            rkeys.append(rc.data)
+            lvals.append(lc.validity)
+            rvals.append(rc.validity)
+            continue
         if lc.dtype.is_dictionary != rc.dtype.is_dictionary:
             raise InvalidArgument(
                 f"join key {ln}/{rn}: string vs non-string")
@@ -346,7 +361,8 @@ def _coalesce(a: Column, b: Column) -> Column:
     """a where valid else b (key coalescing for full outer joins)."""
     av = jnp.ones(a.capacity, bool) if a.validity is None else a.validity
     bv = jnp.ones(b.capacity, bool) if b.validity is None else b.validity
-    data = jnp.where(av, a.data, b.data)
+    data = jnp.where(av[:, None] if a.data.ndim == 2 else av,
+                     a.data, b.data)
     validity = av | bv
     # content equality, matching unify_dictionaries' pass-through for
     # equal-content dictionaries (independently ingested same-value sets)

@@ -23,14 +23,19 @@ from cylon_tpu.table import Table
 def _packable(data: jax.Array) -> bool:
     """float64 cannot ride the u32 packing (XLA's TPU x64-emulation
     pass implements cross-width bitcasts for 64-bit ints but not
-    doubles) and neither can multi-dim columns; both gather
-    individually instead."""
+    doubles) and neither can general multi-dim columns — but a
+    device-bytes string column ([cap, w] u32, bytescol) already IS
+    words and rides the packed gather as-is."""
+    if data.ndim == 2 and data.dtype == jnp.uint32:
+        return True
     return data.ndim == 1 and data.dtype != jnp.float64
 
 
 def _to_words(data: jax.Array) -> jax.Array:
     """[cap] packable column -> [cap, w] u32 words (bit-preserving)."""
     dt = data.dtype
+    if data.ndim == 2:  # bytes column: already u32 words
+        return data
     if dt == jnp.bool_:
         return data.astype(jnp.uint32)[:, None]
     if dt.itemsize == 8:
@@ -102,6 +107,8 @@ def take_columns(table: Table, idx: jax.Array, nrows_out,
     for name, c, sl, vslot in layout:
         if sl is None:  # unpackable (f64): dedicated gather
             data = c.data[safe]
+        elif c.data.ndim == 2:  # bytes column: the words are the data
+            data = out_words[:, sl]
         else:
             data = _from_words(out_words[:, sl], c.data.dtype)
         validity = None if vslot is None else out_words[:, vslot] != 0
@@ -134,6 +141,12 @@ def columns_to_payloads(columns, capacity: int,
         if c.data.ndim == 1:
             spec[name] = len(payloads)
             payloads.append(c.data)
+        elif c.data.ndim == 2 and c.data.dtype == jnp.uint32:
+            # bytes column: each word rides as its own payload slot (a
+            # post-sort gather would cost ~10x the sort on TPU)
+            nw = c.data.shape[1]
+            spec[name] = ("w", len(payloads), nw)
+            payloads.extend(c.data[:, i] for i in range(nw))
         else:
             spec[name] = None
             need_iota = True
@@ -154,8 +167,13 @@ def payloads_to_columns(columns, sorted_payloads, pack) -> dict:
     cols = {}
     for name, c in columns.items():
         slot = spec[name]
-        data = (sorted_payloads[slot] if slot is not None
-                else c.data[sorted_payloads[iota_slot]])
+        if isinstance(slot, tuple):  # bytes column word slots
+            _, start, nw = slot
+            data = jnp.stack(sorted_payloads[start:start + nw], axis=1)
+        elif slot is not None:
+            data = sorted_payloads[slot]
+        else:
+            data = c.data[sorted_payloads[iota_slot]]
         vslot = spec.get(name + "\0v")
         validity = sorted_payloads[vslot] if vslot is not None else None
         cols[name] = Column(data, validity, c.dtype, c.dictionary)
@@ -215,8 +233,11 @@ def _sort_compiled(table: Table, *, by, ascending, na_position) -> Table:
             # pandas keeps null rows in original order (stable sort)
             flag = nulls if na_position == "last" else (1 - nulls)
             okeys.append(flag)
-            key = jnp.where(nulls == 0, key, jnp.zeros((), key.dtype))
-        okeys.append(key)
+            nz = nulls == 0
+            if key.ndim == 2:  # bytes column: zero every word
+                nz = nz[:, None]
+            key = jnp.where(nz, key, jnp.zeros((), key.dtype))
+        okeys.append(key)  # 2-D bytes keys expand in pack_order_keys
     padding = (~kernels.valid_mask(table.capacity, table.nrows)
                ).astype(jnp.uint8)
     operands = kernels.pack_order_keys([padding] + okeys)
@@ -258,6 +279,9 @@ def concat_tables(tables: Sequence[Table], capacity: int | None = None) -> Table
             raise InvalidArgument(
                 f"schema mismatch: {t.column_names} vs {names}")
     tables = unify_table_dictionaries(tables)
+    from cylon_tpu.ops.bytescol import align_table_strings
+
+    tables = align_table_strings(tables)
     cap_out = capacity if capacity is not None else sum(t.capacity for t in tables)
 
     nrows_list = [t.nrows for t in tables]

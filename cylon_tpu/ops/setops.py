@@ -90,7 +90,10 @@ def _unique_compiled(table: Table, *, cols, keep, out_cap) -> Table:
 
 
 def _two_table_gids(a: Table, b: Table, cols: Sequence[str] | None):
+    from cylon_tpu.ops.bytescol import align_table_strings
+
     a, b = unify_table_dictionaries([a, b])
+    a, b = align_table_strings([a, b])
     names = cols if cols is not None else a.column_names
     if [c for c in names if c not in b.column_names]:
         raise InvalidArgument("set op requires matching schemas")
@@ -184,6 +187,16 @@ def equal_tables(a: Table, b: Table, ordered: bool = False) -> bool:
 
         for n in a.column_names:
             ca, cb = a.column(n), b.column(n)
+            if ca.dtype.is_bytes or cb.dtype.is_bytes:
+                from cylon_tpu.ops.bytescol import align_storages
+
+                if not (ca.dtype.is_bytes or ca.dtype.is_dictionary) or \
+                        not (cb.dtype.is_bytes or cb.dtype.is_dictionary):
+                    return False  # string vs non-string
+                ca, cb = align_storages([ca, cb])
+                a = a.add_column(n, ca)
+                b = b.add_column(n, cb)
+                continue
             if ca.dtype.is_dictionary != cb.dtype.is_dictionary:
                 return False
             if ca.dtype.is_dictionary and ca.dictionary != cb.dictionary:

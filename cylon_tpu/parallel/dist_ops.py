@@ -421,7 +421,15 @@ def dist_join(env: CylonEnv, left: Table, right: Table, *,
     # unification is a no-op
     for ln, rn in zip(left_on, right_on):
         lc, rc = left.column(ln), right.column(rn)
-        if lc.dtype.is_dictionary and rc.dtype.is_dictionary \
+        if lc.dtype.is_bytes or rc.dtype.is_bytes:
+            # device-bytes keys need no dictionary unification — hashing
+            # is by content — only a shared word width for the exchange
+            from cylon_tpu.ops.bytescol import align_storages
+
+            lc2, rc2 = align_storages([lc, rc])
+            left = left.add_column(ln, lc2)
+            right = right.add_column(rn, rc2)
+        elif lc.dtype.is_dictionary and rc.dtype.is_dictionary \
                 and lc.dictionary is not rc.dictionary:
             from cylon_tpu.ops.dictenc import unify_dictionaries
 
@@ -649,7 +657,24 @@ def _sort_body(env, table, by, asc0, asc, nsamp, nbins, out_l, w):
     def body(t):
         lt, inof = _checked_local(t)
         c = t.column(by[0])
-        key = kernels.order_key(c.data, asc0)
+        if c.dtype.is_bytes:
+            # range-partition a device-bytes key by its first 8 bytes
+            # (u64 big-endian prefix: prefix order == string order).
+            # When the column is wider, rows sharing a prefix may differ
+            # beyond it, so they must stay on one shard — the multi-key
+            # splitter branch below guarantees that; the row-salt branch
+            # is sound only when the u64 IS the whole key.
+            nw = c.data.shape[1]
+            w0 = c.data[:, 0].astype(jnp.uint64)
+            w1 = (c.data[:, 1].astype(jnp.uint64) if nw > 1
+                  else jnp.zeros_like(w0))
+            key = (w0 << jnp.uint64(32)) | w1
+            if not asc0:
+                key = ~key
+            key_is_whole = nw <= 2
+        else:
+            key = kernels.order_key(c.data, asc0)
+            key_is_whole = True
         hi_sent = jnp.asarray(dtypes.sentinel_high(key.dtype), key.dtype)
         if c.validity is not None:
             # nulls partition to the top range (they sort last)
@@ -697,7 +722,7 @@ def _sort_body(env, table, by, asc0, asc, nsamp, nbins, out_l, w):
             samples = jnp.where(n > 0, sk[take_i],
                                 jnp.asarray(dtypes.sentinel_high(key.dtype),
                                             key.dtype))
-            if len(by) == 1:
+            if len(by) == 1 and key_is_whole:
                 # SALTED ranges: splitters are (key, local-row) PAIRS,
                 # so a dominant key value splits across adjacent shards
                 # instead of landing whole on one (the reference — and
@@ -744,9 +769,12 @@ def _sort_body(env, table, by, asc0, asc, nsamp, nbins, out_l, w):
 
 # ----------------------------------------------------------------- set ops
 def _dist_setop(env, a, b, local_op, out_capacity):
+    from cylon_tpu.ops.bytescol import align_table_strings
+
     a = _prep(env, a)
     b = _prep(env, b)
     a, b = unify_table_dictionaries([a, b])
+    a, b = align_table_strings([a, b])
     cols = a.column_names
     w = env.world_size
     ax = env.world_axes

@@ -97,7 +97,7 @@ class Series:
 
     def _binop(self, other, fn: Callable, out_kind=None) -> "Series":
         c = self._col
-        if c.dtype.is_dictionary:
+        if c.dtype.is_dictionary or c.dtype.is_bytes:
             raise TypeError_("math on string series requires codes/decode")
         if isinstance(other, Series):
             o, ov = other._col.data, other._col.validity
@@ -131,12 +131,51 @@ class Series:
     def __neg__(self): return self._binop(0, lambda a, _: jnp.negative(a))
     def __abs__(self): return self._binop(0, lambda a, _: jnp.abs(a))
 
-    def __eq__(self, o): return self._binop(o, jnp.equal, dtypes.bool_)    # noqa: E501
-    def __ne__(self, o): return self._binop(o, jnp.not_equal, dtypes.bool_)
-    def __lt__(self, o): return self._binop(o, jnp.less, dtypes.bool_)
-    def __le__(self, o): return self._binop(o, jnp.less_equal, dtypes.bool_)
-    def __gt__(self, o): return self._binop(o, jnp.greater, dtypes.bool_)
-    def __ge__(self, o): return self._binop(o, jnp.greater_equal, dtypes.bool_)
+    def _cmp_op(self, o, name: str, fn: Callable) -> "Series":
+        """Comparison dispatch: device-bytes string columns compare by
+        big-endian word order on device (bytewise string order — the
+        binary-comparator role of ``arrow_comparator.cpp``); everything
+        else goes through the elementwise engine."""
+        c = self._col
+        if c.dtype.is_bytes and isinstance(o, str):
+            from cylon_tpu.ops import bytescol
+
+            lt, eq = bytescol.cmp_scalar(c, o)
+            m = {"eq": eq, "ne": ~eq, "lt": lt, "le": lt | eq,
+                 "gt": ~(lt | eq), "ge": ~lt}[name]
+            if c.validity is not None:
+                # pandas null semantics: null != x is True, every other
+                # comparison with null is False
+                m = (m | ~c.validity) if name == "ne" else (m & c.validity)
+            return Series._wrap(Column(m, None, dtypes.bool_),
+                                self._nrows, self.name)
+        if c.dtype.is_bytes and isinstance(o, (Series, Column)) \
+                and name in ("eq", "ne"):
+            from cylon_tpu.ops import bytescol
+
+            oc = o._col if isinstance(o, Series) else o
+            if oc.dtype.is_bytes or oc.dtype.is_dictionary:
+                ca, cb = bytescol.align_storages([c, oc])
+                m = (ca.data == cb.data).all(axis=1)
+                bothv = None
+                for v in (ca.validity, cb.validity):
+                    if v is not None:
+                        bothv = v if bothv is None else (bothv & v)
+                if bothv is not None:
+                    m = m & bothv
+                if name == "ne":
+                    m = ~m  # null != anything -> True (pandas parity,
+                    #         same rule as the scalar path above)
+                return Series._wrap(Column(m, None, dtypes.bool_),
+                                    self._nrows, self.name)
+        return self._binop(o, fn, dtypes.bool_)
+
+    def __eq__(self, o): return self._cmp_op(o, "eq", jnp.equal)
+    def __ne__(self, o): return self._cmp_op(o, "ne", jnp.not_equal)
+    def __lt__(self, o): return self._cmp_op(o, "lt", jnp.less)
+    def __le__(self, o): return self._cmp_op(o, "le", jnp.less_equal)
+    def __gt__(self, o): return self._cmp_op(o, "gt", jnp.greater)
+    def __ge__(self, o): return self._cmp_op(o, "ge", jnp.greater_equal)
 
     def __and__(self, o): return self._binop(o, jnp.logical_and, dtypes.bool_)
     def __or__(self, o): return self._binop(o, jnp.logical_or, dtypes.bool_)
@@ -171,6 +210,11 @@ class Series:
 
     def fillna(self, value) -> "Series":
         c = self._col
+        if c.dtype.is_bytes:
+            from cylon_tpu.ops import bytescol
+
+            return Series._wrap(bytescol.fill_value(c, value), self._nrows,
+                                self.name)
         if c.dtype.is_dictionary:
             from cylon_tpu.ops.dictenc import encode_fill_value
 
@@ -206,6 +250,12 @@ class Series:
         """Parity: ``compute.pyx`` is_in (:702)."""
         c = self._col
         vset = list(values)
+        if c.dtype.is_bytes:
+            from cylon_tpu.ops import bytescol
+
+            mask = bytescol.isin(c, vset)
+            return Series._wrap(Column(mask, None, dtypes.bool_),
+                                self._nrows, self.name)
         if c.dtype.is_dictionary:
             dvals = [] if c.dictionary is None else c.dictionary.values
             lut = {v: i for i, v in enumerate(dvals)}
@@ -232,25 +282,55 @@ class Series:
         vals = [] if c.dictionary is None else list(c.dictionary.values)
         return self.isin([v for v in vals if pred(v)])
 
+    def _bytes_pred(self, mask) -> "Series":
+        return Series._wrap(Column(mask, None, dtypes.bool_), self._nrows,
+                            self.name)
+
     def str_startswith(self, prefix: str) -> "Series":
         """Rows whose value starts with ``prefix`` (pandas
-        ``Series.str.startswith``; always literal)."""
+        ``Series.str.startswith``; always literal). Device-bytes
+        columns run the windowed byte compare on device
+        (:func:`bytescol.startswith`) — no host dictionary scan."""
+        if self._col.dtype.is_bytes:
+            from cylon_tpu.ops import bytescol
+
+            return self._bytes_pred(bytescol.startswith(self._col, prefix))
         return self._dict_pred(lambda v: v is not None
                                and str(v).startswith(prefix))
 
     def str_endswith(self, suffix: str) -> "Series":
         """Rows whose value ends with ``suffix`` (pandas
         ``Series.str.endswith``; always literal)."""
+        if self._col.dtype.is_bytes:
+            from cylon_tpu.ops import bytescol
+
+            return self._bytes_pred(bytescol.endswith(self._col, suffix))
         return self._dict_pred(lambda v: v is not None
                                and str(v).endswith(suffix))
 
     def str_contains(self, pat: str, regex: bool = True) -> "Series":
         """Rows whose value contains ``pat`` — a regex by default, same
         as pandas ``Series.str.contains``; pass ``regex=False`` for
-        literal substring matching."""
-        if regex:
-            import re
+        literal substring matching. Device-bytes columns: literal
+        patterns (and regexes with no metacharacters) run the shifted
+        window compare on device; a true regex decodes to host (the one
+        string op with no device form)."""
+        import re
 
+        if self._col.dtype.is_bytes:
+            from cylon_tpu.ops import bytescol
+
+            if not regex or not re.search(r"[.^$*+?{}\[\]\\|()]", pat):
+                return self._bytes_pred(bytescol.contains(self._col, pat))
+            rx = re.compile(pat)
+            self._require_local("str_contains(regex) on device bytes")
+            host = self.to_numpy()
+            hits = np.array([v is not None and rx.search(str(v)) is not None
+                             for v in host], bool)
+            mask = jnp.zeros(self._col.capacity, bool
+                             ).at[:len(hits)].set(jnp.asarray(hits))
+            return self._bytes_pred(mask)
+        if regex:
             rx = re.compile(pat)
             return self._dict_pred(lambda v: v is not None
                                    and rx.search(str(v)) is not None)
@@ -262,6 +342,16 @@ class Series:
         falls back to a host round-trip like the reference's inferred
         python loop."""
         c = self._col
+        if c.dtype.is_bytes:
+            # arbitrary python fn over variable-length values: host
+            # round trip (decode, map, re-ingest as bytes)
+            self._require_local("map() on device bytes")
+            host = np.array([fn(v) for v in self.to_numpy()], object)
+            col = Column.from_numpy(host, c.capacity,
+                                    string_storage="bytes") \
+                if all(isinstance(v, str) or v is None for v in host) \
+                else Column.from_numpy(host, c.capacity)
+            return Series._wrap(col, self._nrows, self.name)
         if c.dtype.is_dictionary:
             from cylon_tpu.ops.dictenc import reencode_values
 

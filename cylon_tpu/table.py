@@ -193,18 +193,33 @@ class Table:
 
     # -- host bridges ----------------------------------------------------
     @staticmethod
-    def from_pydict(data: Mapping[str, object], capacity: int | None = None) -> "Table":
-        """Parity: ``table.pyx`` from_pydict."""
+    def _storage_of(string_storage, name: str) -> str:
+        """Resolve a per-column storage request: a plain string applies
+        to every string column; a dict maps column name -> storage with
+        ``"dict"`` as the default."""
+        if isinstance(string_storage, Mapping):
+            return string_storage.get(name, "dict")
+        return string_storage
+
+    @staticmethod
+    def from_pydict(data: Mapping[str, object], capacity: int | None = None,
+                    string_storage="dict") -> "Table":
+        """Parity: ``table.pyx`` from_pydict. ``string_storage``:
+        "dict"/"bytes"/"auto" or a per-column-name mapping (see
+        :meth:`Column.from_numpy`)."""
         arrays = {n: np.asarray(v) for n, v in data.items()}
         n = len(next(iter(arrays.values()))) if arrays else 0
         for name, a in arrays.items():
             if len(a) != n:
                 raise InvalidArgument(f"column {name} length {len(a)} != {n}")
-        cols = {name: Column.from_numpy(a, capacity) for name, a in arrays.items()}
+        cols = {name: Column.from_numpy(
+            a, capacity, Table._storage_of(string_storage, name))
+            for name, a in arrays.items()}
         return Table(cols, n)
 
     @staticmethod
-    def from_pandas(df, capacity: int | None = None) -> "Table":
+    def from_pandas(df, capacity: int | None = None,
+                    string_storage="dict") -> "Table":
         """Parity: ``table.pyx`` from_pandas."""
         data = {}
         for name in df.columns:
@@ -220,11 +235,14 @@ class Table:
                     col = Column(col.data, jnp.asarray(v), col.dtype, col.dictionary)
                 data[str(name)] = col
                 continue
-            data[str(name)] = Column.from_numpy(s.to_numpy(), capacity)
+            data[str(name)] = Column.from_numpy(
+                s.to_numpy(), capacity,
+                Table._storage_of(string_storage, str(name)))
         return Table(data, len(df))
 
     @staticmethod
-    def from_arrow(atable, capacity: int | None = None) -> "Table":
+    def from_arrow(atable, capacity: int | None = None,
+                   string_storage="dict") -> "Table":
         """Parity: ``table.pyx`` from_arrow."""
         import pyarrow as pa
         import pyarrow.compute as pc
@@ -232,6 +250,12 @@ class Table:
         cols = {}
         for name in atable.column_names:
             arr = atable.column(name).combine_chunks()
+            if pa.types.is_string(arr.type) or pa.types.is_large_string(
+                    arr.type):
+                cols[str(name)] = Column.from_numpy(
+                    arr.to_numpy(zero_copy_only=False), capacity,
+                    Table._storage_of(string_storage, str(name)))
+                continue
             # Nullable int/bool: keep the logical type, carry Arrow's null
             # mask as validity (to_numpy alone would coerce to float64+NaN).
             if arr.null_count and (pa.types.is_integer(arr.type)
