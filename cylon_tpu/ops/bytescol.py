@@ -251,12 +251,15 @@ def byte_matrix(data: jax.Array) -> jax.Array:
     return b.reshape(data.shape[0], -1).astype(jnp.int32)
 
 
+def _lengths_of(b: jax.Array) -> jax.Array:
+    """[cap] int32 byte length from an already-built byte matrix."""
+    idx = jnp.arange(1, b.shape[1] + 1, dtype=jnp.int32)
+    return jnp.max(jnp.where(b != 0, idx, 0), axis=1)
+
+
 def row_lengths(data: jax.Array) -> jax.Array:
     """[cap] int32 byte length per row (offset of last non-zero byte)."""
-    b = byte_matrix(data)
-    width = b.shape[1]
-    idx = jnp.arange(1, width + 1, dtype=jnp.int32)
-    return jnp.max(jnp.where(b != 0, idx, 0), axis=1)
+    return _lengths_of(byte_matrix(data))
 
 
 def _pat_bytes(pat: str) -> np.ndarray:
@@ -289,7 +292,7 @@ def endswith(col: Column, suffix: str) -> jax.Array:
     b = byte_matrix(col.data)
     if m > b.shape[1]:
         return jnp.zeros(col.capacity, bool)
-    ln = row_lengths(col.data)
+    ln = _lengths_of(b)
     # per-row window [ln-m, ln): one take_along_axis of m lanes
     pos = ln[:, None] - m + jnp.arange(m, dtype=jnp.int32)[None, :]
     safe = jnp.clip(pos, 0, b.shape[1] - 1)
@@ -298,26 +301,55 @@ def endswith(col: Column, suffix: str) -> jax.Array:
     return _and_valid(col, mask)
 
 
-def contains(col: Column, pat: str) -> jax.Array:
-    """Literal substring search: all O(width) shifted windows compared at
-    once — elementwise work on the MXU-adjacent VPU, no per-row loop."""
-    patb = _pat_bytes(pat)
+def _windows(b: jax.Array, patb: np.ndarray, ln: jax.Array) -> jax.Array:
+    """[cap, width-m+1] bool — pattern match at every start offset (all
+    shifted windows compared at once — elementwise VPU work, no per-row
+    loop). Starts whose window would extend past the row length are
+    False."""
     m = len(patb)
-    if m == 0:
-        return _all_valid(col)
-    b = byte_matrix(col.data)
-    width = b.shape[1]
-    if m > width:
-        return jnp.zeros(col.capacity, bool)
-    nwin = width - m + 1
-    acc = jnp.ones((col.capacity, nwin), bool)
+    nwin = b.shape[1] - m + 1
+    acc = jnp.ones((b.shape[0], nwin), bool)
     for j in range(m):
         acc = acc & (b[:, j:j + nwin] == jnp.int32(patb[j]))
-    # a match may not extend into the zero padding: start <= len - m
-    ln = row_lengths(col.data)
     ok = jnp.arange(nwin, dtype=jnp.int32)[None, :] <= (ln[:, None] - m)
-    mask = (acc & ok).any(axis=1)
+    return acc & ok
+
+
+def contains(col: Column, pat: str) -> jax.Array:
+    """Literal substring search."""
+    patb = _pat_bytes(pat)
+    if len(patb) == 0:
+        return _all_valid(col)
+    b = byte_matrix(col.data)
+    if len(patb) > b.shape[1]:
+        return jnp.zeros(col.capacity, bool)
+    mask = _windows(b, patb, _lengths_of(b)).any(axis=1)
     return _and_valid(col, mask)
+
+
+def contains_seq(col: Column, first: str, second: str) -> jax.Array:
+    """SQL ``LIKE '%first%second%'``: ``second`` must occur AFTER the
+    first occurrence of ``first`` (the TPC-H Q13/Q16 comment predicate
+    — on the reference this is a per-value host scan over the
+    dictionary; here it is two window-compare passes on device, so it
+    works when the comment column's value set IS the dataset)."""
+    p1, p2 = _pat_bytes(first), _pat_bytes(second)
+    if len(p1) == 0:
+        return contains(col, second)
+    if len(p2) == 0:
+        return contains(col, first)
+    b = byte_matrix(col.data)
+    if len(p1) + len(p2) > b.shape[1]:
+        return jnp.zeros(col.capacity, bool)
+    ln = _lengths_of(b)  # reuse b — it is the big intermediate
+    m1 = _windows(b, p1, ln)
+    m2 = _windows(b, p2, ln)
+    has1 = m1.any(axis=1)
+    first_pos = jnp.argmax(m1, axis=1)  # first matching start
+    thresh = first_pos + len(p1)
+    starts2 = jnp.arange(m2.shape[1], dtype=jnp.int32)[None, :]
+    ok2 = (m2 & (starts2 >= thresh[:, None])).any(axis=1)
+    return _and_valid(col, has1 & ok2)
 
 
 def cmp_scalar(col: Column, value: str) -> tuple[jax.Array, jax.Array]:

@@ -44,8 +44,10 @@ def compiled(q):
         # device coercion is a host-side step — it must happen before
         # tracing (Table.from_pydict can't consume tracers)
         from cylon_tpu.frame import DataFrame
+        from cylon_tpu.tpch.queries import TPCH_STRING_STORAGE
 
-        data = {k: v if isinstance(v, DataFrame) else DataFrame(v)
+        data = {k: v if isinstance(v, DataFrame)
+                else DataFrame(v, string_storage=TPCH_STRING_STORAGE)
                 for k, v in data.items()}
         return cq(data, **kw)
 

@@ -47,10 +47,18 @@ def _scalar(x):
     return float(x)
 
 
+#: ingest policy for raw dbgen mappings: near-unique text columns take
+#: DEVICE BYTES (no host dictionary — at SF1 o_comment alone is ~1.5M
+#: distinct values, the "dictionary IS the dataset" case); every other
+#: string column is low-cardinality and keeps dictionary codes
+TPCH_STRING_STORAGE = {"o_comment": "bytes", "s_comment": "bytes",
+                       "l_comment": "bytes"}
+
+
 def _df(x) -> DataFrame:
     if isinstance(x, DataFrame):
         return x
-    return DataFrame(x)
+    return DataFrame(x, string_storage=TPCH_STRING_STORAGE)
 
 
 def _tables(data: Mapping, names, env=None) -> list[DataFrame]:
@@ -112,6 +120,20 @@ def _dict_mask(col, values=None, pred=None) -> jnp.ndarray:
     if col.validity is not None:
         m = m & col.validity
     return m
+
+
+def _like_seq(col, w1: str, w2: str) -> jnp.ndarray:
+    """[capacity] bool mask for ``LIKE '%w1%w2%'`` (w2 after the first
+    w1), dispatched by string storage: device window compares for bytes
+    columns (:func:`bytescol.contains_seq` — no host value scan exists
+    for them), host dictionary predicate for coded columns."""
+    if col.dtype.is_bytes:
+        from cylon_tpu.ops import bytescol
+
+        return bytescol.contains_seq(col, w1, w2)
+    return _dict_mask(
+        col, pred=lambda v: v is not None and w1 in str(v)
+        and w2 in str(v)[str(v).index(w1) + len(w1):])
 
 
 def _with_revenue(li: DataFrame) -> DataFrame:
@@ -869,10 +891,7 @@ def q13(data: Mapping, env=None, word1: str = "special",
     """
     customer, orders = _tables(data, ["customer", "orders"], env)
 
-    keep = ~_dict_mask(
-        orders.table.column("o_comment"),
-        pred=lambda v: v is not None and word1 in str(v)
-        and word2 in str(v)[str(v).index(word1) + len(word1):])
+    keep = ~_like_seq(orders.table.column("o_comment"), word1, word2)
     ords = _filt(orders, keep, env)[["o_orderkey", "o_custkey"]]
     j = customer[["c_custkey"]].merge(
         ords, left_on="c_custkey", right_on="o_custkey", how="left",
@@ -983,10 +1002,8 @@ def q16(data: Mapping, env=None, brand: str = "Brand#45",
     part, partsupp, supplier = _tables(
         data, ["part", "partsupp", "supplier"], env)
 
-    good = _filt(supplier, ~_dict_mask(
-        supplier.table.column("s_comment"),
-        pred=lambda v: v is not None and "Customer" in str(v)
-        and "Complaints" in str(v)[str(v).index("Customer"):]), env)
+    good = _filt(supplier, ~_like_seq(
+        supplier.table.column("s_comment"), "Customer", "Complaints"), env)
     good = good[["s_suppkey"]]
     sizes_arr = jnp.asarray(np.asarray(sizes, np.int64))
     t = part.table
