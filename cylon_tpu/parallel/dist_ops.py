@@ -15,6 +15,7 @@ setup, no edge/sequence ids, no progress threads (contrast
 """
 
 import functools
+import os
 from typing import Sequence
 
 import jax
@@ -398,7 +399,8 @@ def dist_join(env: CylonEnv, left: Table, right: Table, *,
     fused XLA program; world==1 short-circuits to the local join like
     the reference's ``world==1`` branch at table.cpp:481)."""
     left_on, right_on = _normalize_join_keys(on, left_on, right_on)
-    if env.world_size == 1:
+    force_dist = os.environ.get("CYLON_TPU_FORCE_DIST", "") in ("1", "on")
+    if env.world_size == 1 and not force_dist:
         lt = dtable.gather_table(env, left) if dtable.is_distributed(left) else left
         rt = dtable.gather_table(env, right) if dtable.is_distributed(right) else right
 

@@ -17,6 +17,19 @@ from cylon_tpu.tpch.queries import (q1, q2, q3, q4, q5, q6, q7, q8, q9,
 _COMPILED: dict = {}
 
 
+def ingest(data) -> dict:
+    """Raw dbgen mapping -> DataFrames under the TPC-H string-storage
+    policy (comment columns as device bytes). The ONE place the policy
+    is applied — queries, the compiled wrapper and the benches all
+    route through it."""
+    from cylon_tpu.frame import DataFrame
+    from cylon_tpu.tpch.queries import TPCH_STRING_STORAGE
+
+    return {k: v if isinstance(v, DataFrame)
+            else DataFrame(v, string_storage=TPCH_STRING_STORAGE)
+            for k, v in data.items()}
+
+
 def compiled(q):
     """Whole-query-compiled variant of a TPC-H query: the entire
     multi-operator pipeline traces into ONE XLA program
@@ -43,16 +56,10 @@ def compiled(q):
     def run(data, **kw):
         # device coercion is a host-side step — it must happen before
         # tracing (Table.from_pydict can't consume tracers)
-        from cylon_tpu.frame import DataFrame
-        from cylon_tpu.tpch.queries import TPCH_STRING_STORAGE
-
-        data = {k: v if isinstance(v, DataFrame)
-                else DataFrame(v, string_storage=TPCH_STRING_STORAGE)
-                for k, v in data.items()}
-        return cq(data, **kw)
+        return cq(ingest(data), **kw)
 
     return run
 
 
-__all__ = ["generate", "generate_pandas", "date_int", "compiled"] + [
-    f"q{i}" for i in range(1, 23)]
+__all__ = ["generate", "generate_pandas", "date_int", "compiled",
+           "ingest"] + [f"q{i}" for i in range(1, 23)]

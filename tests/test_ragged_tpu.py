@@ -1,0 +1,72 @@
+"""Runtime proof of the ragged exchange on real TPU hardware.
+
+The flagship ``lax.ragged_all_to_all`` path (``parallel/shuffle.py``) is
+selected only on a TPU mesh; every CPU test runs the padded path and
+every real-chip op short-circuits at world==1. This test forces
+``CYLON_TPU_SHUFFLE=ragged`` + ``CYLON_TPU_FORCE_DIST=1`` on a 1-device
+TPU mesh, so the ragged collective, the 64-bit transport split and
+Pallas-under-shard_map execute on real Mosaic with a pandas parity
+check. (Parity role: the reference's exchange runs under every mpirun
+test, ``cpp/test/CMakeLists.txt:44-50``.)
+
+Runs in a SUBPROCESS (this pytest process is pinned to the CPU backend
+by conftest) and only when ``CYLON_TEST_TPU=1``: the axon chip is an
+exclusive lease, so grabbing it mid-suite would collide with any
+concurrent bench run. ``bench_suite.py``'s TPU section exercises the
+same path on every full bench run.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+_WORKER = r"""
+import os
+os.environ["CYLON_TPU_SHUFFLE"] = "ragged"
+os.environ["CYLON_TPU_FORCE_DIST"] = "1"
+import numpy as np
+import pandas as pd
+import jax
+import cylon_tpu as ct
+from cylon_tpu.table import Table
+from cylon_tpu.parallel import dist_join, dtable, shuffle
+
+assert jax.devices()[0].platform != "cpu", jax.devices()
+env = ct.CylonEnv(ct.TPUConfig(n_devices=1))
+rng = np.random.default_rng(3)
+n = 20_000
+keys = rng.integers(0, n, n).astype(np.int64)
+vals = rng.normal(size=n)
+com = np.array([f"row {i} of the ragged exchange" for i in range(n)], object)
+t = Table.from_pydict({"k": keys, "v": vals, "s": com},
+                      string_storage="bytes")
+sh = shuffle(env, t, ["k"])
+got = dtable.dist_to_pandas(env, sh).sort_values(["k", "v"]).reset_index(drop=True)
+exp = pd.DataFrame({"k": keys, "v": vals, "s": com}).sort_values(
+    ["k", "v"]).reset_index(drop=True)
+pd.testing.assert_frame_equal(got, exp)
+print("RAGGED_SHUFFLE_OK")
+
+rk = rng.integers(0, n, n // 2).astype(np.int64)
+rv = rng.normal(size=n // 2)
+j = dist_join(env, t.select(["k", "v"]),
+              Table.from_pydict({"k": rk, "w": rv}), on="k")
+gj = dtable.dist_to_pandas(env, j)
+ej = pd.DataFrame({"k": keys, "v": vals}).merge(
+    pd.DataFrame({"k": rk, "w": rv}), on="k")
+assert len(gj) == len(ej), (len(gj), len(ej))
+print("RAGGED_DIST_JOIN_OK")
+"""
+
+
+@pytest.mark.skipif(os.environ.get("CYLON_TEST_TPU") != "1",
+                    reason="TPU lease is exclusive; set CYLON_TEST_TPU=1")
+def test_ragged_exchange_on_tpu():
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    env.pop("XLA_FLAGS", None)
+    out = subprocess.run([sys.executable, "-c", _WORKER], env=env,
+                         capture_output=True, text=True, timeout=900)
+    assert "RAGGED_SHUFFLE_OK" in out.stdout, (out.stdout, out.stderr)
+    assert "RAGGED_DIST_JOIN_OK" in out.stdout, (out.stdout, out.stderr)
