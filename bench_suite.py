@@ -137,35 +137,7 @@ def main():
     from cylon_tpu import tpch
     from cylon_tpu.tpch import dbgen
 
-    data = dbgen.generate(sf=sf, seed=0)
-    # tables pre-ingested once (the reference's TPC-H timing also runs
-    # on loaded tables); tpch.ingest applies the storage policy
-    # (comment columns as device bytes — at SF>=1 a host dictionary
-    # for them would be the dataset)
-    dfs = tpch.ingest(data)
-    # CYLON_BENCH_TPCH_QUERIES="q1,q3,q5,q6" restricts the suite (the
-    # SF10 runs time the numeric-heavy subset; full suite at SF<=1)
-    only = os.environ.get("CYLON_BENCH_TPCH_QUERIES")
-    only = set(only.split(",")) if only else None
-    scalar_q = ("q6", "q14", "q17", "q19")
-    frame_q = [f"q{i}" for i in range(1, 23)
-               if f"q{i}" not in scalar_q]
-    for qname in frame_q:
-        if only is not None and qname not in only:
-            continue
-        qfn = tpch.compiled(qname)
-        res = {}
-        t = _timeit(lambda: res.__setitem__("r", qfn(dfs)),
-                    lambda: res["r"].table.nrows, reps)
-        _emit(f"tpch_{qname}_sf{sf}_wall", t * 1e3, "ms")
-    for qname in scalar_q:
-        if only is not None and qname not in only:
-            continue
-        qfn = tpch.compiled(qname)
-        res = {}
-        t = _timeit(lambda: res.__setitem__("r", np.float64(qfn(dfs))),
-                    lambda: res["r"], reps)
-        _emit(f"tpch_{qname}_sf{sf}_wall", t * 1e3, "ms")
+    _run_tpch(sf, reps)
 
     # 6. TPU ragged exchange: the flagship lax.ragged_all_to_all path,
     # runtime-proven on the real chip (W=1 mesh still compiles and
@@ -182,6 +154,117 @@ def main():
                               + " --xla_force_host_platform_device_count=8")
     subprocess.run([sys.executable, os.path.abspath(__file__),
                     "--exchange"], env=child_env, check=False)
+
+
+def _hbm_stats(tag: str):
+    """Emit device memory headroom (HBM on TPU) — the scale runs track
+    how close each config sits to the 16 GB ceiling."""
+    import jax
+
+    try:
+        st = jax.local_devices()[0].memory_stats() or {}
+    except Exception:
+        st = {}
+    used = st.get("bytes_in_use")
+    limit = st.get("bytes_limit")
+    if used is not None:
+        _emit(f"hbm_in_use_{tag}", used / 2**30, "GiB")
+    if used is not None and limit:
+        _emit(f"hbm_headroom_{tag}", (limit - used) / 2**30, "GiB")
+
+
+def _run_tpch(sf, reps, tag_hbm: bool = False):
+    """Time the (whole-query-compiled) TPC-H suite at scale factor
+    ``sf``. CYLON_BENCH_TPCH_QUERIES="q1,q3,q5,q6" restricts the set
+    (the SF10 runs time the numeric-heavy subset; full suite at
+    SF<=1). Emits regrow events: any query whose capacity ladder
+    settled above 1x reports its final scale."""
+    import numpy as np
+
+    from cylon_tpu import tpch
+    from cylon_tpu.tpch import dbgen
+
+    data = dbgen.generate(sf=sf, seed=0)
+    # tables pre-ingested once (the reference's TPC-H timing also runs
+    # on loaded tables); tpch.ingest applies the storage policy
+    # (comment columns as device bytes — at SF>=1 a host dictionary
+    # for them would be the dataset)
+    dfs = tpch.ingest(data)
+    if tag_hbm:
+        _hbm_stats(f"tpch_sf{sf}_ingest")
+    only = os.environ.get("CYLON_BENCH_TPCH_QUERIES")
+    only = set(only.split(",")) if only else None
+    scalar_q = ("q6", "q14", "q17", "q19")
+    names = [f"q{i}" for i in range(1, 23)]
+    for qname in names:
+        if only is not None and qname not in only:
+            continue
+        qfn = tpch.compiled(qname)
+        res = {}
+        if qname in scalar_q:
+            t = _timeit(lambda: res.__setitem__("r", np.float64(qfn(dfs))),
+                        lambda: res["r"], reps)
+        else:
+            t = _timeit(lambda: res.__setitem__("r", qfn(dfs)),
+                        lambda: res["r"].table.nrows, reps)
+        _emit(f"tpch_{qname}_sf{sf}_wall", t * 1e3, "ms")
+    # regrow events: CompiledQuery memoizes the scale each (query,
+    # shape) settled at — >1 means the capacity ladder re-dispatched
+    for fn, cq in tpch._COMPILED.items():
+        memo = getattr(cq, "_scale_memo", {})
+        worst = max(memo.values(), default=1)
+        if worst > 1:
+            _emit(f"tpch_{fn.__name__}_sf{sf}_regrow_scale", worst, "x")
+    if tag_hbm:
+        _hbm_stats(f"tpch_sf{sf}_end")
+
+
+def scale_main():
+    """--scale: the at-scale proof runs (VERDICT r3 missing #2) on the
+    real chip — TPC-H at CYLON_BENCH_TPCH_SF (1 / 10) and the
+    BASELINE.json larger join/sort configs at CYLON_BENCH_ROWS
+    (10M / 100M), with HBM headroom tracked per stage."""
+    import jax
+
+    import cylon_tpu as ct  # noqa: F401  (enables x64 + cache)
+    from cylon_tpu import Table
+    from cylon_tpu.ops.join import join
+    from cylon_tpu.ops.selection import sort_table
+
+    reps = int(os.environ.get("CYLON_BENCH_REPS", 2))
+    n = int(os.environ.get("CYLON_BENCH_ROWS", 0))
+    sf = float(os.environ.get("CYLON_BENCH_TPCH_SF", 0))
+    rng = np.random.default_rng(7)
+    out = {}
+
+    if n:
+        left = Table.from_pydict(
+            {"k": rng.integers(0, n, n).astype(np.int64),
+             "a": rng.normal(size=n)})
+        right = Table.from_pydict(
+            {"k": rng.integers(0, n, n).astype(np.int64),
+             "b": rng.normal(size=n)})
+        _hbm_stats(f"join_{n}_ingest")
+        f1 = jax.jit(lambda l, r: join(l, r, on="k", how="inner",
+                                       out_capacity=2 * n))
+        t = _timeit(lambda: out.__setitem__("r", f1(left, right)),
+                    lambda: out["r"].nrows, reps)
+        _emit(f"local_inner_merge_{n}_rows_per_sec", n / t, "rows/s",
+              1e9 / 4.0 / 64)
+        _hbm_stats(f"join_{n}_end")
+        del left, right, out["r"]
+
+        st = Table.from_pydict(
+            {"k": rng.integers(0, 2**40, n).astype(np.int64)})
+        f2 = jax.jit(lambda tt: sort_table(tt, ["k"]))
+        t = _timeit(lambda: out.__setitem__("s", f2(st)),
+                    lambda: out["s"].column("k").data[:1], reps)
+        _emit(f"sort_{n}_rows_per_sec", n / t, "rows/s")
+        _hbm_stats(f"sort_{n}_end")
+        del st, out["s"]
+
+    if sf:
+        _run_tpch(sf, reps, tag_hbm=True)
 
 
 def tpu_exchange_main():
@@ -313,5 +396,7 @@ def exchange_main():
 if __name__ == "__main__":
     if "--exchange" in sys.argv:
         exchange_main()
+    elif "--scale" in sys.argv:
+        scale_main()
     else:
         main()

@@ -62,6 +62,7 @@ from cylon_tpu.config import (
     JoinAlgorithm,
     JoinConfig,
     JoinType,
+    ParquetOptions,
     SortOptions,
 )
 from cylon_tpu.context import CylonEnv, TPUConfig, LocalConfig
@@ -88,6 +89,7 @@ __all__ = [
     "Column",
     "CSVReadOptions",
     "CSVWriteOptions",
+    "ParquetOptions",
     "CylonEnv",
     "CylonError",
     "Code",

@@ -134,3 +134,33 @@ class CSVWriteOptions:
 
     delimiter: str = ","
     include_header: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class ParquetOptions:
+    """Parity: ``io/parquet_config.hpp`` ParquetOptions — ChunkSize /
+    ConcurrentFileReads, with the WriterProperties indirection flattened
+    into the properties users actually set through it (compression,
+    row-group size, dictionary encoding, column subset on write).
+
+    Read side: ``concurrent_file_reads`` toggles the per-file thread
+    pool (reference spawns a std::thread per file, table.cpp:1121-1127);
+    ``use_cols`` restricts the columns read. Write side maps onto
+    pyarrow's writer.
+    """
+
+    # read
+    concurrent_file_reads: bool = True
+    use_cols: Sequence[str] | None = None
+    # write (WriterProperties flattened)
+    compression: str = "snappy"      # "none"|"snappy"|"gzip"|"zstd"|...
+    row_group_size: int | None = None  # rows per row group (ChunkSize)
+    use_dictionary: bool = True
+    write_cols: Sequence[str] | None = None  # column subset on write
+
+    def __hash__(self):
+        def h(v):
+            return tuple(v) if isinstance(v, (list, tuple)) else v
+
+        return hash(tuple(h(getattr(self, f.name))
+                          for f in dataclasses.fields(self)))

@@ -654,15 +654,52 @@ class DataFrame:
         if self._index is not None or other._index is not None:
             a, b = self.to_pandas(), other.to_pandas()
             return bool(a.equals(b))
-        from cylon_tpu.ops.setops import equal_tables
+        import numpy as np
+
+        from cylon_tpu.ops.setops import (align_for_equal,
+                                          dist_ordered_equal_compiled,
+                                          equal_tables)
         from cylon_tpu.parallel import dtable
 
-        ta = dtable.gather_table(None, self._table)
-        tb = dtable.gather_table(None, other._table)
+        ta, tb = self._table, other._table
+        if ta.column_names != tb.column_names:
+            return False
         for n in ta.column_names:
-            if n not in tb.column_names or \
-                    ta.column(n).dtype != tb.column(n).dtype:
+            da, db = ta.column(n).dtype, tb.column(n).dtype
+            stringish = ((da.is_bytes or da.is_dictionary)
+                         and (db.is_bytes or db.is_dictionary))
+            if da != db and not stringish:
+                # framework dtype mismatch (e.g. a nullable-int column
+                # vs its to_pandas round trip, re-ingested as strings):
+                # pandas decides value equality, not the device layout
+                # (ADVICE r3)
+                return bool(self.to_pandas().equals(other.to_pandas()))
+        if (dtable.is_distributed(ta) and dtable.is_distributed(tb)
+                and ta.capacity == tb.capacity
+                and dtable.num_shards(ta) == dtable.num_shards(tb)):
+            # same shard layout: compare SHARD-LOCAL — elementwise on
+            # the sharded arrays, one scalar reduce, no gather. ONE
+            # count fetch per table (each RPC is ~100 ms tunneled)
+            # serves the overflow check and the layout decision, and
+            # string-storage alignment waits until the compare is
+            # actually going to run on these layouts.
+            ca, cb = dtable.host_counts(ta), dtable.host_counts(tb)
+            cap_l = dtable.local_capacity(ta)
+            if (ca > cap_l).any() or (cb > cap_l).any():
+                dtable.dist_num_rows(ta)  # raises with the poisoned
+                dtable.dist_num_rows(tb)  # shard's counts
+            if ca.sum() != cb.sum():
                 return False
+            if (ca == cb).all():
+                aligned = align_for_equal(ta, tb)
+                if aligned is None:
+                    return False
+                return bool(np.asarray(
+                    dist_ordered_equal_compiled(*aligned)))
+            # equal totals but different shard boundaries: positional
+            # equality needs the concatenated view — gather fallback
+        ta = dtable.gather_table(None, ta)
+        tb = dtable.gather_table(None, tb)
         return equal_tables(ta, tb, ordered=True)
 
     def isin(self, values: Sequence) -> "DataFrame":

@@ -562,18 +562,27 @@ def write_csv_sharded(df, paths: Sequence[str], env,
 
 
 def read_parquet(paths, env=None, capacity: int | None = None,
-                 columns: Sequence[str] | None = None):
+                 columns: Sequence[str] | None = None,
+                 options: "ParquetOptions | None" = None,
+                 string_storage="dict"):
     """Parity: ``FromParquet`` (table.cpp:1121, behind CYLON_PARQUET —
-    here always available via pyarrow)."""
+    here always available via pyarrow). ``options`` is the
+    :class:`cylon_tpu.config.ParquetOptions` builder mirror
+    (``io/parquet_config.hpp``)."""
     import pyarrow.parquet as pq
 
+    from cylon_tpu.config import ParquetOptions
     from cylon_tpu.frame import DataFrame
 
+    options = options or ParquetOptions()
+    if columns is None:
+        columns = options.use_cols
     single = isinstance(paths, (str, bytes))
     path_list = [paths] if single else list(paths)
     try:
-        if len(path_list) == 1:
-            atables = [pq.read_table(path_list[0], columns=columns)]
+        if len(path_list) == 1 or not options.concurrent_file_reads:
+            atables = [pq.read_table(p, columns=columns)
+                       for p in path_list]
         else:
             with ThreadPoolExecutor(max_workers=min(8, len(path_list))) as ex:
                 atables = list(ex.map(
@@ -583,7 +592,7 @@ def read_parquet(paths, env=None, capacity: int | None = None,
     import pyarrow as pa
 
     at = pa.concat_tables(atables) if len(atables) > 1 else atables[0]
-    t = Table.from_arrow(at, capacity)
+    t = Table.from_arrow(at, capacity, string_storage)
     df = DataFrame._wrap(t)
     if env is not None:
         from cylon_tpu.parallel import scatter_table
@@ -592,12 +601,24 @@ def read_parquet(paths, env=None, capacity: int | None = None,
     return df
 
 
-def write_parquet(df, path):
-    """Parity: ``WriteParquet`` (table.cpp:1148)."""
+def write_parquet(df, path, options: "ParquetOptions | None" = None):
+    """Parity: ``WriteParquet`` (table.cpp:1148) with the
+    ``ParquetOptions`` writer properties (compression, row-group size,
+    dictionary encoding, column subset)."""
     import pyarrow.parquet as pq
 
+    from cylon_tpu.config import ParquetOptions
+
+    options = options or ParquetOptions()
     at = df.to_arrow() if hasattr(df, "to_arrow") else df
-    pq.write_table(at, path)
+    if options.write_cols is not None:
+        at = at.select(list(options.write_cols))
+    comp = options.compression
+    pq.write_table(
+        at, path,
+        compression=None if comp in ("none", None) else comp,
+        row_group_size=options.row_group_size,
+        use_dictionary=options.use_dictionary)
 
 
 def read_json(path, env=None, capacity: int | None = None):

@@ -7,11 +7,18 @@ memory-bound single-pass loops — exactly what Pallas is for:
 
 * :func:`row_hash` fuses the W-word murmur mixing chain (+ optional
   ``% num_partitions``) into ONE pass over HBM, block-resident in VMEM.
-* :func:`segment_sum` re-expresses groupby scatter-add — which XLA
-  lowers to a slow sort/scatter on TPU — as one-hot **MXU matmuls**
-  accumulated across the grid: ``out[g] += onehot(gid)ᵀ · vals``.
+* the scan kernels (:func:`scan32`, :func:`pair_max_scan`) replace
+  XLA's multi-pass reduce-window lowerings for the prefix sums /
+  running maxima inside join expansion and shuffles.
 
-Both kernels run in ``interpret`` mode off-TPU, so the exact code path
+(An MXU one-hot segment-sum kernel lived here through r3; it was
+retired once ``kernels.segmented_totals`` — the segmented-scan +
+compaction-sort path — took over ALL TPU group reductions: its gate
+(f32, 1-D, <=8192 groups) had become unreachable on every default
+path, and measured v5e numbers showed segmented_totals ahead at both
+small and large group counts. See ``ops/groupby.py:_segment_sum``.)
+
+All kernels run in ``interpret`` mode off-TPU, so the exact code path
 unit-tested on the CPU mesh (``tests/conftest.py``) is what compiles on
 real chips. Dispatch policy: :func:`enabled` — auto-on for the TPU
 backend, forceable via ``CYLON_PALLAS=1|0|interpret``.
@@ -31,15 +38,8 @@ from cylon_tpu.platform import current_platform, on_platform
 
 # ---------------------------------------------------------------- dispatch
 
-#: group-count ceiling for the matmul segment-sum: above this the dense
-#: one-hot traffic (cap × ceil(G/512) reads) loses to XLA's sort-based
-#: lowering.
-SEGSUM_MAX_GROUPS = 8192
-
 _SUBLANES = 8          # Mosaic tile: second-to-last dim multiple of 8
 _HASH_LANES = 1024     # lanes per hash row; tile = 8x1024 elements
-_SEG_LANES = 512       # rows per segment-sum sublane; tile = 8x512
-_SEG_GBLOCK = 512      # group slots per out block (onehot = 1 MiB VMEM)
 
 
 def _mode() -> str:
@@ -148,74 +148,6 @@ def row_hash(words, nparts: int = 0, *, seed: int = 0x9747B28C) -> jax.Array:
                           _interpret())
 
 
-# ------------------------------------------------------------ segment sum
-
-def _segsum_kernel(gblock: int, gid_ref, val_ref, out_ref):
-    """out[0, jG:(j+1)G] += onehot(gid)ᵀ · vals — MXU accumulation."""
-    i = pl.program_id(1)
-
-    @pl.when(i == 0)
-    def _init():
-        out_ref[...] = jnp.zeros_like(out_ref)
-
-    j = pl.program_id(0)
-    base = j * gblock
-    acc = jnp.zeros(out_ref.shape, out_ref.dtype)
-    for s in range(gid_ref.shape[0]):                  # static sublane loop
-        gid = gid_ref[s]                               # [B] int32
-        vals = val_ref[s]                              # [B] f32
-        lanes = jax.lax.broadcasted_iota(jnp.int32,
-                                         (gid.shape[0], gblock), 1)
-        onehot = (gid[:, None] - base == lanes).astype(vals.dtype)
-        # HIGHEST: default MXU precision truncates f32 operands to bf16
-        acc += jnp.dot(vals[None, :], onehot,
-                       preferred_element_type=out_ref.dtype,
-                       precision=jax.lax.Precision.HIGHEST)
-    out_ref[...] += acc
-
-
-@functools.partial(jax.jit, static_argnames=("num_segments", "interpret"))
-def _segment_sum_impl(vals: jax.Array, gid: jax.Array, num_segments: int,
-                      interpret: bool) -> jax.Array:
-    cap = vals.shape[0]
-    r, b, gb = _SUBLANES, _SEG_LANES, _SEG_GBLOCK
-    tile = r * b
-    capp = -(-cap // tile) * tile
-    gp = -(-num_segments // gb) * gb
-    # padding rows: gid := gp never matches a lane → zero contribution
-    vals = _pad_to(vals.astype(jnp.float32), capp, 0).reshape(capp // b, b)
-    gid = _pad_to(gid.astype(jnp.int32), capp, gp).reshape(capp // b, b)
-    with jax.enable_x64(False):
-        out = pl.pallas_call(
-            functools.partial(_segsum_kernel, gb),
-            grid=(gp // gb, capp // tile),  # data sweep innermost: the
-            in_specs=[                      # out block stays VMEM-resident
-                pl.BlockSpec((r, b), lambda j, i: (i, 0)),   # while it
-                pl.BlockSpec((r, b), lambda j, i: (i, 0)),   # accumulates
-            ],
-            out_specs=pl.BlockSpec((1, gb), lambda j, i: (0, j)),
-            out_shape=_out_struct((1, gp), jnp.float32, vals),
-            interpret=interpret,
-        )(gid, vals)
-    return out[0, :num_segments]
-
-
-def segment_sum(vals: jax.Array, gid: jax.Array,
-                num_segments: int) -> jax.Array:
-    """f32 segment sum via one-hot MXU matmuls. Rows whose ``gid`` falls
-    outside ``[0, num_segments)`` are dropped (matching
-    ``jax.ops.segment_sum`` with out-of-range ids under clip-free
-    semantics used here: padding rows carry ``gid >= num_segments``).
-    """
-    return _segment_sum_impl(vals, gid, num_segments, _interpret())
-
-
-def segment_sum_ok(num_segments: int) -> bool:
-    """Policy gate: MXU path wins only while the dense one-hot traffic
-    stays below the sort-based lowering's."""
-    return enabled() and num_segments <= SEGSUM_MAX_GROUPS
-
-
 # ------------------------------------------------------------------ scan
 #: lanes per scan tile; tile = 8 x _SCAN_LANES elements, VMEM-resident
 _SCAN_LANES = 2048
@@ -302,8 +234,16 @@ def scan32(x: jax.Array, kind: str) -> jax.Array:
     return _scan32_impl(x, kind, _interpret())
 
 
+#: minimum elements before the Pallas scan beats XLA's cumsum/cummax: a
+#: kernel launch on tiny arrays (e.g. the [W] count vectors inside
+#: shuffle rounds) pads to a full 8x2048 tile and loses to the plain
+#: lowering (ADVICE r3)
+SCAN_MIN_SIZE = 4096
+
+
 def scan32_ok(x) -> bool:
-    return (x.ndim == 1 and x.dtype.itemsize == 4
+    return (x.ndim == 1 and x.shape[0] >= SCAN_MIN_SIZE
+            and x.dtype.itemsize == 4
             and x.dtype != jnp.bool_ and usable_for(x))
 
 

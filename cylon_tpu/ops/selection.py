@@ -219,25 +219,38 @@ def sort_table(table: Table, by: Sequence[str], ascending=True,
                           na_position=na_position)
 
 
+def sort_key_operands(c: Column, asc: bool,
+                      na_position: str = "last") -> list:
+    """The unsigned operand list that sorts one column with pandas
+    semantics (null/NaN flag word ranking nulls last regardless of
+    direction, order-key transform, bytes columns as their words).
+    Shared by the local sort below and the distributed sample-sort's
+    salted splitter tuples (``dist_ops._sort_body``) — partition order
+    MUST equal local sort order or rows land on the wrong shard."""
+    okeys = []
+    nulls = _null_flags(c)
+    key = kernels.order_key(c.data, asc)
+    if nulls is not None:
+        # flag ascending (0 < 1) puts nulls last; zero the data key
+        # under nulls — null slots carry arbitrary payload bytes, and
+        # pandas keeps null rows in original order (stable sort)
+        flag = nulls if na_position == "last" else (1 - nulls)
+        okeys.append(flag)
+        nz = nulls == 0
+        if key.ndim == 2:  # bytes column: zero every word
+            nz = nz[:, None]
+        key = jnp.where(nz, key, jnp.zeros((), key.dtype))
+    okeys.append(key)  # 2-D bytes keys expand in pack_order_keys
+    return okeys
+
+
 @functools.partial(platform_jit, static_argnames=("by", "ascending",
                                                   "na_position"))
 def _sort_compiled(table: Table, *, by, ascending, na_position) -> Table:
     okeys = []
     for name, asc in zip(by, ascending):
-        c = table.column(name)
-        nulls = _null_flags(c)
-        key = kernels.order_key(c.data, asc)
-        if nulls is not None:
-            # flag ascending (0 < 1) puts nulls last; zero the data key
-            # under nulls — null slots carry arbitrary payload bytes, and
-            # pandas keeps null rows in original order (stable sort)
-            flag = nulls if na_position == "last" else (1 - nulls)
-            okeys.append(flag)
-            nz = nulls == 0
-            if key.ndim == 2:  # bytes column: zero every word
-                nz = nz[:, None]
-            key = jnp.where(nz, key, jnp.zeros((), key.dtype))
-        okeys.append(key)  # 2-D bytes keys expand in pack_order_keys
+        okeys.extend(sort_key_operands(table.column(name), asc,
+                                       na_position))
     padding = (~kernels.valid_mask(table.capacity, table.nrows)
                ).astype(jnp.uint8)
     operands = kernels.pack_order_keys([padding] + okeys)

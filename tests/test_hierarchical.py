@@ -219,3 +219,48 @@ def test_hier_compiled_query(henv, rng):
                                     scatter_table(henv, right))))
     want = lp.merge(rp, on="k")["a"].sum()
     np.testing.assert_allclose(got, want)
+
+
+def test_hier_gateway_concentration_no_regrow(henv, rng):
+    """Gateway concentration: slice 0's traffic leans on local worker
+    index 2 (dests {2, 6}) while final per-destination loads still fit
+    the scale-1 output buffer. Stage 1 funnels 900 rows through gateway
+    (slice 0, worker 2) — 1.5x the 600-row output capacity — so r3
+    (stage-1 buffer = out_cap) poisoned and regrew EVERY buffer 2x;
+    the eager stage-1 probe (``dist_ops._probe_hier_mid``) must size
+    the gateway buffer alone and complete at capacity scale 1 (VERDICT
+    r3 weak #5)."""
+    import jax.numpy as jnp
+
+    from cylon_tpu.ops.hash import partition_ids
+    from cylon_tpu.parallel import dtable
+    from cylon_tpu.parallel.dist_ops import DEFAULT_SKEW
+
+    cand = np.arange(200_000, dtype=np.int64)
+    pid = np.asarray(partition_ids([jnp.asarray(cand)], 8))
+    by_pid = {p: cand[pid == p] for p in range(8)}
+    n = 2400                      # 1200 rows per slice (300 per worker)
+    out_l = (n // henv.world_size) * DEFAULT_SKEW          # 600
+    # slice 0 (rows 0..1199): 800 rows to dests {2, 6}, 400 uniform
+    s0 = np.concatenate([by_pid[2][:400], by_pid[6][:400]]
+                        + [by_pid[p][1000:1050] for p in range(8)])
+    # slice 1 (rows 1200..2399): uniform, 150 per destination
+    s1 = np.concatenate([by_pid[p][2000:2150] for p in range(8)])
+    keys = np.concatenate([rng.permutation(s0), rng.permutation(s1)])
+    # preconditions: finals fit scale-1 buffers, gateway (0, 2) does not
+    fin = np.bincount(np.asarray(partition_ids([jnp.asarray(keys)], 8)),
+                      minlength=8)
+    assert fin.max() <= out_l, fin
+    gw02 = ((np.asarray(partition_ids([jnp.asarray(keys[:1200])], 8))
+             % 4) == 2).sum()
+    assert gw02 > out_l, gw02
+
+    t = Table.from_pydict({"k": keys, "v": np.arange(n, dtype=np.int64)})
+    res = shuffle(henv, t, ["k"])
+    assert dist_num_rows(res) == n
+    got = dist_to_pandas(henv, res).sort_values(["k", "v"])
+    assert (got["k"].to_numpy() == np.sort(keys)).all()
+    # no whole-program regrow: the FINAL buffers stayed at scale 1
+    # (stage-1's probed gateway buffer is allowed to be larger)
+    assert dtable.local_capacity(res) == out_l, (
+        dtable.local_capacity(res), out_l)

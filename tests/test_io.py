@@ -374,3 +374,32 @@ def test_write_csv_sharded_roundtrip(env8, rng, tmp_path):
     back = pd.concat(parts, ignore_index=True)
     want = dist_to_pandas(env8, dt).reset_index(drop=True)
     pd.testing.assert_frame_equal(back, want, check_dtype=False)
+
+
+def test_parquet_options_roundtrip(tmp_path, sample_df):
+    """ParquetOptions writer properties + read projection (parity:
+    io/parquet_config.hpp ChunkSize/WriterProperties)."""
+    from cylon_tpu import DataFrame, ParquetOptions
+    from cylon_tpu.io import read_parquet, write_parquet
+
+    path = str(tmp_path / "opt.parquet")
+    df = DataFrame(sample_df)
+    write_parquet(df, path, ParquetOptions(compression="zstd",
+                                           row_group_size=3,
+                                           use_dictionary=False))
+    import pyarrow.parquet as pq
+
+    pf = pq.ParquetFile(path)
+    assert pf.metadata.num_row_groups >= 2  # row_group_size honored
+    assert pf.metadata.row_group(0).column(0).compression.lower() == "zstd"
+    back = read_parquet(path)
+    pd.testing.assert_frame_equal(back.to_pandas(), df.to_pandas())
+    # column subsets: on write and on read
+    write_parquet(df, path, ParquetOptions(write_cols=list(
+        sample_df.columns[:1])))
+    assert read_parquet(path).to_pandas().columns.tolist() == \
+        list(sample_df.columns[:1])
+    proj = read_parquet(path, options=ParquetOptions(
+        use_cols=list(sample_df.columns[:1]),
+        concurrent_file_reads=False))
+    assert proj.to_pandas().columns.tolist() == list(sample_df.columns[:1])

@@ -47,19 +47,6 @@ def test_row_hash_unaligned_length(rng, pallas_interpret):
     assert h.shape == (130,) and h.dtype == jnp.uint32
 
 
-@pytest.mark.parametrize("cap,segs", [(100, 7), (2048, 513), (1500, 1)])
-def test_segment_sum_matches_xla(rng, pallas_interpret, cap, segs):
-    vals = jnp.asarray(rng.normal(size=cap), jnp.float32)
-    gid = jnp.asarray(rng.integers(0, segs, cap), jnp.int32)
-    # some out-of-range ids (padding-row convention) must be dropped
-    gid = gid.at[: cap // 10].set(segs)
-    got = pk.segment_sum(vals, gid, segs)
-    want = jax.ops.segment_sum(
-        jnp.where(gid < segs, vals, 0.0),
-        jnp.clip(gid, 0, segs - 1), num_segments=segs)
-    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
-                               rtol=1e-5, atol=1e-5)
-
 
 def test_groupby_sum_via_pallas(rng, pallas_interpret):
     from cylon_tpu import Table
@@ -76,9 +63,6 @@ def test_groupby_sum_via_pallas(rng, pallas_interpret):
                                pdres.to_numpy(), rtol=1e-4)
 
 
-def test_policy_gate():
-    assert not pk.segment_sum_ok(10**7)
-
 
 def test_row_hash_multiblock(rng, pallas_interpret, monkeypatch):
     # cap > one 8x1024 tile: exercises the multi-block grid indexing
@@ -88,17 +72,6 @@ def test_row_hash_multiblock(rng, pallas_interpret, monkeypatch):
     want = rowhash.hash_columns([a])
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
-
-def test_segment_sum_multiblock(rng, pallas_interpret):
-    # cap > one 8x512 tile AND groups > one 512 out block: exercises the
-    # cross-grid-step out_ref accumulation and the revisit init ordering
-    cap, segs = 20_000, 1200
-    vals = jnp.asarray(rng.normal(size=cap), jnp.float32)
-    gid = jnp.asarray(rng.integers(0, segs, cap), jnp.int32)
-    got = pk.segment_sum(vals, gid, segs)
-    want = jax.ops.segment_sum(vals, gid, num_segments=segs)
-    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
-                               rtol=1e-4, atol=1e-4)
 
 
 @pytest.mark.parametrize("kind,np_ref", [
