@@ -792,10 +792,12 @@ def _sort_body(env, table, by, asc0, asc, nsamp, nbins, out_l, w):
             # the salt is the GLOBAL row id (shard-block order — the
             # order gather_table materialises), so cross-shard ties
             # partition in stable-sort order; a shard-local index would
-            # scramble equal-tuple rows across senders
+            # scramble equal-tuple rows across senders. uint64: W*cap_l
+            # can pass 2^32 on big meshes, and a wrapped salt would
+            # silently re-scramble exactly the ties it protects
             me = jax.lax.axis_index(ax)
-            gsalt = (me.astype(jnp.uint32) * jnp.uint32(cap_l)
-                     + jnp.arange(cap_l, dtype=jnp.uint32))
+            gsalt = (me.astype(jnp.uint64) * jnp.uint64(cap_l)
+                     + jnp.arange(cap_l, dtype=jnp.uint64))
             comps = comps + [gsalt]
             perm = kernels.sort_perm(ops, n)  # valid rows first
             take_i = (jnp.arange(nsamp) * jnp.maximum(n, 1)) // nsamp

@@ -1,0 +1,36 @@
+package org.cylondata.cylon;
+
+import java.util.List;
+
+/**
+ * A typed host-side column, returned by {@link Table#mapColumn}.
+ *
+ * <p>Parity: the reference's {@code Column<T>} (java/.../Column.java —
+ * a typed holder the Java ops produce).
+ */
+public final class Column<T> {
+
+  private final String name;
+  private final List<T> values;
+
+  Column(String name, List<T> values) {
+    this.name = name;
+    this.values = values;
+  }
+
+  public String getName() {
+    return name;
+  }
+
+  public int size() {
+    return values.size();
+  }
+
+  public T get(int i) {
+    return values.get(i);
+  }
+
+  public List<T> values() {
+    return values;
+  }
+}

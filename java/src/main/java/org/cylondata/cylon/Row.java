@@ -1,0 +1,71 @@
+package org.cylondata.cylon;
+
+/**
+ * One row's typed host view, handed to {@link
+ * org.cylondata.cylon.ops.Selector#select}.
+ *
+ * <p>Parity: the reference's {@code Row} (java/.../Row.java — typed
+ * getters over a native row handle). Here the row is a view over
+ * columns the binding already fetched from the catalog (one bulk read
+ * per column for the whole {@code select}, not one JNI call per cell —
+ * the catalog ABI is column-oriented, so per-cell native getters would
+ * be quadratic traffic).
+ */
+public final class Row {
+
+  private final String[] names;
+  private final Object[] columns;  // long[] | double[] | String[] per col
+  private int index;
+
+  Row(String[] names, Object[] columns) {
+    this.names = names;
+    this.columns = columns;
+  }
+
+  void seek(int i) {
+    this.index = i;
+  }
+
+  public int getColumnCount() {
+    return names.length;
+  }
+
+  public String getColumnName(int col) {
+    return names[col];
+  }
+
+  /** Throws {@code NullPointerException} on a null cell (use
+   *  {@link #get} / {@link #isNull} for nullable columns). */
+  public long getInt64(int col) {
+    Object a = columns[col];
+    return a instanceof long[] ? ((long[]) a)[index]
+        : ((Long[]) a)[index];
+  }
+
+  public double getFloat64(int col) {
+    Object a = columns[col];
+    return a instanceof double[] ? ((double[]) a)[index]
+        : ((Double[]) a)[index];
+  }
+
+  public String getString(int col) {
+    return ((String[]) columns[col])[index];
+  }
+
+  public boolean isNull(int col) {
+    return get(col) == null;
+  }
+
+  /** Boxed cell value: {@code Long}, {@code Double} or {@code String}
+   *  ({@code null} for a null cell). */
+  public Object get(int col) {
+    Object a = columns[col];
+    if (a instanceof long[]) {
+      return ((long[]) a)[index];
+    }
+    if (a instanceof double[]) {
+      return ((double[]) a)[index];
+    }
+    return ((Object[]) a)[index];
+  }
+}

@@ -1,6 +1,12 @@
 package org.cylondata.cylon;
 
+import java.util.ArrayList;
+import java.util.List;
 import java.util.UUID;
+
+import org.cylondata.cylon.ops.Filter;
+import org.cylondata.cylon.ops.Mapper;
+import org.cylondata.cylon.ops.Selector;
 
 /**
  * Java consumer of the native table catalog (parity: the reference's
@@ -53,8 +59,13 @@ public final class Table {
     return new Table(uuid, ctx);
   }
 
-  /** Register int64/float64 columns directly (column i is
-   *  {@code long[]} or {@code double[]}). */
+  /** Register columns directly (column i is {@code long[]},
+   *  {@code double[]} or {@code String[]}). String columns are
+   *  dictionary-encoded in the JNI layer (sorted-unique values, int32
+   *  codes, null elements -> validity) and carry their dictionaries as
+   *  the catalog's sidecar convention — the same wire format the
+   *  Python binding writes, so joins across the two bindings compare
+   *  string VALUES. */
   public static Table fromColumns(CylonContext ctx, String[] names,
                                   Object[] columns) {
     String uuid = UUID.randomUUID().toString();
@@ -166,6 +177,178 @@ public final class Table {
       throw new RuntimeException("native join failed rc=" + rc);
     }
     return new Table(uuid, ctx);
+  }
+
+  // ----------------- relational ops over ops.* interfaces -----------------
+
+  /** One user column as a host array — ONE bulk catalog read (the ABI
+   *  is column-oriented; per-cell native getters would be quadratic
+   *  JNI traffic). Nullable numeric columns come back BOXED
+   *  ({@code Long[]}/{@code Double[]}, null elements for null cells)
+   *  so ops never see a null cell's garbage payload; all-valid
+   *  columns keep the primitive fast path. */
+  private Object materializeColumn(int c) {
+    int t = getColumnType(c);
+    if (t == DTYPE_STRING_CODES) {
+      return readStringColumn(c);  // null cells -> null elements
+    }
+    byte[] valid = readValidity(c);
+    if (t == DTYPE_FLOAT64) {
+      double[] raw = readDoubleColumn(c);
+      if (valid == null) {
+        return raw;
+      }
+      Double[] boxed = new Double[raw.length];
+      for (int i = 0; i < raw.length; i++) {
+        boxed[i] = valid[i] != 0 ? (Double) raw[i] : null;
+      }
+      return boxed;
+    }
+    long[] raw = readLongColumn(c);
+    if (valid == null) {
+      return raw;
+    }
+    Long[] boxed = new Long[raw.length];
+    for (int i = 0; i < raw.length; i++) {
+      boxed[i] = valid[i] != 0 ? (Long) raw[i] : null;
+    }
+    return boxed;
+  }
+
+  private Object[] materializeColumns(int nc) {
+    Object[] cols = new Object[nc];
+    for (int c = 0; c < nc; c++) {
+      cols[c] = materializeColumn(c);
+    }
+    return cols;
+  }
+
+  private static Object cell(Object col, int r) {
+    if (col instanceof long[]) {
+      return ((long[]) col)[r];
+    }
+    if (col instanceof double[]) {
+      return ((double[]) col)[r];
+    }
+    return ((Object[]) col)[r];  // Long[] / Double[] / String[]
+  }
+
+  private Table rebuild(Object[] cols, boolean[] keep, int kept) {
+    int nc = cols.length;
+    String[] names = new String[nc];
+    Object[] out = new Object[nc];
+    int nr = getRowCount();
+    for (int c = 0; c < nc; c++) {
+      names[c] = getColumnName(c);
+      Object a = cols[c];
+      if (a instanceof long[]) {
+        long[] src = (long[]) a;
+        long[] dst = new long[kept];
+        for (int r = 0, w = 0; r < nr; r++) {
+          if (keep[r]) dst[w++] = src[r];
+        }
+        out[c] = dst;
+      } else if (a instanceof double[]) {
+        double[] src = (double[]) a;
+        double[] dst = new double[kept];
+        for (int r = 0, w = 0; r < nr; r++) {
+          if (keep[r]) dst[w++] = src[r];
+        }
+        out[c] = dst;
+      } else if (a instanceof String[]) {
+        String[] src = (String[]) a;
+        String[] dst = new String[kept];
+        for (int r = 0, w = 0; r < nr; r++) {
+          if (keep[r]) dst[w++] = src[r];
+        }
+        out[c] = dst;
+      } else if (a instanceof Long[]) {
+        Long[] src = (Long[]) a;
+        Long[] dst = new Long[kept];
+        for (int r = 0, w = 0; r < nr; r++) {
+          if (keep[r]) dst[w++] = src[r];
+        }
+        out[c] = dst;
+      } else {
+        Double[] src = (Double[]) a;
+        Double[] dst = new Double[kept];
+        for (int r = 0, w = 0; r < nr; r++) {
+          if (keep[r]) dst[w++] = src[r];
+        }
+        out[c] = dst;
+      }
+    }
+    return fromColumns(ctx, names, out);
+  }
+
+  /**
+   * Keep rows where {@code filterLogic} holds on one column's value
+   * (boxed {@code Long}/{@code Double}/{@code String} per dtype).
+   *
+   * <p>Parity: {@code Table.filter(int, Filter)} of the reference
+   * ({@code Table.java:229}). The reference evaluates the user lambda
+   * per row through a JNI callback into the JVM; here the predicate
+   * runs over ONE bulk-read column and the surviving rows re-enter the
+   * catalog as a fresh table — same contract, no per-row JNI
+   * crossings.</p>
+   */
+  @SuppressWarnings("unchecked")
+  public <I> Table filter(int columnIndex, Filter<I> filterLogic) {
+    int nr = getRowCount();
+    int nc = getColumnCount();
+    Object[] cols = materializeColumns(nc);
+    Object a = cols[columnIndex];
+    boolean[] keep = new boolean[nr];
+    int kept = 0;
+    for (int r = 0; r < nr; r++) {
+      if (filterLogic.filter((I) cell(a, r))) {
+        keep[r] = true;
+        kept++;
+      }
+    }
+    return rebuild(cols, keep, kept);
+  }
+
+  /**
+   * Keep rows the {@link Selector} accepts (whole-row predicate;
+   * parity: {@code Table.select(Selector)}, {@code Table.java:240} /
+   * native {@code select}, {@code Table.java:307}).
+   */
+  public Table select(Selector selector) {
+    int nr = getRowCount();
+    int nc = getColumnCount();
+    Object[] cols = materializeColumns(nc);
+    String[] names = new String[nc];
+    for (int c = 0; c < nc; c++) {
+      names[c] = getColumnName(c);
+    }
+    Row row = new Row(names, cols);
+    boolean[] keep = new boolean[nr];
+    int kept = 0;
+    for (int r = 0; r < nr; r++) {
+      row.seek(r);
+      if (selector.select(row)) {
+        keep[r] = true;
+        kept++;
+      }
+    }
+    return rebuild(cols, keep, kept);
+  }
+
+  /**
+   * Map one column elementwise through {@code mapper} (parity:
+   * {@code Table.mapColumn}, {@code Table.java:170}). Returns the
+   * mapped values as a host {@link Column}, like the reference.
+   */
+  @SuppressWarnings("unchecked")
+  public <I, O> Column<O> mapColumn(int colIndex, Mapper<I, O> mapper) {
+    int nr = getRowCount();
+    Object a = materializeColumn(colIndex);  // only the mapped column
+    List<O> out = new ArrayList<O>(nr);
+    for (int r = 0; r < nr; r++) {
+      out.add(mapper.map((I) cell(a, r)));
+    }
+    return new Column<O>(getColumnName(colIndex), out);
   }
 
   /** Remove this table from the catalog (parity: {@code clear}). */

@@ -38,10 +38,35 @@ public final class JoinExample {
         && amount[0] == 20.0 && amount[1] == 21.0 && amount[2] == 30.0
         && score[0] == 0.5 && score[1] == 0.5 && score[2] == 0.25;
 
+    // ops.* interfaces (parity: the reference's Filter/Selector/Mapper)
+    Table big = joined.filter(1, (Double v) -> v > 20.0);
+    ok = ok && big.getRowCount() == 2;
+    Table key2 = joined.select(row -> row.getInt64(0) == 2);
+    ok = ok && key2.getRowCount() == 2;
+    ok = ok && joined.<Double, Double>mapColumn(1, v -> v * 2.0)
+        .get(0) == 40.0;
+
+    // String[] columns dictionary-encode through the catalog's
+    // sidecar convention (shared with the Python binding)
+    Table named = Table.fromColumns(ctx,
+        new String[] {"name", "x"},
+        new Object[] {new String[] {"carol", "alice", "bob"},
+                      new long[] {1, 2, 3}});
+    String[] back = named.readStringColumn(0);
+    ok = ok && back[0].equals("carol") && back[1].equals("alice")
+        && back[2].equals("bob");
+    Table alice = named.filter(0, (String s) -> s.startsWith("a"));
+    ok = ok && alice.getRowCount() == 1
+        && alice.readStringColumn(0)[0].equals("alice");
+
     joined.print(10);
     orders.clear();
     customers.clear();
     joined.clear();
+    big.clear();
+    key2.clear();
+    named.clear();
+    alice.clear();
     ctx.finalizeCtx();
 
     if (!ok) {

@@ -1,0 +1,13 @@
+package org.cylondata.cylon.ops;
+
+/**
+ * Single-column row predicate for {@link org.cylondata.cylon.Table#filter}.
+ *
+ * <p>Parity contract: the reference's {@code ops.Filter} interface
+ * (java/src/main/java/org/cylondata/cylon/ops/Filter.java) — the
+ * method name and shape ARE the compatibility surface, so user lambdas
+ * written against the reference compile unchanged.
+ */
+public interface Filter<I> {
+  boolean filter(I value);
+}

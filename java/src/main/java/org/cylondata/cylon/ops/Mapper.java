@@ -1,0 +1,12 @@
+package org.cylondata.cylon.ops;
+
+/**
+ * Elementwise cell transform for
+ * {@link org.cylondata.cylon.Table#mapColumn}.
+ *
+ * <p>Parity contract: the reference's {@code ops.Mapper} interface —
+ * name and shape are the compatibility surface.
+ */
+public interface Mapper<I, O> {
+  O map(I cellValue);
+}

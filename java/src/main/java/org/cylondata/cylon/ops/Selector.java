@@ -1,0 +1,13 @@
+package org.cylondata.cylon.ops;
+
+import org.cylondata.cylon.Row;
+
+/**
+ * Whole-row predicate for {@link org.cylondata.cylon.Table#select}.
+ *
+ * <p>Parity contract: the reference's {@code ops.Selector} interface —
+ * name and shape are the compatibility surface.
+ */
+public interface Selector {
+  boolean select(Row row);
+}

@@ -155,6 +155,10 @@ done:
 
 /* ------------------------------------------------- direct columns */
 
+static int cmp_pstr(const void *a, const void *b) {
+  return strcmp(*(const char *const *) a, *(const char *const *) b);
+}
+
 JNIEXPORT void JNICALL
 Java_org_cylondata_cylon_Table_nativePutColumns(JNIEnv *env, jclass cls,
                                                 jstring jid,
@@ -167,36 +171,55 @@ Java_org_cylondata_cylon_Table_nativePutColumns(JNIEnv *env, jclass cls,
   }
   const char *id = (*env)->GetStringUTFChars(env, jid, NULL);
   jsize nc = (*env)->GetArrayLength(env, jnames);
-  const char **names = malloc(sizeof(char *) * nc);
-  jstring *jname_refs = malloc(sizeof(jstring) * nc);
-  int32_t *dtypes = malloc(sizeof(int32_t) * nc);
-  const void **bufs = malloc(sizeof(void *) * nc);
-  int64_t *lens = malloc(sizeof(int64_t) * nc);
-  void **owned = malloc(sizeof(void *) * nc);
+  /* String[] columns append two dictionary-sidecar slots each
+   * ("<col>\x01blob" / "<col>\x01offs" — the Python binding's wire
+   * convention, native/__init__.py), so joins on string keys compare
+   * VALUES, not per-table codes */
+  int32_t cap = (int32_t) nc * 3;
+  const char **names = malloc(sizeof(char *) * cap);
+  char **owned_names = calloc(cap, sizeof(char *));
+  jstring *jname_refs = calloc(nc, sizeof(jstring));
+  int32_t *dtypes = malloc(sizeof(int32_t) * cap);
+  const void **bufs = malloc(sizeof(void *) * cap);
+  int64_t *lens = malloc(sizeof(int64_t) * cap);
+  const uint8_t **valids = malloc(sizeof(uint8_t *) * cap);
+  void **owned = calloc(cap, sizeof(void *));
+  uint8_t **ovalid = calloc(cap, sizeof(uint8_t *));
   int64_t n = -1;
   int bad = 0;
+  int32_t slot = (int32_t) nc;  /* sidecars go after the user columns */
 
   jclass longArr = (*env)->FindClass(env, "[J");
   jclass dblArr = (*env)->FindClass(env, "[D");
+  jclass strArr = (*env)->FindClass(env, "[Ljava/lang/String;");
+  /* boxed Long[]/Double[]: null elements carry numeric NULLS through
+   * (what Table.filter/select round-trip for nullable columns) */
+  jclass boxLongArr = (*env)->FindClass(env, "[Ljava/lang/Long;");
+  jclass boxDblArr = (*env)->FindClass(env, "[Ljava/lang/Double;");
+  jclass longCls = (*env)->FindClass(env, "java/lang/Long");
+  jclass dblCls = (*env)->FindClass(env, "java/lang/Double");
+  jmethodID longVal = (*env)->GetMethodID(env, longCls, "longValue",
+                                          "()J");
+  jmethodID dblVal = (*env)->GetMethodID(env, dblCls, "doubleValue",
+                                         "()D");
   for (jsize c = 0; c < nc; c++) {
+    names[c] = "";
+    dtypes[c] = 0;
+    lens[c] = 0;
+    valids[c] = NULL;
     jname_refs[c] = (jstring) (*env)->GetObjectArrayElement(env, jnames, c);
     if (jname_refs[c] == NULL) {
       /* GetStringUTFChars(NULL) would segfault the JVM */
-      names[c] = "";
       bad = 1;
     } else {
       names[c] = (*env)->GetStringUTFChars(env, jname_refs[c], NULL);
     }
     jobject col = (*env)->GetObjectArrayElement(env, jcols, c);
-    jsize len;
+    jsize len = 0;
     if (col == NULL) {
       /* IsInstanceOf(NULL, cls) is JNI_TRUE per spec — a null column
        * would otherwise segfault in GetArrayLength */
       bad = 1;
-      owned[c] = NULL;
-      len = 0;
-      dtypes[c] = 0;
-      lens[c] = 0;
     } else if ((*env)->IsInstanceOf(env, col, longArr)) {
       len = (*env)->GetArrayLength(env, (jarray) col);
       owned[c] = malloc(sizeof(int64_t) * (size_t) len);
@@ -211,12 +234,145 @@ Java_org_cylondata_cylon_Table_nativePutColumns(JNIEnv *env, jclass cls,
                                    (jdouble *) owned[c]);
       dtypes[c] = 1;
       lens[c] = (int64_t) len * 8;
+    } else if ((*env)->IsInstanceOf(env, col, boxLongArr)
+               || (*env)->IsInstanceOf(env, col, boxDblArr)) {
+      int is_long = (*env)->IsInstanceOf(env, col, boxLongArr);
+      len = (*env)->GetArrayLength(env, (jarray) col);
+      uint8_t *valid = malloc((size_t) len ? (size_t) len : 1);
+      int any_null = 0;
+      if (is_long) {
+        int64_t *vals = malloc(sizeof(int64_t)
+                               * ((size_t) len ? (size_t) len : 1));
+        for (jsize i = 0; i < len; i++) {
+          jobject e = (*env)->GetObjectArrayElement(
+              env, (jobjectArray) col, i);
+          if (e == NULL) {
+            vals[i] = 0;
+            valid[i] = 0;
+            any_null = 1;
+          } else {
+            vals[i] = (int64_t) (*env)->CallLongMethod(env, e, longVal);
+            valid[i] = 1;
+            (*env)->DeleteLocalRef(env, e);
+          }
+        }
+        dtypes[c] = 0;
+        owned[c] = vals;
+        lens[c] = (int64_t) len * 8;
+      } else {
+        double *vals = malloc(sizeof(double)
+                              * ((size_t) len ? (size_t) len : 1));
+        for (jsize i = 0; i < len; i++) {
+          jobject e = (*env)->GetObjectArrayElement(
+              env, (jobjectArray) col, i);
+          if (e == NULL) {
+            vals[i] = 0.0;
+            valid[i] = 0;
+            any_null = 1;
+          } else {
+            vals[i] = (double) (*env)->CallDoubleMethod(env, e, dblVal);
+            valid[i] = 1;
+            (*env)->DeleteLocalRef(env, e);
+          }
+        }
+        dtypes[c] = 1;
+        owned[c] = vals;
+        lens[c] = (int64_t) len * 8;
+      }
+      if (any_null) {
+        ovalid[c] = valid;
+        valids[c] = valid;
+      } else {
+        free(valid);
+      }
+    } else if ((*env)->IsInstanceOf(env, col, strArr)) {
+      /* dictionary-encode client-side: sorted-unique values (code
+       * order == value order, matching the Python ingest), int32
+       * codes, null elements -> validity 0 */
+      len = (*env)->GetArrayLength(env, (jarray) col);
+      char **svals = calloc((size_t) len ? (size_t) len : 1,
+                            sizeof(char *));
+      uint8_t *valid = malloc((size_t) len ? (size_t) len : 1);
+      int any_null = 0;
+      for (jsize i = 0; i < len; i++) {
+        jstring js = (jstring) (*env)->GetObjectArrayElement(
+            env, (jobjectArray) col, i);
+        if (js == NULL) {
+          valid[i] = 0;
+          any_null = 1;
+        } else {
+          const char *u = (*env)->GetStringUTFChars(env, js, NULL);
+          svals[i] = strdup(u ? u : "");
+          if (u) (*env)->ReleaseStringUTFChars(env, js, u);
+          (*env)->DeleteLocalRef(env, js);
+          valid[i] = 1;
+        }
+      }
+      char **sorted = malloc(sizeof(char *) * ((size_t) len ? len : 1));
+      int32_t m = 0;
+      for (jsize i = 0; i < len; i++)
+        if (svals[i]) sorted[m++] = svals[i];
+      qsort(sorted, (size_t) m, sizeof(char *), cmp_pstr);
+      int32_t u = 0;
+      for (int32_t i = 0; i < m; i++)
+        if (i == 0 || strcmp(sorted[i], sorted[u - 1]) != 0)
+          sorted[u++] = sorted[i];
+      int32_t *codes = malloc(sizeof(int32_t) * ((size_t) len ? len : 1));
+      for (jsize i = 0; i < len; i++) {
+        if (!svals[i]) {
+          codes[i] = 0;
+          continue;
+        }
+        char **hit = bsearch(&svals[i], sorted, (size_t) u,
+                             sizeof(char *), cmp_pstr);
+        codes[i] = hit ? (int32_t) (hit - sorted) : 0;
+      }
+      dtypes[c] = 2;
+      owned[c] = codes;
+      lens[c] = (int64_t) len * 4;
+      if (any_null) {
+        ovalid[c] = valid;
+        valids[c] = valid;
+      } else {
+        free(valid);
+      }
+      /* dictionary sidecars over the unique values */
+      int64_t *offs = malloc(sizeof(int64_t) * ((size_t) u + 1));
+      int64_t total = 0;
+      offs[0] = 0;
+      for (int32_t v = 0; v < u; v++) {
+        total += (int64_t) strlen(sorted[v]);
+        offs[v + 1] = total;
+      }
+      char *blob = malloc(total ? (size_t) total : 1);
+      for (int32_t v = 0; v < u; v++)
+        memcpy(blob + offs[v], sorted[v],
+               (size_t) (offs[v + 1] - offs[v]));
+      size_t base_len = strlen(names[c]);
+      int32_t bs = slot++;
+      owned_names[bs] = malloc(base_len + 7);
+      /* "\x01" kept separate: "\x01b..." would munch hex digits */
+      sprintf(owned_names[bs], "%s\x01" "blob", names[c]);
+      names[bs] = owned_names[bs];
+      dtypes[bs] = 1;  /* Kind.UINT8 tag, Python-compatible */
+      owned[bs] = blob;
+      bufs[bs] = blob;
+      lens[bs] = total;
+      valids[bs] = NULL;
+      int32_t os = slot++;
+      owned_names[os] = malloc(base_len + 7);
+      sprintf(owned_names[os], "%s\x01" "offs", names[c]);
+      names[os] = owned_names[os];
+      dtypes[os] = 8;  /* Kind.INT64 tag */
+      owned[os] = offs;
+      bufs[os] = offs;
+      lens[os] = ((int64_t) u + 1) * 8;
+      valids[os] = NULL;
+      for (jsize i = 0; i < len; i++) free(svals[i]);
+      free(svals);
+      free(sorted);
     } else {
       bad = 1;
-      owned[c] = NULL;
-      len = 0;
-      dtypes[c] = 0;
-      lens[c] = 0;
     }
     bufs[c] = owned[c];
     if (n < 0) n = len;
@@ -224,22 +380,29 @@ Java_org_cylondata_cylon_Table_nativePutColumns(JNIEnv *env, jclass cls,
   }
   if (bad) {
     throw_runtime(env, "fromColumns: columns must be equal-length "
-                       "long[] or double[]");
-  } else if (cylon_catalog_put(id, (int32_t) nc, names, dtypes, n, bufs,
-                               lens, NULL)) {
+                       "long[], double[] or String[]");
+  } else if (cylon_catalog_put(id, slot, names, dtypes, n, bufs,
+                               lens, valids)) {
     throw_runtime(env, "catalog put failed");
   }
-  for (jsize c = 0; c < nc; c++) {
+  for (int32_t c = 0; c < cap; c++) {
     free(owned[c]);
+    free(ovalid[c]);
+    free(owned_names[c]);
+  }
+  for (jsize c = 0; c < nc; c++) {
     if (jname_refs[c] != NULL)
       (*env)->ReleaseStringUTFChars(env, jname_refs[c], names[c]);
   }
   free(names);
+  free(owned_names);
   free(jname_refs);
   free(dtypes);
   free(bufs);
   free(lens);
+  free(valids);
   free(owned);
+  free(ovalid);
   (*env)->ReleaseStringUTFChars(env, jid, id);
 }
 

@@ -35,6 +35,7 @@ typedef jobject jarray; typedef jarray jobjectArray;
 typedef jarray jlongArray; typedef jarray jdoubleArray;
 typedef jarray jintArray;  typedef jarray jbyteArray;
 typedef unsigned char jboolean;
+typedef jobject jmethodID;
 struct JNINativeInterface_;
 typedef const struct JNINativeInterface_ *JNIEnv;
 struct JNINativeInterface_ {
@@ -45,6 +46,10 @@ struct JNINativeInterface_ {
   jstring (*NewStringUTF)(JNIEnv *, const char *);
   jsize (*GetArrayLength)(JNIEnv *, jarray);
   jobject (*GetObjectArrayElement)(JNIEnv *, jobjectArray, jsize);
+  void (*DeleteLocalRef)(JNIEnv *, jobject);
+  jmethodID (*GetMethodID)(JNIEnv *, jclass, const char *, const char *);
+  jlong (*CallLongMethod)(JNIEnv *, jobject, jmethodID, ...);
+  jdouble (*CallDoubleMethod)(JNIEnv *, jobject, jmethodID, ...);
   jboolean (*IsInstanceOf)(JNIEnv *, jobject, jclass);
   void (*GetLongArrayRegion)(JNIEnv *, jlongArray, jsize, jsize, jlong *);
   void (*GetDoubleArrayRegion)(JNIEnv *, jdoubleArray, jsize, jsize,
