@@ -137,15 +137,29 @@ def _groupby_compiled(table: Table, *, by, aggs, out_cap,
     iota = jnp.arange(cap, dtype=jnp.int32)
     src_cols = {s: table.column(s) for s in src_names}
     # original row index leads the payloads (keytab + first/last);
-    # multi-dim columns fall back to a post-sort gather via that index
-    payloads, pack = columns_to_payloads(src_cols, cap, lead=[iota],
-                                        index_slot=0)
+    # multi-dim columns fall back to a post-sort gather via that index.
+    # WIDE value sets instead ride one packed row gather through the
+    # sorted index — each sort payload re-moves its bytes through every
+    # merge stage (see selection.PAYLOAD_SORT_MAX_WORDS)
+    from cylon_tpu.ops.selection import (PAYLOAD_SORT_MAX_WORDS,
+                                         payload_words)
+
+    wide = payload_words(src_cols) > PAYLOAD_SORT_MAX_WORDS
+    if wide:
+        payloads, pack = [iota], None
+    else:
+        payloads, pack = columns_to_payloads(src_cols, cap, lead=[iota],
+                                             index_slot=0)
 
     gid_s, num_groups, sorted_pl = kernels.group_sort(
         keys, table.nrows, kvals, payloads)
     orig_idx = sorted_pl[0]
-    sorted_cols = payloads_to_columns(src_cols, sorted_pl, pack)
-    stab = Table(sorted_cols, table.nrows)
+    if wide:
+        stab = take_columns(table, orig_idx, table.nrows,
+                            names=src_names)
+    else:
+        sorted_cols = payloads_to_columns(src_cols, sorted_pl, pack)
+        stab = Table(sorted_cols, table.nrows)
 
     specs = []
     for spec in aggs:

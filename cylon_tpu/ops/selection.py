@@ -180,12 +180,46 @@ def payloads_to_columns(columns, sorted_payloads, pack) -> dict:
     return cols
 
 
+#: payload u32-words above which :func:`permute_by_sort` stops carrying
+#: columns through the comparator network and instead sorts a
+#: permutation + does ONE packed row gather. Every extra sort operand
+#: re-moves its bytes through every merge stage of the O(log^2 n)
+#: sorting network, while the gather pays per row once: measured on
+#: v5e at 6M rows, 12 extra u32 operands turn a ~20 s (cold) 2-operand
+#: sort into 140 s, vs ~2 s for the packed gather — the "payloads ride
+#: the sort" rule that wins for narrow tables INVERTS for wide ones
+#: (e.g. any table carrying a device-bytes string column).
+PAYLOAD_SORT_MAX_WORDS = 6
+
+
+def _column_words(c: Column) -> int:
+    """u32 words this column adds per row as sort payload."""
+    d = c.data
+    if d.ndim == 2:
+        w = d.shape[1]
+    else:
+        w = 2 if d.dtype.itemsize == 8 else 1
+    if c.validity is not None:
+        w += 1
+    return w
+
+
+def payload_words(columns) -> int:
+    return sum(_column_words(c) for c in columns.values())
+
+
 def permute_by_sort(table: Table, operands, nrows_out) -> Table:
     """Reorder a table by a stable sort on ``operands`` (pre-built
-    unsigned order keys), carrying every column through ``lax.sort`` as
-    payload. Random gathers are ~10x the cost of the sort itself on TPU
-    at 10M rows, so moving the bytes through the comparator network
-    beats materialising a permutation and gathering."""
+    unsigned order keys). Narrow tables carry every column through
+    ``lax.sort`` as payload (random gathers cost ~10x a narrow sort);
+    wide tables (> ``PAYLOAD_SORT_MAX_WORDS`` payload words) sort only
+    a row-index payload and take ONE bit-packed row gather instead —
+    see the constant's docstring for the measured crossover."""
+    if payload_words(table.columns) > PAYLOAD_SORT_MAX_WORDS:
+        iota = jnp.arange(table.capacity, dtype=jnp.int32)
+        out = jax.lax.sort(tuple(operands) + (iota,),
+                           num_keys=len(operands), is_stable=True)
+        return take_columns(table, out[-1], nrows_out)
     payloads, pack = columns_to_payloads(table.columns, table.capacity)
     out = jax.lax.sort(tuple(operands) + tuple(payloads),
                        num_keys=len(operands), is_stable=True)

@@ -65,13 +65,24 @@ def _unique_compiled(table: Table, *, cols, keep, out_cap) -> Table:
     original order, so the first/last position IS the first/last
     occurrence); (2) re-sort by (not-representative, original index) to
     emit representatives in original row order."""
+    from cylon_tpu.ops.selection import (PAYLOAD_SORT_MAX_WORDS,
+                                         payload_words)
+
     cap = table.capacity
     names = cols if cols is not None else tuple(table.column_names)
     keys = [table.column(n).data for n in names]
     vals = [table.column(n).validity for n in names]
     iota = jnp.arange(cap, dtype=jnp.int32)
-    payloads, pack = columns_to_payloads(table.columns, cap,
-                                        lead=[iota], index_slot=0)
+    wide = payload_words(table.columns) > PAYLOAD_SORT_MAX_WORDS
+    if wide:
+        # wide tables: neither sort carries the columns — the group
+        # sort and the order-restoring sort both move only row ids,
+        # then ONE packed gather materialises the representatives
+        # (selection.PAYLOAD_SORT_MAX_WORDS has the measured crossover)
+        payloads, pack = [iota], None
+    else:
+        payloads, pack = columns_to_payloads(table.columns, cap,
+                                             lead=[iota], index_slot=0)
     gid_s, num_groups, sorted_pl = kernels.group_sort(
         keys, table.nrows, vals, payloads)
     orig_s = sorted_pl[0]
@@ -80,11 +91,18 @@ def _unique_compiled(table: Table, *, cols, keep, out_cap) -> Table:
     else:
         is_rep = (gid_s != jnp.roll(gid_s, -1)) | (iota == cap - 1)
     is_rep = is_rep & (gid_s < cap)       # padding has the sentinel id
-    sorted_cols = payloads_to_columns(table.columns, sorted_pl, pack)
-    operands = kernels.pack_order_keys(
-        [(~is_rep).astype(jnp.uint8), orig_s.astype(jnp.uint32)])
-    out = permute_by_sort(Table(sorted_cols, num_groups), operands,
-                          num_groups)
+    if wide:
+        # orig_s is a non-negative int32, so it is its own order key
+        _, orig_final = jax.lax.sort(
+            ((~is_rep).astype(jnp.uint8), orig_s), num_keys=2,
+            is_stable=True)
+        out = take_columns(table, orig_final, num_groups)
+    else:
+        sorted_cols = payloads_to_columns(table.columns, sorted_pl, pack)
+        operands = kernels.pack_order_keys(
+            [(~is_rep).astype(jnp.uint8), orig_s.astype(jnp.uint32)])
+        out = permute_by_sort(Table(sorted_cols, num_groups), operands,
+                              num_groups)
     return kernels.carry_overflow(_trim_capacity(out, out_cap, num_groups),
                                   table)
 

@@ -61,6 +61,71 @@ def _df(x) -> DataFrame:
     return DataFrame(x, string_storage=TPCH_STRING_STORAGE)
 
 
+#: per-table column-name prefix; only columns carrying their own
+#: table's prefix are pruning candidates (partsupp columns all start
+#: ps_, so the part table's p_ test never sees them — tables are
+#: pruned one at a time)
+_TPCH_PREFIXES = {"lineitem": "l_", "orders": "o_", "customer": "c_",
+                  "supplier": "s_", "part": "p_", "partsupp": "ps_",
+                  "nation": "n_", "region": "r_"}
+
+
+def _code_strings(code) -> set:
+    """Every string constant reachable from a code object: nested
+    lambdas/comprehensions recurse, tuple constants (column-name lists
+    compile to tuple consts) flatten."""
+    out = set()
+    for c in code.co_consts:
+        if isinstance(c, str):
+            out.add(c)
+        elif isinstance(c, tuple):
+            out |= {e for e in c if isinstance(e, str)}
+        elif hasattr(c, "co_consts"):
+            out |= _code_strings(c)
+    return out
+
+
+def _query_strings(code, globalns, depth: int = 2) -> set:
+    """String constants of a query function AND of the module helpers
+    it calls (resolved through ``co_names`` — e.g. ``_with_revenue``
+    names ``l_extendedprice``/``l_discount`` in its own code object,
+    invisible to the caller's constants), so pruning survives new
+    helpers without per-helper special cases."""
+    out = _code_strings(code)
+    if depth:
+        for name in code.co_names:
+            g = globalns.get(name)
+            fc = getattr(g, "__code__", None)
+            if fc is not None:
+                out |= _query_strings(fc, globalns, depth - 1)
+    return out
+
+
+def _prune(df: DataFrame, table_name: str, strings: set) -> DataFrame:
+    """Projection pushdown: drop this table's columns the calling query
+    never names (the reference reads only referenced columns at scan
+    time too). Conservative: only columns carrying the table's own
+    TPC-H prefix are candidates, and lineitem always keeps the revenue
+    inputs (``_with_revenue`` references them from its own code
+    object, invisible to the caller's constants). At SF1 this is what
+    keeps e.g. Q6 from dragging the 44-byte ``l_comment`` words
+    through every filter sort."""
+    prefix = _TPCH_PREFIXES.get(table_name)
+    if prefix is None:
+        return df
+    # long constants (the docstring with the query's SQL text) match by
+    # substring, so a column named only there still survives — pruning
+    # must only ever overapproximate
+    long_strs = [s for s in strings if len(s) > 60]
+    cols = df.table.column_names
+    keep = [c for c in cols
+            if not c.startswith(prefix) or c in strings
+            or any(c in s for s in long_strs)]
+    if len(keep) == len(cols):
+        return df
+    return df[keep]
+
+
 def _tables(data: Mapping, names, env=None) -> list[DataFrame]:
     """Coerce inputs to the layout the query runs in. With an ``env``
     every input is laid out on the mesh (already-distributed frames pass
@@ -68,15 +133,27 @@ def _tables(data: Mapping, names, env=None) -> list[DataFrame]:
     groupbys and sorts all run shard-local — no input is ever gathered
     (the reference's SPMD contract, ``docs/docs/arch.md:41-48``: every
     rank computes on its own partition). With ``env=None`` inputs are
-    materialised to the local layout (the pandas-exact eager path)."""
+    materialised to the local layout (the pandas-exact eager path).
+
+    Inputs are PROJECTED to the columns the calling query references
+    (its code object's string constants — :func:`_prune`) before any
+    compute, so unreferenced columns never enter a filter/shuffle."""
+    import sys
+
     missing = [n for n in names if n not in data]
     if missing:
         raise InvalidArgument(f"tpch input missing tables {missing}")
+    caller = sys._getframe(1)
+    strings = _query_strings(caller.f_code, caller.f_globals)
     if env is None:
-        return [_df(data[n])._materialized() for n in names]
+        return [_prune(_df(data[n])._materialized(), n, strings)
+                for n in names]
     from cylon_tpu.parallel import scatter_table
 
-    return [DataFrame._wrap(scatter_table(env, _df(data[n]).table))
+    # prune BEFORE the mesh layout: a dropped column must never be
+    # device_put across the mesh in the first place
+    return [DataFrame._wrap(scatter_table(
+        env, _prune(_df(data[n]), n, strings).table))
             for n in names]
 
 

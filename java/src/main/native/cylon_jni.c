@@ -159,6 +159,75 @@ static int cmp_pstr(const void *a, const void *b) {
   return strcmp(*(const char *const *) a, *(const char *const *) b);
 }
 
+/* JNI strings cross the boundary as MODIFIED UTF-8 (CESU-8 surrogate
+ * pairs for supplementary chars, C0 80 for U+0000), but the catalog
+ * sidecar blobs are STRICT UTF-8 (the Python binding writes and
+ * decodes them). Transcode both directions so cross-binding joins
+ * compare identical bytes. U+0000 inside a value is unsupported by the
+ * string layer (NUL-delimited plumbing) and becomes U+FFFD. */
+static char *mutf8_to_utf8(const char *in) {
+  size_t n = strlen(in);
+  char *out = malloc(n + 4);  /* never longer than input (+FFFD slack) */
+  size_t i = 0, w = 0;
+  while (i < n) {
+    unsigned char a = (unsigned char) in[i];
+    if (a == 0xC0 && i + 1 < n && (unsigned char) in[i + 1] == 0x80) {
+      out[w++] = (char) 0xEF;  /* U+FFFD: embedded NUL unsupported */
+      out[w++] = (char) 0xBF;
+      out[w++] = (char) 0xBD;
+      i += 2;
+      continue;
+    }
+    if (a == 0xED && i + 5 < n) {
+      unsigned b = (unsigned char) in[i + 1], c = (unsigned char) in[i + 2];
+      unsigned d = (unsigned char) in[i + 3], e = (unsigned char) in[i + 4];
+      unsigned f = (unsigned char) in[i + 5];
+      if (b >= 0xA0 && b <= 0xAF && d == 0xED && e >= 0xB0 && e <= 0xBF) {
+        unsigned hi = 0xD800u | ((b & 0x0Fu) << 6) | (c & 0x3Fu);
+        unsigned lo = 0xDC00u | ((e & 0x0Fu) << 6) | (f & 0x3Fu);
+        unsigned cp = 0x10000u + ((hi - 0xD800u) << 10) + (lo - 0xDC00u);
+        out[w++] = (char) (0xF0 | (cp >> 18));
+        out[w++] = (char) (0x80 | ((cp >> 12) & 0x3F));
+        out[w++] = (char) (0x80 | ((cp >> 6) & 0x3F));
+        out[w++] = (char) (0x80 | (cp & 0x3F));
+        i += 6;
+        continue;
+      }
+    }
+    out[w++] = in[i++];
+  }
+  out[w] = 0;
+  return out;
+}
+
+static char *utf8_to_mutf8(const char *in, size_t n) {
+  /* worst case: every 4-byte sequence becomes 6 bytes */
+  char *out = malloc(n * 3 / 2 + 4);
+  size_t i = 0, w = 0;
+  while (i < n) {
+    unsigned char a = (unsigned char) in[i];
+    if (a >= 0xF0 && i + 3 < n) {
+      unsigned cp = ((a & 0x07u) << 18)
+          | (((unsigned char) in[i + 1] & 0x3Fu) << 12)
+          | (((unsigned char) in[i + 2] & 0x3Fu) << 6)
+          | ((unsigned char) in[i + 3] & 0x3Fu);
+      unsigned hi = 0xD800u + ((cp - 0x10000u) >> 10);
+      unsigned lo = 0xDC00u + ((cp - 0x10000u) & 0x3FFu);
+      out[w++] = (char) 0xED;
+      out[w++] = (char) (0xA0 | ((hi >> 6) & 0x0F));
+      out[w++] = (char) (0x80 | (hi & 0x3F));
+      out[w++] = (char) 0xED;
+      out[w++] = (char) (0xB0 | ((lo >> 6) & 0x0F));
+      out[w++] = (char) (0x80 | (lo & 0x3F));
+      i += 4;
+      continue;
+    }
+    out[w++] = in[i++];
+  }
+  out[w] = 0;
+  return out;
+}
+
 JNIEXPORT void JNICALL
 Java_org_cylondata_cylon_Table_nativePutColumns(JNIEnv *env, jclass cls,
                                                 jstring jid,
@@ -302,7 +371,7 @@ Java_org_cylondata_cylon_Table_nativePutColumns(JNIEnv *env, jclass cls,
           any_null = 1;
         } else {
           const char *u = (*env)->GetStringUTFChars(env, js, NULL);
-          svals[i] = strdup(u ? u : "");
+          svals[i] = mutf8_to_utf8(u ? u : "");  /* strict UTF-8 blob */
           if (u) (*env)->ReleaseStringUTFChars(env, js, u);
           (*env)->DeleteLocalRef(env, js);
           valid[i] = 1;
@@ -471,9 +540,8 @@ Java_org_cylondata_cylon_Table_nativeReadDictValues(JNIEnv *env, jclass cls,
     out = (*env)->NewObjectArray(env, k, strcls, NULL);
     for (jsize v = 0; v < k; v++) {
       int64_t a = offs[v], b = offs[v + 1];
-      char *tmp = malloc((size_t) (b - a) + 1);
-      memcpy(tmp, blob + a, (size_t) (b - a));
-      tmp[b - a] = 0;
+      /* NewStringUTF expects MODIFIED UTF-8; the blob is strict */
+      char *tmp = utf8_to_mutf8(blob + a, (size_t) (b - a));
       jstring s = (*env)->NewStringUTF(env, tmp);
       (*env)->SetObjectArrayElement(env, out, v, s);
       free(tmp);
