@@ -194,12 +194,17 @@ def _run_tpch(sf, reps, tag_hbm: bool = False):
         _hbm_stats(f"tpch_sf{sf}_ingest")
     only = os.environ.get("CYLON_BENCH_TPCH_QUERIES")
     only = set(only.split(",")) if only else None
+    # eager mode: one compiled program PER OPERATOR instead of per
+    # query — at very large scale factors the whole-query programs can
+    # take minutes each to compile, and the per-op executables are
+    # shared across queries
+    eager = os.environ.get("CYLON_BENCH_TPCH_MODE") == "eager"
     scalar_q = ("q6", "q14", "q17", "q19")
     names = [f"q{i}" for i in range(1, 23)]
     for qname in names:
         if only is not None and qname not in only:
             continue
-        qfn = tpch.compiled(qname)
+        qfn = getattr(tpch, qname) if eager else tpch.compiled(qname)
         res = {}
         if qname in scalar_q:
             t = _timeit(lambda: res.__setitem__("r", np.float64(qfn(dfs))),

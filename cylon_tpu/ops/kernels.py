@@ -481,16 +481,28 @@ def segmented_totals(gid_s: jax.Array, out_cap: int,
     scanned = jax.lax.associative_scan(
         combine, tuple(carriers) + (first,))
 
-    # compaction: last rows first, in (ascending-gid) order. Every 1-D
-    # element of every channel rides the one sort; multi-dim elements
-    # are extracted afterwards by one small [out_cap]-row gather through
-    # the compacted source positions.
+    # compaction: last rows first, in (ascending-gid) order. NARROW
+    # channel sets ride the one sort as payloads; WIDE ones (or small
+    # out_cap) sort only (keep, extras, iota) and fetch every channel
+    # by [out_cap]-row gathers through the compacted source positions
+    # — each payload operand re-moves its bytes through every merge
+    # stage of the O(log^2 n) network, which at SF1 scale (6M rows,
+    # ~10 f64 channels) turned this one sort into minutes, while the
+    # pos-gathers are out_cap rows each (see
+    # selection.PAYLOAD_SORT_MAX_WORDS for the measured crossover)
     keep = (~last).astype(jnp.uint8)
     flat_ops = []
     for arrs in scanned[:-1]:
         for e in arrs:
             if e.ndim == 1:
                 flat_ops.append(e)
+    from cylon_tpu.ops.selection import PAYLOAD_SORT_MAX_WORDS
+
+    flat_words = sum(2 if e.dtype.itemsize == 8 else 1 for e in flat_ops)
+    ride_sort = (flat_words <= PAYLOAD_SORT_MAX_WORDS
+                 and out_cap > cap // 4)
+    if not ride_sort:
+        flat_ops = []
     sorted_out = jax.lax.sort(
         (keep,) + tuple(flat_ops) + tuple(extras) + (iota,),
         num_keys=1, is_stable=True)
@@ -507,17 +519,18 @@ def segmented_totals(gid_s: jax.Array, out_cap: int,
     flat_sorted = list(sorted_out[1:1 + len(flat_ops)])
     extra_sorted = [fit(e) for e in sorted_out[1 + len(flat_ops):-1]]
     pos = fit(sorted_out[-1])   # source row of each compacted slot
+    pos_safe = jnp.clip(pos, 0, cap - 1)
 
     outputs = []
     fi = 0
     for arrs in scanned[:-1]:
         chan_out = []
         for e in arrs:
-            if e.ndim == 1:
+            if e.ndim == 1 and ride_sort:
                 chan_out.append(fit(flat_sorted[fi]))
                 fi += 1
             else:
-                chan_out.append(e[jnp.clip(pos, 0, cap - 1)])
+                chan_out.append(e[pos_safe])
         outputs.append(tuple(chan_out))
     return outputs, extra_sorted
 

@@ -167,7 +167,8 @@ static int cmp_pstr(const void *a, const void *b) {
  * string layer (NUL-delimited plumbing) and becomes U+FFFD. */
 static char *mutf8_to_utf8(const char *in) {
   size_t n = strlen(in);
-  char *out = malloc(n + 4);  /* never longer than input (+FFFD slack) */
+  /* worst growth: a 2-byte C0 80 becomes a 3-byte U+FFFD (1.5x) */
+  char *out = malloc(n * 3 / 2 + 4);
   size_t i = 0, w = 0;
   while (i < n) {
     unsigned char a = (unsigned char) in[i];
@@ -178,19 +179,32 @@ static char *mutf8_to_utf8(const char *in) {
       i += 2;
       continue;
     }
-    if (a == 0xED && i + 5 < n) {
+    if (a == 0xED && i + 2 < n) {
       unsigned b = (unsigned char) in[i + 1], c = (unsigned char) in[i + 2];
-      unsigned d = (unsigned char) in[i + 3], e = (unsigned char) in[i + 4];
-      unsigned f = (unsigned char) in[i + 5];
-      if (b >= 0xA0 && b <= 0xAF && d == 0xED && e >= 0xB0 && e <= 0xBF) {
-        unsigned hi = 0xD800u | ((b & 0x0Fu) << 6) | (c & 0x3Fu);
-        unsigned lo = 0xDC00u | ((e & 0x0Fu) << 6) | (f & 0x3Fu);
-        unsigned cp = 0x10000u + ((hi - 0xD800u) << 10) + (lo - 0xDC00u);
-        out[w++] = (char) (0xF0 | (cp >> 18));
-        out[w++] = (char) (0x80 | ((cp >> 12) & 0x3F));
-        out[w++] = (char) (0x80 | ((cp >> 6) & 0x3F));
-        out[w++] = (char) (0x80 | (cp & 0x3F));
-        i += 6;
+      if (b >= 0xA0 && b <= 0xAF && i + 5 < n) {
+        unsigned d = (unsigned char) in[i + 3];
+        unsigned e = (unsigned char) in[i + 4];
+        unsigned f = (unsigned char) in[i + 5];
+        if (d == 0xED && e >= 0xB0 && e <= 0xBF) {
+          unsigned hi = 0xD800u | ((b & 0x0Fu) << 6) | (c & 0x3Fu);
+          unsigned lo = 0xDC00u | ((e & 0x0Fu) << 6) | (f & 0x3Fu);
+          unsigned cp = 0x10000u + ((hi - 0xD800u) << 10)
+              + (lo - 0xDC00u);
+          out[w++] = (char) (0xF0 | (cp >> 18));
+          out[w++] = (char) (0x80 | ((cp >> 12) & 0x3F));
+          out[w++] = (char) (0x80 | ((cp >> 6) & 0x3F));
+          out[w++] = (char) (0x80 | (cp & 0x3F));
+          i += 6;
+          continue;
+        }
+      }
+      if (b >= 0xA0 && b <= 0xBF) {
+        /* UNPAIRED surrogate (legal in a Java String): no valid UTF-8
+         * form exists — U+FFFD keeps the blob strictly decodable */
+        out[w++] = (char) 0xEF;
+        out[w++] = (char) 0xBF;
+        out[w++] = (char) 0xBD;
+        i += 3;
         continue;
       }
     }
