@@ -185,6 +185,25 @@ def _run_tpch(sf, reps, tag_hbm: bool = False):
     from cylon_tpu.tpch import dbgen
 
     data = dbgen.generate(sf=sf, seed=0)
+    only = os.environ.get("CYLON_BENCH_TPCH_QUERIES")
+    valid = {f"q{i}" for i in range(1, 23)}
+    only = ({q.strip() for q in only.split(",")} & valid) if only else None
+    if only and os.environ.get("CYLON_BENCH_TPCH_PRUNE_INGEST",
+                               "1") != "0":
+        # query-subset runs ingest only the columns those queries
+        # reference (the storage-scan projection any engine does) —
+        # at SF10 a full lineitem load alone is ~10 GB of HBM.
+        # keep_columns is the SAME predicate queries._prune applies, so
+        # the two layers cannot diverge
+        from cylon_tpu.tpch import queries as _q
+
+        strings = set()
+        for qn in sorted(only):
+            strings |= _q._query_strings(getattr(_q, qn).__code__,
+                                         vars(_q))
+        data = {t: {c: v for c, v in cols.items()
+                    if c in _q.keep_columns(t, cols, strings)}
+                for t, cols in data.items()}
     # tables pre-ingested once (the reference's TPC-H timing also runs
     # on loaded tables); tpch.ingest applies the storage policy
     # (comment columns as device bytes — at SF>=1 a host dictionary
@@ -192,8 +211,6 @@ def _run_tpch(sf, reps, tag_hbm: bool = False):
     dfs = tpch.ingest(data)
     if tag_hbm:
         _hbm_stats(f"tpch_sf{sf}_ingest")
-    only = os.environ.get("CYLON_BENCH_TPCH_QUERIES")
-    only = set(only.split(",")) if only else None
     # eager mode: one compiled program PER OPERATOR instead of per
     # query — at very large scale factors the whole-query programs can
     # take minutes each to compile, and the per-op executables are
@@ -243,30 +260,40 @@ def scale_main():
     out = {}
 
     if n:
-        left = Table.from_pydict(
-            {"k": rng.integers(0, n, n).astype(np.int64),
-             "a": rng.normal(size=n)})
-        right = Table.from_pydict(
-            {"k": rng.integers(0, n, n).astype(np.int64),
-             "b": rng.normal(size=n)})
-        _hbm_stats(f"join_{n}_ingest")
-        f1 = jax.jit(lambda l, r: join(l, r, on="k", how="inner",
-                                       out_capacity=2 * n))
-        t = _timeit(lambda: out.__setitem__("r", f1(left, right)),
-                    lambda: out["r"].nrows, reps)
-        _emit(f"local_inner_merge_{n}_rows_per_sec", n / t, "rows/s",
-              1e9 / 4.0 / 64)
-        _hbm_stats(f"join_{n}_end")
-        del left, right, out["r"]
+        try:
+            left = Table.from_pydict(
+                {"k": rng.integers(0, n, n).astype(np.int64),
+                 "a": rng.normal(size=n)})
+            right = Table.from_pydict(
+                {"k": rng.integers(0, n, n).astype(np.int64),
+                 "b": rng.normal(size=n)})
+            _hbm_stats(f"join_{n}_ingest")
+            f1 = jax.jit(lambda l, r: join(l, r, on="k", how="inner",
+                                           out_capacity=2 * n))
+            t = _timeit(lambda: out.__setitem__("r", f1(left, right)),
+                        lambda: out["r"].nrows, reps)
+            _emit(f"local_inner_merge_{n}_rows_per_sec", n / t, "rows/s",
+                  1e9 / 4.0 / 64)
+            _hbm_stats(f"join_{n}_end")
+        except Exception as e:  # OOM at this shape is itself a result
+            _emit(f"local_inner_merge_{n}_oom", 1, type(e).__name__)
+        finally:
+            out.clear()
+            left = right = None
 
-        st = Table.from_pydict(
-            {"k": rng.integers(0, 2**40, n).astype(np.int64)})
-        f2 = jax.jit(lambda tt: sort_table(tt, ["k"]))
-        t = _timeit(lambda: out.__setitem__("s", f2(st)),
-                    lambda: out["s"].column("k").data[:1], reps)
-        _emit(f"sort_{n}_rows_per_sec", n / t, "rows/s")
-        _hbm_stats(f"sort_{n}_end")
-        del st, out["s"]
+        try:
+            st = Table.from_pydict(
+                {"k": rng.integers(0, 2**40, n).astype(np.int64)})
+            f2 = jax.jit(lambda tt: sort_table(tt, ["k"]))
+            t = _timeit(lambda: out.__setitem__("s", f2(st)),
+                        lambda: out["s"].column("k").data[:1], reps)
+            _emit(f"sort_{n}_rows_per_sec", n / t, "rows/s")
+            _hbm_stats(f"sort_{n}_end")
+        except Exception as e:
+            _emit(f"sort_{n}_oom", 1, type(e).__name__)
+        finally:
+            out.clear()
+            st = None
 
     if sf:
         _run_tpch(sf, reps, tag_hbm=True)

@@ -125,24 +125,20 @@ def exchange_arrays(arrays, pid, n_local, out_cap: int,
         # bench_suite TPU section): 500k rows x (i64 key + f64 + 28-byte
         # string) shuffle ≈ 0.48 s end-to-end eager (~1.0M rows/s,
         # including the ~110 ms tunnel RPC per dispatch and the
-        # adaptive count check); the ragged DMA itself is not the
-        # bottleneck at W=1. Multi-chip ICI numbers need real hardware.
+        # adaptive count check). All columns ride ONE packed u32 word
+        # matrix: one destination-order gather and ONE ragged
+        # collective per exchange instead of ~2 per column.
         in_offs = kernels.exclusive_cumsum(counts)
         # offset of MY block inside each destination's receive buffer:
         # sum of earlier senders' contributions to that destination
         out_offs = (jnp.cumsum(cmat, axis=0) - cmat)[me, :]
-        outs = []
-        for a in arrays:
-            a_sorted = a[order]
-            parts, restore = _transportable(a_sorted)
-            got = []
-            for transport in parts:
-                buf = jnp.zeros((out_cap,) + transport.shape[1:],
-                                transport.dtype)
-                got.append(jax.lax.ragged_all_to_all(
-                    transport, buf, in_offs, counts, out_offs, recv_sizes,
-                    axis_name=axis_name))
-            outs.append(restore(got))
+        packed, spec = _pack_words(arrays)
+        psorted = packed[order]
+        buf = jnp.zeros((out_cap, psorted.shape[1]), jnp.uint32)
+        got = jax.lax.ragged_all_to_all(
+            psorted, buf, in_offs, counts, out_offs, recv_sizes,
+            axis_name=axis_name)
+        outs = _unpack_words(got, spec)
         n_recv = jnp.where(n_recv_true > out_cap, out_cap + 1, n_recv_true)
         return outs, n_recv.astype(jnp.int32)
 
@@ -170,31 +166,23 @@ def exchange_arrays(arrays, pid, n_local, out_cap: int,
     recv_valid = (pos % b) < recv_block_sizes[pos // b]
     keep = (~recv_valid).astype(jnp.uint8)
 
-    outs = []
-    compact_perm = None
-    for a in arrays:
-        a_sorted = a[order]
-        parts, restore = _transportable(a_sorted)
-        got = []
-        for transport in parts:
-            buf = jnp.zeros((w * b,) + transport.shape[1:], transport.dtype)
-            buf = buf.at[slot].set(transport, mode="drop")
-            swapped = jax.lax.all_to_all(
-                buf.reshape((w, b) + transport.shape[1:]),
-                axis_name, split_axis=0, concat_axis=0)
-            flat = swapped.reshape((w * b,) + transport.shape[1:])
-            if compact_perm is None:
-                _, compact_perm = jax.lax.sort(
-                    (keep, jnp.arange(w * b, dtype=jnp.int32)), num_keys=1)
-            compacted = flat[compact_perm]
-            if w * b >= out_cap:
-                compacted = compacted[:out_cap]
-            else:
-                pad = jnp.zeros((out_cap - w * b,) + transport.shape[1:],
-                                transport.dtype)
-                compacted = jnp.concatenate([compacted, pad])
-            got.append(compacted)
-        outs.append(restore(got))
+    packed, spec = _pack_words(arrays)
+    nw = packed.shape[1]
+    psorted = packed[order]
+    buf = jnp.zeros((w * b, nw), jnp.uint32).at[slot].set(psorted,
+                                                          mode="drop")
+    swapped = jax.lax.all_to_all(buf.reshape(w, b, nw), axis_name,
+                                 split_axis=0, concat_axis=0)
+    flat = swapped.reshape(w * b, nw)
+    _, compact_perm = jax.lax.sort(
+        (keep, jnp.arange(w * b, dtype=jnp.int32)), num_keys=1)
+    compacted = flat[compact_perm]
+    if w * b >= out_cap:
+        compacted = compacted[:out_cap]
+    else:
+        compacted = jnp.concatenate(
+            [compacted, jnp.zeros((out_cap - w * b, nw), jnp.uint32)])
+    outs = _unpack_words(compacted, spec)
 
     # fold all failure modes into an impossible row count:
     # - a (sender,dest) bucket overflowed somewhere (psum of flags)
@@ -259,19 +247,15 @@ def _exchange_padded_chunked(arrays, pid_sorted, order, n_recv_true,
     pos = jnp.arange(w * b, dtype=jnp.int32)
     s_idx, r_idx = pos // b, pos % b
 
-    outs_parts = []   # per array: list of received part buffers
-    restores = []
-    sorted_parts = []
-    for a in arrays:
-        parts, restore = _transportable(a[order])
-        if padn:
-            parts = [jnp.concatenate(
-                [p, jnp.zeros((padn,) + p.shape[1:], p.dtype)])
-                for p in parts]
-        sorted_parts.append(parts)
-        restores.append(restore)
-        outs_parts.append([jnp.zeros((out_cap,) + p.shape[1:], p.dtype)
-                           for p in parts])
+    # all columns ride one packed u32 word matrix: one gather into
+    # destination order, one all_to_all per round (not ~2 per column)
+    packed, spec = _pack_words(arrays)
+    nw = packed.shape[1]
+    psorted = packed[order]
+    if padn:
+        psorted = jnp.concatenate(
+            [psorted, jnp.zeros((padn, nw), jnp.uint32)])
+    out_buf = jnp.zeros((out_cap, nw), jnp.uint32)
 
     for c in range(nch):
         sl = slice(c * b, (c + 1) * b)
@@ -287,18 +271,14 @@ def _exchange_padded_chunked(arrays, pid_sorted, order, n_recv_true,
         # bounds for the receive buffer, dropped by mode="drop" — the
         # n_recv fold below still reports the true total
         target = jnp.where(rvalid, target, out_cap).astype(jnp.int32)
-        for parts, outs in zip(sorted_parts, outs_parts):
-            for i, p in enumerate(parts):
-                buf = jnp.zeros((w * b,) + p.shape[1:], p.dtype)
-                buf = buf.at[slot].set(p[sl], mode="drop")
-                swapped = jax.lax.all_to_all(
-                    buf.reshape((w, b) + p.shape[1:]),
-                    axis_name, split_axis=0, concat_axis=0)
-                flat = swapped.reshape((w * b,) + p.shape[1:])
-                outs[i] = outs[i].at[target].set(flat, mode="drop")
+        buf = jnp.zeros((w * b, nw), jnp.uint32)
+        buf = buf.at[slot].set(psorted[sl], mode="drop")
+        swapped = jax.lax.all_to_all(buf.reshape(w, b, nw), axis_name,
+                                     split_axis=0, concat_axis=0)
+        flat = swapped.reshape(w * b, nw)
+        out_buf = out_buf.at[target].set(flat, mode="drop")
 
-    outs = [restore(parts)
-            for restore, parts in zip(restores, outs_parts)]
+    outs = _unpack_words(out_buf, spec)
     n_recv = jnp.where(n_recv_true > out_cap, out_cap + 1, n_recv_true)
     return outs, n_recv.astype(jnp.int32)
 
@@ -418,6 +398,94 @@ def _transportable(a):
 
         return [lo, hi], restore
     return [a], lambda xs: xs[0]
+
+
+def _pack_words(arrays):
+    """All transport arrays bit-packed into ONE [cap, W] uint32 matrix
+    (+ a spec for :func:`_unpack_words`).
+
+    One matrix means ONE destination-order gather and ONE collective
+    per exchange round instead of ~2 per column: a random row gather
+    costs the same per index for 1 lane or 128, and each extra
+    ``(ragged_)all_to_all`` pays its own DMA setup. 64-bit values ride
+    the same splits as :func:`_transportable` (exact lo/hi words for
+    ints; the (hi, lo) f32 pair on TPU, a lossless u32-pair bitcast
+    elsewhere); bytes columns are already word matrices.
+    """
+    from cylon_tpu.platform import current_platform
+
+    tpu = current_platform() == "tpu"
+    mats, spec = [], []
+    for a in arrays:
+        dt = a.dtype
+        if a.ndim == 2:  # device-bytes string column: already words
+            mats.append(a.astype(jnp.uint32))
+            spec.append(("words", a.shape[1], dt))
+        elif dt == jnp.bool_:
+            mats.append(a.astype(jnp.uint32)[:, None])
+            spec.append(("bool", 1, dt))
+        elif dt.itemsize == 8:
+            if jnp.issubdtype(dt, jnp.floating):
+                if tpu:
+                    hi = a.astype(jnp.float32)
+                    lo = jnp.where(
+                        jnp.isfinite(a) & jnp.isfinite(hi),
+                        (a - hi.astype(jnp.float64)).astype(jnp.float32),
+                        jnp.float32(0))
+                    pair = jnp.stack(
+                        [jax.lax.bitcast_convert_type(hi, jnp.uint32),
+                         jax.lax.bitcast_convert_type(lo, jnp.uint32)],
+                        axis=1)
+                    mats.append(pair)
+                    spec.append(("f64pair", 2, dt))
+                else:
+                    mats.append(jax.lax.bitcast_convert_type(a, jnp.uint32))
+                    spec.append(("bits64", 2, dt))
+            else:
+                u = a.astype(jnp.uint64)
+                lo = (u & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32)
+                hi = (u >> jnp.uint64(32)).astype(jnp.uint32)
+                mats.append(jnp.stack([lo, hi], axis=1))
+                spec.append(("i64pair", 2, dt))
+        elif dt.itemsize == 4:
+            mats.append(jax.lax.bitcast_convert_type(a, jnp.uint32)[:, None])
+            spec.append(("bits32", 1, dt))
+        else:  # 1/2-byte: zero-extend through the matching unsigned
+            udt = jnp.dtype(f"uint{dt.itemsize * 8}")
+            mats.append(jax.lax.bitcast_convert_type(a, udt)
+                        .astype(jnp.uint32)[:, None])
+            spec.append(("small", 1, dt))
+    packed = mats[0] if len(mats) == 1 else jnp.concatenate(mats, axis=1)
+    return packed, spec
+
+
+def _unpack_words(m, spec):
+    outs = []
+    off = 0
+    for kind, w, dt in spec:
+        sl = m[:, off:off + w]
+        off += w
+        if kind == "words":
+            outs.append(sl.astype(dt))
+        elif kind == "bool":
+            outs.append(sl[:, 0] != 0)
+        elif kind == "f64pair":
+            hi = jax.lax.bitcast_convert_type(sl[:, 0], jnp.float32)
+            lo = jax.lax.bitcast_convert_type(sl[:, 1], jnp.float32)
+            outs.append(hi.astype(jnp.float64) + lo.astype(jnp.float64))
+        elif kind == "bits64":
+            outs.append(jax.lax.bitcast_convert_type(sl, dt))
+        elif kind == "i64pair":
+            v = ((sl[:, 1].astype(jnp.uint64) << jnp.uint64(32))
+                 | sl[:, 0].astype(jnp.uint64))
+            outs.append(v.astype(dt))
+        elif kind == "bits32":
+            outs.append(jax.lax.bitcast_convert_type(sl[:, 0], dt))
+        else:  # small
+            udt = jnp.dtype(f"uint{dt.itemsize * 8}")
+            outs.append(jax.lax.bitcast_convert_type(
+                sl[:, 0].astype(udt), dt))
+    return outs
 
 
 def shuffle_local(table: Table, pid, out_cap: int,

@@ -286,6 +286,14 @@ class Series:
         return Series._wrap(Column(mask, None, dtypes.bool_), self._nrows,
                             self.name)
 
+    @property
+    def str(self) -> "_StrAccessor":
+        """pandas-style string accessor (``s.str.startswith(...)``),
+        covering both device layouts: device-bytes columns run windowed
+        byte kernels on device, dictionary columns evaluate once per
+        distinct value on host."""
+        return _StrAccessor(self)
+
     def str_startswith(self, prefix: str) -> "Series":
         """Rows whose value starts with ``prefix`` (pandas
         ``Series.str.startswith``; always literal). Device-bytes
@@ -404,3 +412,81 @@ class Series:
                 seen.add(k)
                 out.append(v)
         return np.asarray(out, dtype=vals.dtype)
+
+
+class _StrAccessor:
+    """``Series.str`` — the pandas string-method namespace (parity:
+    pandas ``Series.str``; the reference exposes string compute through
+    pycylon's compute surface). Methods dispatch on the column's device
+    layout; see the ``str_*`` methods on :class:`Series`."""
+
+    def __init__(self, s: Series):
+        self._s = s
+
+    def startswith(self, prefix: str) -> Series:
+        return self._s.str_startswith(prefix)
+
+    def endswith(self, suffix: str) -> Series:
+        return self._s.str_endswith(suffix)
+
+    def contains(self, pat: str, regex: bool = True) -> Series:
+        return self._s.str_contains(pat, regex=regex)
+
+    def len(self) -> Series:
+        """Value length in characters for dictionary columns (host map
+        over distinct values); in UTF-8 BYTES for device-bytes columns
+        (device row_lengths — equal for ASCII data)."""
+        s = self._s
+        c = s.column
+        if c.dtype.is_bytes:
+            from cylon_tpu.ops import bytescol
+
+            data = bytescol.row_lengths(c.data)
+            return Series._wrap(Column(data, c.validity, dtypes.int32),
+                                s._nrows, s.name)
+        if c.dtype.is_dictionary:
+            import numpy as np
+
+            vals = ([] if c.dictionary is None
+                    else [len(str(v)) for v in c.dictionary.values])
+            lut = jnp.asarray(np.asarray(vals or [0], np.int32))
+            data = lut[jnp.clip(c.data, 0, max(len(vals) - 1, 0))]
+            return Series._wrap(Column(data, c.validity, dtypes.int32),
+                                s._nrows, s.name)
+        raise TypeError_("str.len() on non-string column")
+
+    def _ascii_case(self, upper: bool) -> Series:
+        s = self._s
+        c = s.column
+        if c.dtype.is_bytes:
+            # ASCII case transform fully on device: flip bit 5 of a-z /
+            # A-Z bytes inside each big-endian word; non-ASCII (>=0x80)
+            # bytes are multi-byte UTF-8 payload and pass through
+            lo, hi = (0x61, 0x7A) if upper else (0x41, 0x5A)
+            data = c.data
+            out = jnp.zeros_like(data)
+            for shift in (24, 16, 8, 0):
+                b = (data >> jnp.uint32(shift)) & jnp.uint32(0xFF)
+                flip = (b >= lo) & (b <= hi)
+                b = jnp.where(flip, b ^ jnp.uint32(0x20), b)
+                out = out | (b << jnp.uint32(shift))
+            return Series._wrap(Column(out, c.validity, c.dtype),
+                                s._nrows, s.name)
+        if c.dtype.is_dictionary:
+            from cylon_tpu.ops.dictenc import reencode_values
+
+            fn = str.upper if upper else str.lower
+            vals = [None if v is None else fn(str(v))
+                    for v in (c.dictionary.values
+                              if c.dictionary is not None else [])]
+            return Series._wrap(reencode_values(c, vals), s._nrows,
+                                s.name)
+        raise TypeError_("str case transform on non-string column")
+
+    def upper(self) -> Series:
+        """ASCII upper-case (device-side for bytes columns; non-ASCII
+        characters pass through unchanged)."""
+        return self._ascii_case(True)
+
+    def lower(self) -> Series:
+        return self._ascii_case(False)

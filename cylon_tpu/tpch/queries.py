@@ -110,20 +110,27 @@ def _prune(df: DataFrame, table_name: str, strings: set) -> DataFrame:
     object, invisible to the caller's constants). At SF1 this is what
     keeps e.g. Q6 from dragging the 44-byte ``l_comment`` words
     through every filter sort."""
-    prefix = _TPCH_PREFIXES.get(table_name)
-    if prefix is None:
-        return df
-    # long constants (the docstring with the query's SQL text) match by
-    # substring, so a column named only there still survives — pruning
-    # must only ever overapproximate
-    long_strs = [s for s in strings if len(s) > 60]
     cols = df.table.column_names
-    keep = [c for c in cols
-            if not c.startswith(prefix) or c in strings
-            or any(c in s for s in long_strs)]
+    keep = keep_columns(table_name, cols, strings)
     if len(keep) == len(cols):
         return df
     return df[keep]
+
+
+def keep_columns(table_name: str, cols, strings: set) -> list:
+    """The prune predicate, shared with the bench's pre-ingest pruning
+    (``bench_suite._run_tpch``): keep a column unless it carries this
+    table's own TPC-H prefix AND the query names it nowhere. Long
+    constants (the docstring with the query's SQL text) match by
+    substring, so a column named only there still survives — pruning
+    must only ever overapproximate."""
+    prefix = _TPCH_PREFIXES.get(table_name)
+    if prefix is None:
+        return list(cols)
+    long_strs = [s for s in strings if len(s) > 60]
+    return [c for c in cols
+            if not c.startswith(prefix) or c in strings
+            or any(c in s for s in long_strs)]
 
 
 def _tables(data: Mapping, names, env=None) -> list[DataFrame]:

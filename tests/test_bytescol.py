@@ -362,3 +362,24 @@ def test_dist_unique_bytes(env8, rng):
     u = dist_ops.dist_unique(env8, _bt(df), ["k"])
     got = dtable.dist_to_pandas(env8, u)
     assert sorted(got["k"].tolist()) == sorted(set(df["k"]))
+
+
+def test_str_accessor(rng):
+    vals = np.array(["Apple Pie", "banana", None, "Cherry", "ümlaut Ö"],
+                    object)
+    for storage in ("bytes", "dict"):
+        t = Table.from_pydict({"s": vals}, string_storage=storage)
+        s = Series._wrap(t.column("s"), t.nrows, "s")
+        got = np.asarray(s.str.startswith("b").column.data)[:5]
+        assert got.tolist() == [False, True, False, False, False], storage
+        got = np.asarray(s.str.contains("an", regex=False).column.data)[:5]
+        assert got.tolist() == [False, True, False, False, False]
+        up = s.str.upper().to_numpy()
+        assert up[0] == "APPLE PIE" and up[1] == "BANANA" and up[2] is None
+        # non-ASCII passes through the device ASCII transform unchanged
+        if storage == "bytes":
+            assert up[4] == "üMLAUT Ö"
+        lo = s.str.lower().to_numpy()
+        assert lo[3] == "cherry"
+        ln = s.str.len().to_numpy()
+        assert ln[1] == 6 and ln[3] == 6
