@@ -156,6 +156,14 @@ def main():
                     "--exchange"], env=child_env, check=False)
 
 
+def _is_oom(e: Exception) -> bool:
+    """Device-memory exhaustion at a shape is a RESULT (the single-chip
+    ceiling); anything else is a regression and must fail the bench."""
+    return (isinstance(e, MemoryError)
+            or "RESOURCE_EXHAUSTED" in str(e)
+            or "ResourceExhausted" in str(e))
+
+
 def _hbm_stats(tag: str):
     """Emit device memory headroom (HBM on TPU) — the scale runs track
     how close each config sits to the 16 GB ceiling."""
@@ -201,8 +209,8 @@ def _run_tpch(sf, reps, tag_hbm: bool = False):
         for qn in sorted(only):
             strings |= _q._query_strings(getattr(_q, qn).__code__,
                                          vars(_q))
-        data = {t: {c: v for c, v in cols.items()
-                    if c in _q.keep_columns(t, cols, strings)}
+        data = {t: {c: cols[c]
+                    for c in _q.keep_columns(t, list(cols), strings)}
                 for t, cols in data.items()}
     # tables pre-ingested once (the reference's TPC-H timing also runs
     # on loaded tables); tpch.ingest applies the storage policy
@@ -275,7 +283,9 @@ def scale_main():
             _emit(f"local_inner_merge_{n}_rows_per_sec", n / t, "rows/s",
                   1e9 / 4.0 / 64)
             _hbm_stats(f"join_{n}_end")
-        except Exception as e:  # OOM at this shape is itself a result
+        except Exception as e:
+            if not _is_oom(e):  # only allocation failures are results
+                raise
             _emit(f"local_inner_merge_{n}_oom", 1, type(e).__name__)
         finally:
             out.clear()
@@ -290,6 +300,8 @@ def scale_main():
             _emit(f"sort_{n}_rows_per_sec", n / t, "rows/s")
             _hbm_stats(f"sort_{n}_end")
         except Exception as e:
+            if not _is_oom(e):
+                raise
             _emit(f"sort_{n}_oom", 1, type(e).__name__)
         finally:
             out.clear()

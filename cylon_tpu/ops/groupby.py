@@ -40,6 +40,9 @@ from cylon_tpu.table import Table
 AGG_OPS = ("sum", "count", "size", "min", "max", "mean", "var", "std",
            "nunique", "first", "last", "median", "quantile", "sumsq")
 
+#: static-shape -> settled capacity scale of the eager regrow ladder
+_EAGER_SCALE_MEMO: dict = {}
+
 
 def _segment_sum(vals, gid, num_segments: int):
     """XLA segment sum over GROUP-SORTED gid (monotone), hence the
@@ -97,29 +100,59 @@ def groupby_aggregate(table: Table, by: Sequence[str],
     key-sorted. Null keys form their own group (they equal each other).
     Nulls/NaNs in value columns are skipped (pandas skipna semantics).
     """
-    if out_capacity is not None:
-        out_cap = int(out_capacity)
-    else:
-        cap = int(table.capacity)
-        if isinstance(table.nrows, jax.core.Tracer):
-            # under a trace (whole-query compilation or a dist-op body)
-            # an enclosing regrow loop catches overflow — so bound the
-            # group count OPTIMISTICALLY: every segment reduction's
-            # cost scales with this static output bound (measured on
-            # v5e: 600k-segment f64 segment-sum ~160 ms vs ~6 ms at
-            # 8k), and most groupbys produce far fewer groups than
-            # rows. Overflow poisons nrows; the regrow re-dispatches
-            # at 2x (power-of-2 scale ladder bounds recompiles).
-            from cylon_tpu import plan
+    import os
 
-            out_cap = min(cap, max(8192, cap // 16)
-                          * plan.current_scale())
-        else:
-            out_cap = cap
-    return _groupby_compiled(table, by=tuple(by),
-                             aggs=tuple(tuple(a) for a in aggs),
-                             out_cap=out_cap, quantile=float(quantile),
-                             segscan=_use_segscan(int(table.capacity)))
+    cap = int(table.capacity)
+    by_t = tuple(by)
+    aggs_t = tuple(tuple(a) for a in aggs)
+    seg = _use_segscan(cap)
+
+    def dispatch(oc):
+        return _groupby_compiled(table, by=by_t, aggs=aggs_t,
+                                 out_cap=oc, quantile=float(quantile),
+                                 segscan=seg)
+
+    if out_capacity is not None:
+        return dispatch(int(out_capacity))
+    # default bound: every per-group reduction's cost scales with the
+    # static output bound (measured on v5e: 600k-segment f64
+    # segment-sum ~160 ms vs ~6 ms at 8k), and most groupbys produce
+    # far fewer groups than rows — so bound OPTIMISTICALLY and regrow.
+    from cylon_tpu import plan
+
+    def bound(scale):
+        return min(cap, max(8192, cap // 16) * scale)
+
+    if isinstance(table.nrows, jax.core.Tracer):
+        # under a trace (whole-query compilation or a dist-op body) the
+        # enclosing regrow ladder catches the overflow poison
+        return dispatch(bound(plan.current_scale()))
+    if os.environ.get("CYLON_TPU_ADAPTIVE", "1") in ("0", "off", "false"):
+        return dispatch(cap)  # classic fire-and-check, no host sync
+    # eager: host-side ladder, one row-count sync per call (the frame
+    # path pays that sync in shrink_to_fit anyway). The settled scale
+    # memoizes per static shape so steady-state reruns dispatch ONCE —
+    # without the memo every high-cardinality groupby would replay its
+    # failed dispatches on every call.
+    from cylon_tpu.errors import OutOfCapacity
+
+    key = (cap, by_t, aggs_t, seg)
+    scale = max(plan.current_scale(), _EAGER_SCALE_MEMO.get(key, 1))
+    while True:
+        t = dispatch(bound(scale))
+        try:
+            t.num_rows  # host sync; raises on overflow
+            _EAGER_SCALE_MEMO[key] = scale
+            return t
+        except OutOfCapacity:
+            # failure path only (no sync on success): an UPSTREAM
+            # truncation's poison rides carry_overflow and would raise
+            # at every rung — groups can never exceed rows, so detect
+            # it on the input and return the poisoned result at once
+            # instead of replaying the ladder's compiles
+            if int(table.nrows) > cap or bound(scale) >= cap:
+                return t
+            scale *= 2
 
 
 @functools.partial(platform_jit, static_argnames=("by", "aggs", "out_cap",
@@ -148,10 +181,9 @@ def _groupby_compiled(table: Table, *, by, aggs, out_cap,
     # WIDE value sets instead ride one packed row gather through the
     # sorted index — each sort payload re-moves its bytes through every
     # merge stage (see selection.PAYLOAD_SORT_MAX_WORDS)
-    from cylon_tpu.ops.selection import (PAYLOAD_SORT_MAX_WORDS,
-                                         payload_words)
+    from cylon_tpu.ops.selection import payload_words, use_gather_path
 
-    wide = payload_words(src_cols) > PAYLOAD_SORT_MAX_WORDS
+    wide = use_gather_path(payload_words(src_cols), cap)
     if wide:
         payloads, pack = [iota], None
     else:

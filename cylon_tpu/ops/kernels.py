@@ -496,10 +496,10 @@ def segmented_totals(gid_s: jax.Array, out_cap: int,
         for e in arrs:
             if e.ndim == 1:
                 flat_ops.append(e)
-    from cylon_tpu.ops.selection import PAYLOAD_SORT_MAX_WORDS
+    from cylon_tpu.ops.selection import use_gather_path
 
     flat_words = sum(2 if e.dtype.itemsize == 8 else 1 for e in flat_ops)
-    ride_sort = (flat_words <= PAYLOAD_SORT_MAX_WORDS
+    ride_sort = (not use_gather_path(flat_words, cap)
                  and out_cap > cap // 4)
     if not ride_sort:
         flat_ops = []

@@ -191,6 +191,22 @@ def payloads_to_columns(columns, sorted_payloads, pack) -> dict:
 #: (e.g. any table carrying a device-bytes string column).
 PAYLOAD_SORT_MAX_WORDS = 6
 
+#: ...but only at scale: below this row count the comparator network is
+#: still cheap and random gathers are the expensive primitive (~10x a
+#: narrow sort at 1M rows — the r3 measurement), so wide payloads keep
+#: riding the sort. The blowup above is superlinear in rows; 2M is the
+#: same knee the segmented-scan gate uses (groupby.SEGSCAN_MAX_ROWS).
+PAYLOAD_GATHER_MIN_ROWS = 2_000_000
+
+
+def use_gather_path(total_words: int, rows: int) -> bool:
+    """Shared wide-table crossover for permute/groupby/unique/
+    segmented_totals: sort a permutation + packed-gather instead of
+    carrying payloads, once BOTH the width and the row count pass the
+    measured knees."""
+    return (total_words > PAYLOAD_SORT_MAX_WORDS
+            and rows >= PAYLOAD_GATHER_MIN_ROWS)
+
 
 def _column_words(c: Column) -> int:
     """u32 words this column adds per row as sort payload."""
@@ -212,10 +228,11 @@ def permute_by_sort(table: Table, operands, nrows_out) -> Table:
     """Reorder a table by a stable sort on ``operands`` (pre-built
     unsigned order keys). Narrow tables carry every column through
     ``lax.sort`` as payload (random gathers cost ~10x a narrow sort);
-    wide tables (> ``PAYLOAD_SORT_MAX_WORDS`` payload words) sort only
-    a row-index payload and take ONE bit-packed row gather instead —
-    see the constant's docstring for the measured crossover."""
-    if payload_words(table.columns) > PAYLOAD_SORT_MAX_WORDS:
+    wide tables (> ``PAYLOAD_SORT_MAX_WORDS`` payload words at
+    >= ``PAYLOAD_GATHER_MIN_ROWS`` rows) sort only a row-index payload
+    and take ONE bit-packed row gather instead — see the constants'
+    docstrings for the measured crossover."""
+    if use_gather_path(payload_words(table.columns), table.capacity):
         iota = jnp.arange(table.capacity, dtype=jnp.int32)
         out = jax.lax.sort(tuple(operands) + (iota,),
                            num_keys=len(operands), is_stable=True)

@@ -65,15 +65,14 @@ def _unique_compiled(table: Table, *, cols, keep, out_cap) -> Table:
     original order, so the first/last position IS the first/last
     occurrence); (2) re-sort by (not-representative, original index) to
     emit representatives in original row order."""
-    from cylon_tpu.ops.selection import (PAYLOAD_SORT_MAX_WORDS,
-                                         payload_words)
+    from cylon_tpu.ops.selection import payload_words, use_gather_path
 
     cap = table.capacity
     names = cols if cols is not None else tuple(table.column_names)
     keys = [table.column(n).data for n in names]
     vals = [table.column(n).validity for n in names]
     iota = jnp.arange(cap, dtype=jnp.int32)
-    wide = payload_words(table.columns) > PAYLOAD_SORT_MAX_WORDS
+    wide = use_gather_path(payload_words(table.columns), cap)
     if wide:
         # wide tables: neither sort carries the columns — the group
         # sort and the order-restoring sort both move only row ids,
