@@ -703,25 +703,13 @@ class DataFrame:
         return equal_tables(ta, tb, ordered=True)
 
     def isin(self, values: Sequence) -> "DataFrame":
-        """Parity: frame.py isin (membership per element)."""
+        """Parity: frame.py isin (membership per element). Delegates to
+        :meth:`Series.isin` per column — one implementation of the
+        null-probe / type-mismatch semantics for both surfaces."""
         t = self._table
-        cols = {}
-        vset = set(values)
-        for name, c in t.columns.items():
-            if c.dtype.is_bytes:
-                from cylon_tpu.ops import bytescol
-
-                mask = bytescol.isin(c, list(vset))
-                cols[name] = Column(mask, None, dtypes.bool_)
-                continue
-            if c.dtype.is_dictionary:
-                codes = [i for i, v in enumerate(c.dictionary.values)
-                         if v in vset]
-                probe = jnp.asarray(codes or [-1], jnp.int32)
-            else:
-                probe = jnp.asarray(list(values), c.data.dtype)
-            mask = (c.data[:, None] == probe[None, :]).any(axis=1)
-            cols[name] = Column(mask, None, dtypes.bool_)
+        vals = list(values)
+        cols = {name: self.series(name).isin(vals).column
+                for name in t.column_names}
         return DataFrame._wrap(Table(cols, t.nrows))
 
     # -- reductions ------------------------------------------------------

@@ -1178,9 +1178,36 @@ int32_t cylon_catalog_join(const char* left_id, const char* right_id,
         rk_[i] >= (int32_t)R.cols.size())
       return -3;
     // exact tag equality (incl. temporal-unit bits): equal raw images
-    // of DIFFERENT logical types (timestamp[s] vs [ms], raw codes vs
-    // Kind-tagged codes) must not join on bit coincidence
-    if (L.cols[lk_[i]].dtype != R.cols[rk_[i]].dtype) return -4;
+    // of DIFFERENT logical types (timestamp[s] vs [ms]) must not join
+    // on bit coincidence. The stringish tags {2 raw codes, 12 STRING,
+    // 13 LARGE_STRING} are one logical class across the two tag
+    // conventions (the JNI writes 2, the Python binding 12): they
+    // compare by resolved KeyClass below, and sidecar dictionaries
+    // make the codes comparable by VALUE — so a Java-vs-Python
+    // string-key join is legal, not a -4.
+    auto stringish = [](int32_t d) {
+      int t = d & 0xFF;
+      return t == 2 || t == 12 || t == 13;
+    };
+    if (L.cols[lk_[i]].dtype != R.cols[rk_[i]].dtype) {
+      if (!(stringish(L.cols[lk_[i]].dtype) &&
+            stringish(R.cols[rk_[i]].dtype)))
+        return -4;
+      // cross-convention string keys are only meaningful when BOTH
+      // sides carry sidecar dictionaries (the unification below then
+      // compares by VALUE); a sidecar-less raw-code side would fall
+      // through to the legacy bit compare of TABLE-LOCAL codes —
+      // exactly the bit-coincidence join the strict gate existed to
+      // reject. Presence check only (cheap); a present-but-malformed
+      // sidecar is re-rejected when the unification loop extracts it.
+      auto has_sidecars = [](const CatTable& t, const std::string& base) {
+        return find_col(t, base + kSidecarSep + std::string("blob")) >= 0 &&
+               find_col(t, base + kSidecarSep + std::string("offs")) >= 0;
+      };
+      if (!has_sidecars(L, L.cols[lk_[i]].name) ||
+          !has_sidecars(R, R.cols[rk_[i]].name))
+        return -4;
+    }
     KeyClass lkc = key_class(L.cols[lk_[i]], L.n_rows);
     KeyClass rkc = key_class(R.cols[rk_[i]], R.n_rows);
     if (lkc.cls < 0 || rkc.cls < 0) return -4;
@@ -1211,8 +1238,15 @@ int32_t cylon_catalog_join(const char* left_id, const char* right_id,
     KeyClass rkc = key_class(rc, R.n_rows);
     int cls = lkc.cls;
     if (cls == 2 && rkc.cls == 2) {
+      bool mixed_tags = lc.dtype != rc.dtype;
       std::vector<std::string> lv, rv;
-      if (extract_dict(L, lc.name, &lv) && extract_dict(R, rc.name, &rv)) {
+      bool unified_ok =
+          extract_dict(L, lc.name, &lv) && extract_dict(R, rc.name, &rv);
+      // mixed-tag keys passed the gate on sidecar PRESENCE; if the
+      // sidecars turn out malformed the bit-compare fallback would be
+      // meaningless across conventions — reject instead
+      if (mixed_tags && !unified_ok) return -4;
+      if (unified_ok) {
         std::vector<std::string> merged = lv;
         merged.insert(merged.end(), rv.begin(), rv.end());
         std::sort(merged.begin(), merged.end());

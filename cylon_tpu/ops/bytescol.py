@@ -257,9 +257,15 @@ def _lengths_of(b: jax.Array) -> jax.Array:
     return jnp.max(jnp.where(b != 0, idx, 0), axis=1)
 
 
-def row_lengths(data: jax.Array) -> jax.Array:
-    """[cap] int32 byte length per row (offset of last non-zero byte)."""
-    return _lengths_of(byte_matrix(data))
+def char_lengths(data: jax.Array) -> jax.Array:
+    """[cap] int32 CHARACTER count per row: a byte starts a UTF-8 code
+    point iff it is not a continuation byte ((b & 0xC0) != 0x80), so the
+    count is one predicate sum over the byte matrix — no host decode.
+    Equal to the byte length for ASCII data; differs (and matches
+    pandas ``Series.str.len``) for multi-byte code points."""
+    b = byte_matrix(data)
+    start = (b != 0) & ((b & 0xC0) != 0x80)
+    return start.sum(axis=1, dtype=jnp.int32)
 
 
 def _pat_bytes(pat: str) -> np.ndarray:
@@ -375,9 +381,11 @@ def cmp_scalar(col: Column, value: str) -> tuple[jax.Array, jax.Array]:
 
 
 def isin(col: Column, values) -> jax.Array:
+    # pandas isin([None]) / isin([nan]) matches null rows — a null-ish
+    # probe value must OR the null mask in, not silently drop out
+    has_null = any(is_nullish(v) for v in values)
     vals = [v for v in values if isinstance(v, str)]
-    if not vals:
-        return jnp.zeros(col.capacity, bool)
+    mask = jnp.zeros(col.capacity, bool)
     nw = col.data.shape[1]
     rows = []
     for v in vals:
@@ -385,11 +393,13 @@ def isin(col: Column, values) -> jax.Array:
             rows.append(encode_scalar(v, nw))
         except InvalidArgument:
             pass  # longer than any stored value: no match possible
-    if not rows:
-        return jnp.zeros(col.capacity, bool)
-    probe = jnp.asarray(np.stack(rows))                     # [k, nw]
-    mask = (col.data[:, None, :] == probe[None, :, :]).all(-1).any(1)
-    return _and_valid(col, mask)
+    if rows:
+        probe = jnp.asarray(np.stack(rows))                 # [k, nw]
+        mask = (col.data[:, None, :] == probe[None, :, :]).all(-1).any(1)
+        mask = _and_valid(col, mask)
+    if has_null and col.validity is not None:
+        mask = mask | ~col.validity
+    return mask
 
 
 def replace_where(col: Column, keep: jax.Array, value: str,
@@ -427,6 +437,21 @@ def _and_valid(col: Column, mask: jax.Array) -> jax.Array:
     return mask
 
 
+def is_nullish(v) -> bool:
+    """None / NaN / pd.NA / NaT — the scalar values pandas isin treats
+    as matching null rows."""
+    if v is None:
+        return True
+    if isinstance(v, float):
+        return v != v
+    if isinstance(v, (str, bytes, int, bool)):
+        return False
+    import pandas as pd
+
+    r = pd.isna(v)  # covers pd.NA, pd.NaT, np.datetime64("NaT")
+    return bool(r) if isinstance(r, (bool, np.bool_)) else False
+
+
 # --------------------------------------------------------------- auto policy
 def choose_storage(arr: np.ndarray, sample: int = 8192,
                    card_threshold: float = 0.5) -> str:
@@ -434,13 +459,16 @@ def choose_storage(arr: np.ndarray, sample: int = 8192,
     whose sampled distinct-value ratio exceeds ``card_threshold`` gets
     device bytes (the dictionary would scale with the data); otherwise
     dictionary codes (4 bytes/row beats padded width). The sample bounds
-    the decision cost — no global factorize before the choice is made."""
+    the decision cost — no global factorize before the choice is made.
+    The sample is STRIDED across the full column: a head sample would
+    systematically under-count cardinality on data sorted or clustered
+    by this column (the near-unique case bytes storage exists for)."""
     import pandas as pd
 
     n = len(arr)
     if n == 0:
         return "dict"
-    take = arr[:sample] if n > sample else arr
+    take = arr[:: max(1, -(-n // sample))] if n > sample else arr
     try:
         uniq = pd.unique(take[~np.asarray(pd.isna(take))])
     except Exception:

@@ -247,7 +247,12 @@ class Series:
 
     # -- membership / map ------------------------------------------------
     def isin(self, values) -> "Series":
-        """Parity: ``compute.pyx`` is_in (:702)."""
+        """Parity: ``compute.pyx`` is_in (:702). A null-ish probe value
+        (None / NaN) matches null rows, like pandas isin([None]); a
+        type-incompatible probe value never matches but does not poison
+        the rest of the list (pandas: isin([1, 'a']) still matches 1)."""
+        from cylon_tpu.ops.bytescol import is_nullish
+
         c = self._col
         vset = list(values)
         if c.dtype.is_bytes:
@@ -256,16 +261,54 @@ class Series:
             mask = bytescol.isin(c, vset)
             return Series._wrap(Column(mask, None, dtypes.bool_),
                                 self._nrows, self.name)
+        has_null = any(is_nullish(v) for v in vset)
+        vals = [v for v in vset if not is_nullish(v)]
         if c.dtype.is_dictionary:
             dvals = [] if c.dictionary is None else c.dictionary.values
             lut = {v: i for i, v in enumerate(dvals)}
-            probe = jnp.asarray([lut.get(v, -1) for v in vset] or [-1],
-                                jnp.int32)
+            probe = [lut[v] for v in vals if v in lut]
+            pdt = np.int32
+        elif c.dtype.kind in (dtypes.Kind.TIMESTAMP, dtypes.Kind.DURATION,
+                              dtypes.Kind.DATE32, dtypes.Kind.DATE64):
+            # temporal columns store unit-scaled ints; coerce probes
+            # through numpy temporal space at the column's unit (a raw
+            # int compare against a datetime64 probe would never match)
+            unit = c.dtype.unit or (
+                "D" if c.dtype.kind == dtypes.Kind.DATE32 else "ms")
+            cast = (np.timedelta64 if c.dtype.kind == dtypes.Kind.DURATION
+                    else np.datetime64)
+            pdt = np.dtype(c.data.dtype)
+            probe = []
+            for v in vals:
+                if isinstance(v, (int, float, bool)):
+                    continue  # pandas: a bare number never matches a date
+                try:
+                    probe.append(np.asarray(
+                        cast(v, unit).astype(np.int64), pdt)[()])
+                except (TypeError, ValueError):
+                    continue
         else:
-            probe = jnp.asarray(np.asarray(vset, np.dtype(c.data.dtype)))
-        mask = (c.data[:, None] == probe[None, :]).any(axis=1)
+            pdt = np.dtype(c.data.dtype)
+            probe = []
+            for v in vals:
+                try:
+                    cv = np.asarray(v, pdt)[()]
+                except (TypeError, ValueError, OverflowError):
+                    continue
+                if cv == v:  # 1.5 must not match int 1 via truncation
+                    probe.append(cv)
+        if probe:
+            p = jnp.asarray(np.asarray(probe, pdt))
+            mask = (c.data[:, None] == p[None, :]).any(axis=1)
+        else:
+            mask = jnp.zeros(c.capacity, bool)
         if c.validity is not None:
             mask = mask & c.validity
+            if has_null:
+                mask = mask | ~c.validity
+        elif has_null and jnp.issubdtype(c.data.dtype, jnp.floating):
+            # floats without a validity buffer carry nulls as NaN
+            mask = mask | jnp.isnan(c.data)
         return Series._wrap(Column(mask, None, dtypes.bool_), self._nrows,
                             self.name)
 
@@ -433,15 +476,17 @@ class _StrAccessor:
         return self._s.str_contains(pat, regex=regex)
 
     def len(self) -> Series:
-        """Value length in characters for dictionary columns (host map
-        over distinct values); in UTF-8 BYTES for device-bytes columns
-        (device row_lengths — equal for ASCII data)."""
+        """Value length in CHARACTERS for both layouts (pandas
+        semantics): host map over distinct values for dictionary
+        columns, a device UTF-8 start-byte count
+        (:func:`bytescol.char_lengths`) for device-bytes columns — the
+        two storages agree on non-ASCII data."""
         s = self._s
         c = s.column
         if c.dtype.is_bytes:
             from cylon_tpu.ops import bytescol
 
-            data = bytescol.row_lengths(c.data)
+            data = bytescol.char_lengths(c.data)
             return Series._wrap(Column(data, c.validity, dtypes.int32),
                                 s._nrows, s.name)
         if c.dtype.is_dictionary:

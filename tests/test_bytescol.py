@@ -383,3 +383,56 @@ def test_str_accessor(rng):
         assert lo[3] == "cherry"
         ln = s.str.len().to_numpy()
         assert ln[1] == 6 and ln[3] == 6
+
+
+def test_str_len_counts_characters_both_storages():
+    """ADVICE r4: str.len() must count CHARACTERS (pandas semantics)
+    on both layouts — device-bytes columns previously returned UTF-8
+    byte length, so 'ü' counted as 2."""
+    import pandas as pd
+
+    import cylon_tpu as ct
+
+    vals = ["übung", "őz", "ascii", "日本語", ""]
+    want = pd.Series(vals).str.len().tolist()
+    for storage in ("bytes", "dict"):
+        df = ct.DataFrame({"s": np.array(vals, object)},
+                          string_storage=storage)
+        got = df.series("s").str.len().to_numpy().tolist()
+        assert got == want, (storage, got, want)
+
+
+def test_isin_null_probe_matches_null_rows():
+    """ADVICE r4: pandas Series.isin([None]) is True for null rows —
+    a null-ish probe value must OR the null mask in, on every column
+    layout (bytes, dict, numeric) and through DataFrame.isin."""
+    import cylon_tpu as ct
+
+    for storage in ("bytes", "dict"):
+        df = ct.DataFrame({"s": np.array(["x", None, "y", None], object)},
+                          string_storage=storage)
+        s = df.series("s")
+        assert s.isin([None]).to_numpy().tolist() == \
+            [False, True, False, True], storage
+        assert s.isin(["x", None]).to_numpy().tolist() == \
+            [True, True, False, True], storage
+        got = df.isin(["y", None]).to_dict()["s"]
+        assert list(got) == [False, True, True, True], storage
+    # float column: NaN probe matches NaN rows (pandas isin([nan]))
+    df = ct.DataFrame({"f": np.array([1.0, np.nan, 2.0])})
+    assert df.series("f").isin([float("nan")]).to_numpy().tolist() == \
+        [False, True, False]
+    assert df.series("f").isin([2.0]).to_numpy().tolist() == \
+        [False, False, True]
+
+
+def test_choose_storage_strided_sample_beats_clustering():
+    """ADVICE r4: a head sample under-counts cardinality on data
+    sorted/clustered by the column — 20k near-unique values whose
+    first 8192 rows repeat one value must still pick bytes storage."""
+    n = 20000
+    arr = np.array([f"val{i:06d}" for i in range(n)], object)
+    arr[:8192] = "dup"  # clustered head: old head-sample saw 1 distinct
+    from cylon_tpu.ops import bytescol
+
+    assert bytescol.choose_storage(arr) == "bytes"

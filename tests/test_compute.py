@@ -325,3 +325,46 @@ def test_unify_content_equal_dictionaries_no_remap():
     out = unify_dictionaries([a, b])
     # content-equal dictionaries pass through without a device remap
     assert out[0] is a and out[1] is b
+
+
+def test_isin_type_incompatible_values_dont_poison():
+    """A probe value the column dtype can't represent never matches —
+    and must not blank the rest of the list (pandas isin([1, 'a'])
+    still matches 1), on both the Series and DataFrame surfaces."""
+    import cylon_tpu as ct
+
+    df = ct.DataFrame({"i": np.array([1, 2, 3], np.int64)})
+    assert df.series("i").isin(["a"]).to_numpy().tolist() == \
+        [False, False, False]
+    assert df.series("i").isin([1, "a"]).to_numpy().tolist() == \
+        [True, False, False]
+    # 1.5 must not match int 1 via truncation
+    assert df.series("i").isin([1.5]).to_numpy().tolist() == \
+        [False, False, False]
+    assert list(df.isin([1, "a"]).to_dict()["i"]) == [True, False, False]
+
+
+def test_isin_temporal_and_pdna_probes():
+    """datetime64/pd.Timestamp probes match temporal columns via the
+    column's unit, pd.NA / NaT probes match null rows (pandas parity)."""
+    import pandas as pd
+
+    import cylon_tpu as ct
+
+    d = np.array(["2020-01-01", "2020-01-02", "2020-01-03"],
+                 "datetime64[D]")
+    df = ct.DataFrame(pd.DataFrame({"d": d}))
+    got = df.series("d").isin([np.datetime64("2020-01-01")]).to_numpy()
+    assert got.tolist() == [True, False, False]
+    got = df.series("d").isin([pd.Timestamp("2020-01-02"), "x"]).to_numpy()
+    assert got.tolist() == [False, True, False]
+    # a bare number never matches a date (pandas semantics)
+    assert df.series("d").isin([5]).to_numpy().tolist() == \
+        [False, False, False]
+    # pd.NA probe matches null rows of a validity-masked column
+    df2 = ct.DataFrame(pd.DataFrame({"i": pd.array([1, None, 3],
+                                                   dtype="Int64")}))
+    assert df2.series("i").isin([pd.NA]).to_numpy().tolist() == \
+        [False, True, False]
+    assert df2.series("i").isin([pd.NA, 3]).to_numpy().tolist() == \
+        [False, True, True]

@@ -465,6 +465,66 @@ def test_native_join_differing_key_names():
     lib.cylon_catalog_clear()
 
 
+def test_native_catalog_join_cross_binding_string_tags():
+    """A Java-vs-Python string-key catalog join: the JNI writes raw tag
+    2 for string codes while the Python binding writes Kind.STRING (12).
+    The stringish tags {2, 12, 13} are ONE logical class — the join must
+    compare resolved KeyClass (and unify the sidecar dictionaries by
+    VALUE), not demand exact tag equality (ADVICE r4)."""
+    import ctypes as c
+
+    import cylon_tpu as ct
+    from cylon_tpu import native
+    from cylon_tpu.native import catalog_get, catalog_put
+
+    lib = native._load()
+    if lib is None:
+        pytest.skip("native lib unavailable")
+    native.catalog_clear()
+    # left: Python-binding convention (Kind.STRING tag 12 + sidecars)
+    lt = ct.Table.from_pydict({"k": np.array(["a", "c", "c"], object),
+                               "v": np.array([1.0, 2.0, 3.0])})
+    catalog_put("L", lt)
+    # right: JNI convention — raw tag 2 codes + the same sidecar wire
+    # format (blob tag 1, offs tag 8), codes local to THIS table
+    # (cylon_jni.c fromColumns writes exactly this framing)
+    rvals = ["b", "c"]
+    codes = np.array([0, 1, 1], np.int32)          # b, c, c
+    blobs = b"".join(v.encode() for v in rvals)
+    blob = np.frombuffer(blobs, np.uint8).copy()
+    offs = np.zeros(len(rvals) + 1, np.int64)
+    for i, v in enumerate(rvals):
+        offs[i + 1] = offs[i] + len(v.encode())
+    names = [b"k", b"k\x01blob", b"k\x01offs"]
+    bufs = [codes, blob, offs]
+    c_names = (c.c_char_p * 3)(*names)
+    c_dt = (c.c_int32 * 3)(2, 1, 8)
+    c_bufs = (c.c_void_p * 3)(*[b.ctypes.data_as(c.c_void_p)
+                                for b in bufs])
+    c_lens = (c.c_int64 * 3)(*[b.nbytes for b in bufs])
+    assert lib.cylon_catalog_put(b"R", 3, c_names, c_dt, 3, c_bufs,
+                                 c_lens, None) == 0
+    key = (c.c_int32 * 1)(0)
+    rc = lib.cylon_catalog_join(b"L", b"R", b"J", 1, key, key, 0)
+    assert rc == 0, f"cross-binding string join returned {rc}"
+    got = catalog_get("J").to_pandas()
+    # only 'c' matches, 2 left rows x 2 right rows -> 4, by VALUE not
+    # by code (a raw code compare would match 'a'(0) with 'b'(0))
+    assert len(got) == 4
+    assert set(got["k"]) == {"c"}
+    assert set(got["v"]) == {2.0, 3.0}
+    # a sidecar-LESS raw-code side must still be rejected: without a
+    # dictionary to unify, the join would bit-compare table-local codes
+    c_names2 = (c.c_char_p * 1)(b"k")
+    c_dt2 = (c.c_int32 * 1)(2)
+    c_bufs2 = (c.c_void_p * 1)(codes.ctypes.data_as(c.c_void_p))
+    c_lens2 = (c.c_int64 * 1)(codes.nbytes)
+    assert lib.cylon_catalog_put(b"R2", 1, c_names2, c_dt2, 3, c_bufs2,
+                                 c_lens2, None) == 0
+    assert lib.cylon_catalog_join(b"L", b"R2", b"J2", 1, key, key, 0) == -4
+    native.catalog_clear()
+
+
 def test_native_catalog_join_string_keys_unifies_dictionaries():
     """String-key joins must compare VALUES, not table-local codes:
     independently ingested tables assign different codes to the same

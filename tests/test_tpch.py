@@ -819,3 +819,46 @@ def test_comment_columns_are_device_bytes(data):
     # and the generator's comments are genuinely high-cardinality
     o = data["orders"]["o_comment"]
     assert len(set(o)) > 0.5 * len(o)
+
+
+def test_projection_pushdown_covers_actual_access(data):
+    """ADVICE r4 (medium): the projection-pushdown inference walks code
+    -object string constants to a fixed helper depth — a helper nested
+    past the limit, or a runtime-built column name, silently changes
+    the pruned set. This test derives each query's referenced-column
+    MANIFEST from actual execution (every ``Table.column`` access while
+    the query runs) and asserts the inferred keep-set covers it, so an
+    inference regression fails loudly here instead of as a KeyError in
+    a benchmark run. The same ``keep_columns`` predicate drives the
+    bench's pre-ingest pruning (``bench_suite._run_tpch``)."""
+    from cylon_tpu import tpch
+    from cylon_tpu.table import Table
+    from cylon_tpu.tpch import queries as Q
+
+    dfs = tpch.ingest(data)
+    input_cols = {n: set(d.table.column_names) for n, d in dfs.items()}
+
+    accessed: set = set()
+    orig = Table.column
+
+    def spy(self, name):
+        accessed.add(name)
+        return orig(self, name)
+
+    for qn in [f"q{i}" for i in range(1, 23)]:
+        fn = getattr(Q, qn)
+        accessed.clear()
+        Table.column = spy
+        try:
+            fn(data)          # full eager run, pruning active
+        finally:
+            Table.column = orig
+        strings = Q._query_strings(fn.__code__, fn.__globals__)
+        for tname, cols in input_cols.items():
+            keep = set(Q.keep_columns(tname, sorted(cols), strings))
+            missing = (accessed & cols) - keep
+            assert not missing, (
+                f"{qn} reads {sorted(missing)} of {tname} but the "
+                f"string-constant inference would prune them — a "
+                f"helper exceeded the _query_strings depth limit or a "
+                f"column name is built at runtime")
