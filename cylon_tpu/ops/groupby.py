@@ -127,7 +127,7 @@ def groupby_aggregate(table: Table, by: Sequence[str],
         # under a trace (whole-query compilation or a dist-op body) the
         # enclosing regrow ladder catches the overflow poison
         return dispatch(bound(plan.current_scale()))
-    if os.environ.get("CYLON_TPU_ADAPTIVE", "1") in ("0", "off", "false"):
+    if not plan.adaptive_enabled():
         return dispatch(cap)  # classic fire-and-check, no host sync
     # eager: host-side ladder, one row-count sync per call (the frame
     # path pays that sync in shrink_to_fit anyway). The settled scale

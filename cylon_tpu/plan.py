@@ -102,6 +102,16 @@ def current_scale() -> int:
     return _SCALE.get()
 
 
+def adaptive_enabled() -> bool:
+    """The ONE parse of ``CYLON_TPU_ADAPTIVE`` (default on) — every
+    regrow ladder (``dist_ops._adaptive``, ``groupby``, the nunique
+    ladder) consults this, so the accepted spellings live here."""
+    import os
+
+    return os.environ.get("CYLON_TPU_ADAPTIVE", "1") not in (
+        "0", "off", "false")
+
+
 def _result_tables(out):
     """Tables reachable in a query result (pytree of Tables/DataFrames)."""
     from cylon_tpu.table import Table
