@@ -201,16 +201,20 @@ def _run_tpch(sf, reps, tag_hbm: bool = False):
         # query-subset runs ingest only the columns those queries
         # reference (the storage-scan projection any engine does) —
         # at SF10 a full lineitem load alone is ~10 GB of HBM.
-        # keep_columns is the SAME predicate queries._prune applies, so
-        # the two layers cannot diverge
-        from cylon_tpu.tpch import queries as _q
+        # Keep-sets AND predicate are the SAME explicit manifest +
+        # queries.manifest_keep that queries._tables prunes by, so the
+        # two layers cannot diverge
+        from cylon_tpu.tpch.manifest import MANIFEST
+        from cylon_tpu.tpch.queries import manifest_keep
 
-        strings = set()
+        keep_by_table: dict = {}
         for qn in sorted(only):
-            strings |= _q._query_strings(getattr(_q, qn).__code__,
-                                         vars(_q))
-        data = {t: {c: cols[c]
-                    for c in _q.keep_columns(t, list(cols), strings)}
+            for t, ks in MANIFEST[qn].items():
+                keep_by_table.setdefault(t, set()).update(ks)
+        # a table NO selected query reads keeps zero columns (ingest
+        # builds an empty frame for it; nothing is device_put)
+        data = {t: {c: cols[c] for c in manifest_keep(
+                        t, cols, keep_by_table.get(t, frozenset()))}
                 for t, cols in data.items()}
     # tables pre-ingested once (the reference's TPC-H timing also runs
     # on loaded tables); tpch.ingest applies the storage policy

@@ -85,45 +85,77 @@ def _code_strings(code) -> set:
     return out
 
 
-def _query_strings(code, globalns, depth: int = 2) -> set:
+def _query_strings(code, globalns, depth: int = 2, top: bool = True) -> set:
     """String constants of a query function AND of the module helpers
     it calls (resolved through ``co_names`` — e.g. ``_with_revenue``
     names ``l_extendedprice``/``l_discount`` in its own code object,
     invisible to the caller's constants), so pruning survives new
-    helpers without per-helper special cases."""
+    helpers without per-helper special cases.
+
+    Long strings (>60 chars — docstrings) are kept only from the query
+    function's OWN code object: :func:`keep_columns` applies a
+    substring match to them (a column named only in the query's SQL
+    docstring must survive), and a HELPER docstring that merely
+    discusses a column would otherwise defeat pruning for every caller
+    (``_prune``'s own docstring naming ``l_comment`` kept the 44-byte
+    comment words in all seven lineitem queries until r5)."""
     out = _code_strings(code)
+    if not top:
+        out = {s for s in out if len(s) <= 60}
     if depth:
         for name in code.co_names:
             g = globalns.get(name)
             fc = getattr(g, "__code__", None)
             if fc is not None:
-                out |= _query_strings(fc, globalns, depth - 1)
+                out |= _query_strings(fc, globalns, depth - 1, top=False)
     return out
 
 
-def _prune(df: DataFrame, table_name: str, strings: set) -> DataFrame:
+def _prune(df: DataFrame, table_name: str, strings: set,
+           explicit: frozenset | None = None) -> DataFrame:
     """Projection pushdown: drop this table's columns the calling query
     never names (the reference reads only referenced columns at scan
-    time too). Conservative: only columns carrying the table's own
-    TPC-H prefix are candidates, and lineitem always keeps the revenue
-    inputs (``_with_revenue`` references them from its own code
-    object, invisible to the caller's constants). At SF1 this is what
-    keeps e.g. Q6 from dragging the 44-byte ``l_comment`` words
+    time too). With an ``explicit`` manifest set (:mod:`.manifest` —
+    the source of truth for the 22 standard queries) that set IS the
+    keep predicate; otherwise fall back to the string-constant
+    inference, which is conservative: only columns carrying the
+    table's own TPC-H prefix are candidates. At SF1 this is what keeps
+    e.g. Q6 from dragging the 44-byte bytes-storage comment words
     through every filter sort."""
     cols = df.table.column_names
-    keep = keep_columns(table_name, cols, strings)
+    if explicit is not None:
+        keep = manifest_keep(table_name, cols, explicit)
+    elif not strings:
+        # no manifest entry for this table AND no inference — keep all
+        # (pruning must only ever overapproximate)
+        return df
+    else:
+        keep = keep_columns(table_name, cols, strings)
     if len(keep) == len(cols):
         return df
     return df[keep]
 
 
+def manifest_keep(table_name: str, cols, explicit) -> list:
+    """The explicit-manifest keep predicate — THE prune semantics for
+    the 22 standard queries, shared by runtime pruning (:func:`_prune`)
+    and the bench's pre-ingest projection (``bench_suite._run_tpch``)
+    so the two layers cannot diverge: keep a column unless it carries
+    this table's own TPC-H prefix and the manifest set excludes it."""
+    prefix = _TPCH_PREFIXES.get(table_name)
+    return [c for c in cols
+            if prefix is None or not c.startswith(prefix)
+            or c in explicit]
+
+
 def keep_columns(table_name: str, cols, strings: set) -> list:
-    """The prune predicate, shared with the bench's pre-ingest pruning
-    (``bench_suite._run_tpch``): keep a column unless it carries this
-    table's own TPC-H prefix AND the query names it nowhere. Long
-    constants (the docstring with the query's SQL text) match by
-    substring, so a column named only there still survives — pruning
-    must only ever overapproximate."""
+    """The INFERENCE prune predicate — the fallback for callers outside
+    the 22-query manifest (and the cross-check the manifest equality
+    test recomputes): keep a column unless it carries this table's own
+    TPC-H prefix AND the query names it nowhere. Long constants (the
+    docstring with the query's SQL text) match by substring, so a
+    column named only there still survives — pruning must only ever
+    overapproximate."""
     prefix = _TPCH_PREFIXES.get(table_name)
     if prefix is None:
         return list(cols)
@@ -143,24 +175,32 @@ def _tables(data: Mapping, names, env=None) -> list[DataFrame]:
     materialised to the local layout (the pandas-exact eager path).
 
     Inputs are PROJECTED to the columns the calling query references
-    (its code object's string constants — :func:`_prune`) before any
-    compute, so unreferenced columns never enter a filter/shuffle."""
+    before any compute, so unreferenced columns never enter a
+    filter/shuffle. For the 22 standard queries the keep-sets come
+    from the explicit :mod:`.manifest` (ADVICE r4: declared, not
+    inferred); an unknown caller falls back to the string-constant
+    inference, which only ever overapproximates."""
     import sys
+
+    from cylon_tpu.tpch.manifest import MANIFEST
 
     missing = [n for n in names if n not in data]
     if missing:
         raise InvalidArgument(f"tpch input missing tables {missing}")
     caller = sys._getframe(1)
-    strings = _query_strings(caller.f_code, caller.f_globals)
+    declared = MANIFEST.get(caller.f_code.co_name, {})
+    strings = (set() if declared
+               else _query_strings(caller.f_code, caller.f_globals))
     if env is None:
-        return [_prune(_df(data[n])._materialized(), n, strings)
+        return [_prune(_df(data[n])._materialized(), n, strings,
+                       declared.get(n))
                 for n in names]
     from cylon_tpu.parallel import scatter_table
 
     # prune BEFORE the mesh layout: a dropped column must never be
     # device_put across the mesh in the first place
     return [DataFrame._wrap(scatter_table(
-        env, _prune(_df(data[n]), n, strings).table))
+        env, _prune(_df(data[n]), n, strings, declared.get(n)).table))
             for n in names]
 
 

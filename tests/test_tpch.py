@@ -829,8 +829,10 @@ def test_projection_pushdown_covers_actual_access(data):
     MANIFEST from actual execution (every ``Table.column`` access while
     the query runs) and asserts the inferred keep-set covers it, so an
     inference regression fails loudly here instead of as a KeyError in
-    a benchmark run. The same ``keep_columns`` predicate drives the
-    bench's pre-ingest pruning (``bench_suite._run_tpch``)."""
+    a benchmark run. (Runtime pruning and the bench's pre-ingest
+    projection are driven by the explicit ``tpch/manifest.py``, which
+    ``test_inferred_pruning_matches_manifest`` pins to this same
+    inference.)"""
     from cylon_tpu import tpch
     from cylon_tpu.table import Table
     from cylon_tpu.tpch import queries as Q
@@ -862,3 +864,52 @@ def test_projection_pushdown_covers_actual_access(data):
                 f"string-constant inference would prune them — a "
                 f"helper exceeded the _query_strings depth limit or a "
                 f"column name is built at runtime")
+
+
+def test_inferred_pruning_matches_manifest(data):
+    """ADVICE r4 (medium), second leg: the string-constant inference
+    must agree EXACTLY with the explicit per-query manifest that
+    ``queries._tables`` actually prunes by (``tpch/manifest.py``).
+    Equality — not mere coverage — so drift in EITHER direction fails
+    loudly: a helper refactor that exceeds the inference depth limit
+    (under-keep → would have been a silent KeyError source before the
+    manifest became authoritative) AND an over-keep leak (r5 found
+    ``_prune``'s own docstring feeding ``l_comment`` through the
+    long-string substring rule into every lineitem query's keep-set)."""
+    from cylon_tpu.tpch import queries as Q
+    from cylon_tpu.tpch.manifest import MANIFEST
+
+    cols = {name: sorted(tbl.keys()) for name, tbl in data.items()}
+    assert sorted(MANIFEST) == sorted(f"q{i}" for i in range(1, 23))
+
+    # each query's manifest must cover EXACTLY the tables the query
+    # passes to _tables: a query gaining a table without a manifest
+    # update would silently skip pruning at runtime (safe) but prune
+    # the table to zero columns in bench_suite's subset pre-ingest
+    import ast
+    import inspect
+
+    tree = ast.parse(inspect.getsource(Q))
+    loads = {}
+    for node in tree.body:
+        if (isinstance(node, ast.FunctionDef) and node.name in MANIFEST):
+            for call in ast.walk(node):
+                if (isinstance(call, ast.Call)
+                        and isinstance(call.func, ast.Name)
+                        and call.func.id == "_tables"):
+                    loads[node.name] = sorted(
+                        ast.literal_eval(e) for e in call.args[1].elts)
+    for qn, entry in MANIFEST.items():
+        assert loads.get(qn) == sorted(entry), (
+            f"{qn} loads tables {loads.get(qn)} but manifest declares "
+            f"{sorted(entry)} — update manifest.py")
+
+    for qn, entry in MANIFEST.items():
+        fn = getattr(Q, qn)
+        strings = Q._query_strings(fn.__code__, fn.__globals__)
+        for tname, declared in entry.items():
+            inferred = set(Q.keep_columns(tname, cols[tname], strings))
+            assert inferred == set(declared), (
+                f"{qn}/{tname}: inference {sorted(inferred)} != "
+                f"manifest {sorted(declared)} — update manifest.py if "
+                f"the query changed, or fix the inference leak")
