@@ -327,12 +327,26 @@ def scale_main():
             if not _is_oom(e):  # only allocation failures are results
                 raise
             _emit(f"local_inner_merge_{n}_oom", 1, type(e).__name__)
+            # defer the out-of-core fallback to OUTSIDE this handler:
+            # while the except clause runs, the live exception's
+            # traceback pins the dispatch frames (and with them the
+            # device tables), so HBM would still be full
+            ooc_needed = True
+        else:
+            ooc_needed = False
+        finally:
             out.clear()
-            left = right = None
+            left = right = f1 = None
+
+        if ooc_needed:
             # out-of-core completion (VERDICT r4 missing #2): host-
-            # partitioned spill join over the same device kernels
+            # partitioned spill join over the same device kernels,
+            # AFTER the failed in-core attempt's buffers are released
+            import gc
+
             from cylon_tpu.outofcore import ooc_join
 
+            gc.collect()
             nparts = max(8, n // 12_500_000)
             lsrc = {"k": rng.integers(0, n, n).astype(np.int64),
                     "a": rng.normal(size=n)}
@@ -360,9 +374,6 @@ def scale_main():
             _emit(f"local_inner_merge_{n}_ooc_spilled",
                   spilled_bytes[0] / 2**30, "GiB")
             lsrc = rsrc = None
-        finally:
-            out.clear()
-            left = right = None
 
         try:
             st = Table.from_pydict(

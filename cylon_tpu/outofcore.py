@@ -167,14 +167,20 @@ def ooc_join(left, right, on, how: str = "inner",
         # O(log(rows)) across partitions
         lt = Table.from_pydict(lp, capacity=pow2_bucket(max(ln, 1)))
         rt = Table.from_pydict(rp, capacity=pow2_bucket(max(rn, 1)))
-        # uniform-hash partitions: 4x the larger side covers heavy
-        # many-to-many fan-out; overflow doubles the bound, a bounded
-        # number of times (a device OOM raises through — regrowing
-        # would only deepen it)
+        # ~1 output row per probe row is the expected shape of an
+        # equi-join on hash-partitioned keys; pow2 rounding plus the
+        # doubling ladder below absorbs fan-out, and starting tight
+        # matters — at 12.5M-row partitions a 4x(ln+rn) start is a
+        # multi-GB output buffer that can itself OOM the pass
         from cylon_tpu.errors import OutOfCapacity
 
-        cap = pow2_bucket(4 * max(ln + rn, 1))
-        for _ in range(8):
+        # ladder depth 12: the tight start shifts the ceiling down 4x
+        # vs the old 4x(ln+rn) start, and hot-key fan-out inside ONE
+        # partition cannot be relieved by more partitions — keep the
+        # reachable maximum at least where it was (a device OOM during
+        # a deep regrow raises through, which is the honest limit)
+        cap = pow2_bucket(2 * max(ln, rn, 1))
+        for _ in range(12):
             try:
                 res = dev_join(lt, rt, on=keys if len(keys) > 1
                                else keys[0], how=how, suffixes=suffixes,
