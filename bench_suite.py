@@ -181,12 +181,18 @@ def _hbm_stats(tag: str):
         _emit(f"hbm_headroom_{tag}", (limit - used) / 2**30, "GiB")
 
 
-def _run_tpch(sf, reps, tag_hbm: bool = False):
+def _run_tpch(sf, reps, tag_hbm: bool = False, ooc_report=None):
     """Time the (whole-query-compiled) TPC-H suite at scale factor
     ``sf``. CYLON_BENCH_TPCH_QUERIES="q1,q3,q5,q6" restricts the set
     (the SF10 runs time the numeric-heavy subset; full suite at
     SF<=1). Emits regrow events: any query whose capacity ladder
-    settled above 1x reports its final scale."""
+    settled above 1x reports its final scale.
+
+    ``ooc_report``: a list to APPEND OOM'd-query names to instead of
+    running their out-of-core fallbacks here — the at-scale driver runs
+    them in a separate process, because an execution-time OOM leaves
+    the failed run's device buffers unreclaimable in-process on this
+    backend (the fallback would start with HBM already full)."""
     import numpy as np
 
     from cylon_tpu import tpch
@@ -260,6 +266,9 @@ def _run_tpch(sf, reps, tag_hbm: bool = False):
             _emit(f"tpch_{fn.__name__}_sf{sf}_regrow_scale", worst, "x")
     if tag_hbm:
         _hbm_stats(f"tpch_sf{sf}_end")
+    if ooc_report is not None:
+        ooc_report.extend(ooc_pending)
+        return
     # out-of-core completion for the OOM'd queries (VERDICT r4 missing
     # #2) — AFTER dropping the device-resident ingest (dfs holds e.g.
     # SF10's ~10 GB lineitem; the streaming runs need that HBM back).
@@ -272,28 +281,142 @@ def _run_tpch(sf, reps, tag_hbm: bool = False):
 
         dfs = None
         gc.collect()
-        for qname in ooc_pending:
-            ofn = (streaming.q1_ooc if qname == "q1"
-                   else streaming.q5_ooc)
-            try:
-                t0 = time.perf_counter()
-                out = ofn(data)
-                out.table.num_rows
-                t = time.perf_counter() - t0
-                _emit(f"tpch_{qname}_sf{sf}_ooc_wall", t * 1e3, "ms")
-                del out
-            except Exception as e:
-                if not _is_oom(e):
-                    raise
-                _emit(f"tpch_{qname}_sf{sf}_ooc_oom", 1,
-                      type(e).__name__)
+        _tpch_ooc(data, ooc_pending, sf)
+
+
+def _tpch_ooc(data, qnames, sf):
+    """Run the streaming out-of-core TPC-H variants for ``qnames``."""
+    from cylon_tpu.tpch import streaming
+
+    for qname in qnames:
+        ofn = streaming.q1_ooc if qname == "q1" else streaming.q5_ooc
+        try:
+            t0 = time.perf_counter()
+            out = ofn(data)
+            out.table.num_rows
+            t = time.perf_counter() - t0
+            _emit(f"tpch_{qname}_sf{sf}_ooc_wall", t * 1e3, "ms")
+            del out
+        except Exception as e:
+            if not _is_oom(e):
+                raise
+            _emit(f"tpch_{qname}_sf{sf}_ooc_oom", 1, type(e).__name__)
 
 
 def scale_main():
     """--scale: the at-scale proof runs (VERDICT r3 missing #2) on the
     real chip — TPC-H at CYLON_BENCH_TPCH_SF (1 / 10) and the
     BASELINE.json larger join/sort configs at CYLON_BENCH_ROWS
-    (10M / 100M), with HBM headroom tracked per stage."""
+    (10M / 100M), with HBM headroom tracked per stage.
+
+    PROCESS STRUCTURE: each in-core attempt that may exceed HBM runs in
+    its OWN child process (``--scale-incore=<join|sort|tpch>``), and the
+    out-of-core completions run here in the parent afterwards. An
+    execution-time OOM on this backend leaves the failed run's device
+    buffers unreclaimable in-process (observed: after the 100M join's
+    OOM, a 128 MB device_put still reports RESOURCE_EXHAUSTED after
+    releasing every reference + gc), so "record the OOM, then complete
+    out-of-core" is only reliable across a process boundary. The child
+    reports which configs OOM'd via a sentinel JSON file; metrics print
+    straight through to this process's stdout. The chip is leased one
+    process at a time — children run sequentially and exit cleanly
+    before the parent touches the device."""
+    import tempfile
+
+    n = int(os.environ.get("CYLON_BENCH_ROWS", 0))
+    sf = float(os.environ.get("CYLON_BENCH_TPCH_SF", 0))
+    report = {}
+    crashed = []
+    legs = (["join", "sort"] if n else []) + (["tpch"] if sf else [])
+    for leg in legs:
+        with tempfile.NamedTemporaryFile("r", suffix=".json",
+                                         delete=False) as f:
+            sentinel = f.name
+        child_env = dict(os.environ)
+        child_env["CYLON_SCALE_SENTINEL"] = sentinel
+        rc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__),
+             f"--scale-incore={leg}"], env=child_env).returncode
+        try:
+            with open(sentinel) as f:
+                part = json.load(f)
+        except (OSError, ValueError):
+            part = None
+        finally:
+            os.unlink(sentinel)
+        if part is None:
+            # the child died without reporting (not a recorded OOM — a
+            # crash). Record it, but DON'T abort yet: earlier legs'
+            # out-of-core completions must still run ("slow is fine,
+            # DNF is not"), and they cannot run interleaved here — the
+            # chip is leased one process at a time, so the parent must
+            # not touch the device until every child has exited
+            crashed.append(f"--scale-incore={leg} exited rc={rc} "
+                           "with no sentinel")
+            continue
+        report.update(part)
+
+    rng = np.random.default_rng(7)
+    if report.get("join_oom"):
+        # out-of-core completion (VERDICT r4 missing #2): host-
+        # partitioned spill join over the same device kernels, in this
+        # so-far-device-idle parent (fresh HBM)
+        from cylon_tpu.outofcore import ooc_join
+
+        nparts = max(8, n // 12_500_000)
+        lsrc = {"k": rng.integers(0, n, n).astype(np.int64),
+                "a": rng.normal(size=n)}
+        rsrc = {"k": rng.integers(0, n, n).astype(np.int64),
+                "b": rng.normal(size=n)}
+        # the sink pays the full device->host spill per partition
+        # (honest wall) but retains only byte counts — keeping the
+        # frames would re-create the memory pressure this path exists
+        # to avoid
+        spilled_bytes = [0]
+
+        def _spill(df):
+            spilled_bytes[0] += int(df.memory_usage(index=False).sum())
+
+        t0 = time.perf_counter()
+        total = ooc_join(lsrc, rsrc, on="k", n_partitions=nparts,
+                         sink=_spill)
+        t = time.perf_counter() - t0
+        assert total > 0
+        _emit(f"local_inner_merge_{n}_ooc_rows_per_sec", n / t,
+              "rows/s", 1e9 / 4.0 / 64)
+        _emit(f"local_inner_merge_{n}_ooc_out_rows", float(total), "rows")
+        _emit(f"local_inner_merge_{n}_ooc_spilled",
+              spilled_bytes[0] / 2**30, "GiB")
+        lsrc = rsrc = None
+
+    if report.get("tpch_ooc"):
+        from cylon_tpu.tpch import dbgen
+        from cylon_tpu.tpch.manifest import MANIFEST
+        from cylon_tpu.tpch.queries import manifest_keep
+
+        pending = report["tpch_ooc"]
+        data = dbgen.generate(sf=sf, seed=0)
+        # prune to the pending queries' manifests, like the child's
+        # ingest — regenerating SF10 unpruned would hold ~10+ GB of
+        # comment strings in host RAM for streaming runs that read
+        # only lineitem's numeric columns + the small build tables
+        keep_by_table: dict = {}
+        for qn in sorted(set(pending)):
+            for t, ks in MANIFEST[qn].items():
+                keep_by_table.setdefault(t, set()).update(ks)
+        data = {t: {c: cols[c] for c in manifest_keep(
+                        t, cols, keep_by_table.get(t, frozenset()))}
+                for t, cols in data.items()}
+        _tpch_ooc(data, pending, sf)
+
+    if crashed:
+        raise RuntimeError("; ".join(crashed))
+
+
+def scale_incore_main(leg: str):
+    """One in-core at-scale attempt (see :func:`scale_main`): emits its
+    metrics (or its OOM line) and writes the sentinel JSON telling the
+    parent which out-of-core completions to run."""
     import jax
 
     import cylon_tpu as ct  # noqa: F401  (enables x64 + cache)
@@ -306,8 +429,9 @@ def scale_main():
     sf = float(os.environ.get("CYLON_BENCH_TPCH_SF", 0))
     rng = np.random.default_rng(7)
     out = {}
+    report = {}
 
-    if n:
+    if leg == "join":
         try:
             left = Table.from_pydict(
                 {"k": rng.integers(0, n, n).astype(np.int64),
@@ -323,58 +447,13 @@ def scale_main():
             _emit(f"local_inner_merge_{n}_rows_per_sec", n / t, "rows/s",
                   1e9 / 4.0 / 64)
             _hbm_stats(f"join_{n}_end")
+            report["join_oom"] = False
         except Exception as e:
             if not _is_oom(e):  # only allocation failures are results
                 raise
             _emit(f"local_inner_merge_{n}_oom", 1, type(e).__name__)
-            # defer the out-of-core fallback to OUTSIDE this handler:
-            # while the except clause runs, the live exception's
-            # traceback pins the dispatch frames (and with them the
-            # device tables), so HBM would still be full
-            ooc_needed = True
-        else:
-            ooc_needed = False
-        finally:
-            out.clear()
-            left = right = f1 = None
-
-        if ooc_needed:
-            # out-of-core completion (VERDICT r4 missing #2): host-
-            # partitioned spill join over the same device kernels,
-            # AFTER the failed in-core attempt's buffers are released
-            import gc
-
-            from cylon_tpu.outofcore import ooc_join
-
-            gc.collect()
-            nparts = max(8, n // 12_500_000)
-            lsrc = {"k": rng.integers(0, n, n).astype(np.int64),
-                    "a": rng.normal(size=n)}
-            rsrc = {"k": rng.integers(0, n, n).astype(np.int64),
-                    "b": rng.normal(size=n)}
-            _hbm_stats(f"join_{n}_ooc_start")
-            # the sink pays the full device->host spill per partition
-            # (honest wall) but retains only byte counts — keeping the
-            # frames would re-create the memory pressure this path
-            # exists to avoid
-            spilled_bytes = [0]
-
-            def _spill(df):
-                spilled_bytes[0] += int(df.memory_usage(index=False).sum())
-
-            t0 = time.perf_counter()
-            total = ooc_join(lsrc, rsrc, on="k", n_partitions=nparts,
-                             sink=_spill)
-            t = time.perf_counter() - t0
-            assert total > 0
-            _emit(f"local_inner_merge_{n}_ooc_rows_per_sec", n / t,
-                  "rows/s", 1e9 / 4.0 / 64)
-            _emit(f"local_inner_merge_{n}_ooc_out_rows", float(total),
-                  "rows")
-            _emit(f"local_inner_merge_{n}_ooc_spilled",
-                  spilled_bytes[0] / 2**30, "GiB")
-            lsrc = rsrc = None
-
+            report["join_oom"] = True
+    elif leg == "sort":
         try:
             st = Table.from_pydict(
                 {"k": rng.integers(0, 2**40, n).astype(np.int64)})
@@ -387,12 +466,17 @@ def scale_main():
             if not _is_oom(e):
                 raise
             _emit(f"sort_{n}_oom", 1, type(e).__name__)
-        finally:
-            out.clear()
-            st = None
+    elif leg == "tpch":
+        pending: list = []
+        _run_tpch(sf, reps, tag_hbm=True, ooc_report=pending)
+        report["tpch_ooc"] = pending
+    else:
+        raise ValueError(f"unknown --scale-incore leg {leg!r}")
 
-    if sf:
-        _run_tpch(sf, reps, tag_hbm=True)
+    sentinel = os.environ.get("CYLON_SCALE_SENTINEL")
+    if sentinel:
+        with open(sentinel, "w") as f:
+            json.dump(report, f)
 
 
 def tpu_exchange_main():
@@ -594,6 +678,10 @@ def exchange_main():
 if __name__ == "__main__":
     if "--exchange" in sys.argv:
         exchange_main()
+    elif any(a.startswith("--scale-incore=") for a in sys.argv):
+        leg = next(a for a in sys.argv
+                   if a.startswith("--scale-incore=")).split("=", 1)[1]
+        scale_incore_main(leg)
     elif "--scale" in sys.argv:
         scale_main()
     elif "--weak-scaling" in sys.argv:
