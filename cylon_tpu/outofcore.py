@@ -32,7 +32,7 @@ import numpy as np
 
 from cylon_tpu.errors import InvalidArgument
 
-__all__ = ["host_partition_chunks", "ooc_join", "ooc_groupby"]
+__all__ = ["host_partition_chunks", "ooc_join", "ooc_groupby", "ooc_sort"]
 
 
 def _hash_u64(a: np.ndarray) -> np.ndarray:
@@ -74,32 +74,11 @@ def host_partition_chunks(chunks: Iterable[Mapping[str, np.ndarray]],
     """Partition phase: hash-split every chunk's rows into
     ``n_partitions`` host buckets. Returns one ``{col: np.ndarray}``
     dict per partition (dense concatenated spill buffers)."""
-    parts: list[dict[str, list]] = [
-        {} for _ in range(n_partitions)]
-    schema: dict[str, np.dtype] = {}
-    for chunk in chunks:
-        cols = dict(chunk)
-        n = len(next(iter(cols.values())))
-        pid = (_row_hash([np.asarray(cols[k]) for k in key_cols])
-               % np.uint64(n_partitions)).astype(np.int64)
-        order = np.argsort(pid, kind="stable")
-        bounds = np.searchsorted(pid[order], np.arange(n_partitions + 1))
-        for name, arr in cols.items():
-            arr = np.asarray(arr)[order]
-            schema.setdefault(name, arr.dtype)
-            for p in range(n_partitions):
-                lo, hi = bounds[p], bounds[p + 1]
-                if hi > lo:
-                    parts[p].setdefault(name, []).append(arr[lo:hi])
-        del cols
-    out = []
-    for p in parts:
-        full = {name: (np.concatenate(p[name]) if len(p[name]) > 1
-                       else p[name][0]) if name in p
-                else np.empty(0, dt)  # keep schema on empty partitions
-                for name, dt in schema.items()}
-        out.append(full)
-    return out
+    def pid_of(cols):
+        return (_row_hash([cols[k] for k in key_cols])
+                % np.uint64(n_partitions)).astype(np.int64)
+
+    return _scatter_chunks(chunks, pid_of, n_partitions)
 
 
 def _as_chunks(src, chunk_rows: int):
@@ -253,3 +232,140 @@ def ooc_groupby(src, by: Sequence[str], aggs,
         {c: merged_df[c].to_numpy() for c in merged_df.columns})
     return groupby_aggregate(final, list(by),
                              [(o, merge[op], o) for _, op, o in aggs])
+
+
+def _lex_gt(cols: Sequence[np.ndarray], split) -> np.ndarray:
+    """Vectorised lexicographic ``row > split`` over parallel
+    partition-encoded key columns (see :func:`_sortable`; each column
+    compares in its own dtype — no cross-column promotion)."""
+    gt = np.zeros(len(cols[0]), bool)
+    eq = np.ones(len(cols[0]), bool)
+    for c, s in zip(cols, split):
+        gt |= eq & (c > s)
+        eq &= c == s
+    return gt
+
+
+def _sortable(a: np.ndarray) -> np.ndarray:
+    """Key column encoded for partition comparisons. Ints/datetimes
+    pass through in their own dtype (no precision loss). Floats map to
+    order-preserving uint64 (the sign-flip bit trick), with NaN
+    canonicalised to a pattern ABOVE +inf — so NaNs range-partition
+    strictly last, after real infinities, matching the device sort's
+    (and pandas') inf-before-NaN placement."""
+    a = np.asarray(a)
+    if a.dtype.kind not in "iufM":
+        raise InvalidArgument(
+            f"ooc_sort keys must be numeric/datetime, got {a.dtype}")
+    if a.dtype.kind != "f":
+        return a
+    f = np.ascontiguousarray(a, np.float64)
+    u = f.view(np.uint64)
+    u = np.where(np.isnan(f), np.uint64(0x7FF8000000000000), u)
+    return np.where(u >> np.uint64(63) == 1, ~u,
+                    u | np.uint64(1 << 63))
+
+
+def _scatter_chunks(chunks, pid_fn, n_partitions: int) -> list[dict]:
+    """Shared partition scatter: route every chunk's rows into
+    ``n_partitions`` host buckets by ``pid_fn(cols) -> int64[n]``,
+    returning one dense ``{col: np.ndarray}`` per partition (empty
+    partitions keep the schema)."""
+    parts: list[dict[str, list]] = [{} for _ in range(n_partitions)]
+    schema: dict[str, np.dtype] = {}
+    for chunk in chunks:
+        cols = {k: np.asarray(v) for k, v in chunk.items()}
+        pid = pid_fn(cols)
+        order = np.argsort(pid, kind="stable")
+        bounds = np.searchsorted(pid[order], np.arange(n_partitions + 1))
+        for name, arr in cols.items():
+            arr = arr[order]
+            schema.setdefault(name, arr.dtype)
+            for p in range(n_partitions):
+                lo, hi = bounds[p], bounds[p + 1]
+                if hi > lo:
+                    parts[p].setdefault(name, []).append(arr[lo:hi])
+        del cols
+    out = []
+    for p in parts:
+        full = {name: (np.concatenate(p[name]) if len(p[name]) > 1
+                       else p[name][0]) if name in p
+                else np.empty(0, dt)  # keep schema on empty partitions
+                for name, dt in schema.items()}
+        out.append(full)
+    return out
+
+
+def ooc_sort(src, by, n_partitions: int = 8, chunk_rows: int = 1 << 22,
+             sink: Callable | None = None,
+             sample_stride: int = 8192) -> int:
+    """Out-of-core sort: the host-DRAM twin of ``dist_sort``'s
+    sample-sort (sample -> splitters -> range partition -> per-range
+    device sort), completing sorts whose in-core working set exceeds
+    one chip's HBM. Two passes over ``src`` (a host column dict, a
+    chunk iterable, or a zero-arg callable returning a FRESH chunk
+    iterator — e.g. ``lambda: read_parquet_chunks(path, 1 << 22)``;
+    non-callable iterators are consumed by pass 1, so streaming
+    sources must come as callables): pass 1 strided-samples the keys
+    and picks ``n_partitions - 1`` splitter tuples; pass 2
+    range-partitions every chunk into host buckets by vectorised
+    lexicographic compare. Each bucket then device-sorts with the
+    normal fused program and spills via ``sink(pandas_df)`` IN RANGE
+    ORDER — the concatenation of the sink calls is the globally
+    sorted table. Returns total rows.
+
+    Parity: ``dist_sort``'s sample-sort structure
+    (``table.cpp DistributedSort`` -> sample + SortImpl) with "another
+    rank's memory" replaced by host DRAM, like :func:`ooc_join`."""
+    from cylon_tpu.ops.selection import sort_table
+    from cylon_tpu.table import Table
+    from cylon_tpu.utils import pow2_bucket
+
+    keys = [by] if isinstance(by, str) else list(by)
+    if callable(src):
+        chunks = lambda: _as_chunks(src(), chunk_rows)  # noqa: E731
+    else:
+        chunks = lambda: _as_chunks(src, chunk_rows)    # noqa: E731
+
+    # pass 1: strided per-column key samples (each keeps its own
+    # dtype) -> equi-spaced splitter tuples
+    samples: list[list[np.ndarray]] = [[] for _ in keys]
+    for chunk in chunks():
+        kc = [_sortable(np.asarray(chunk[k])) for k in keys]
+        if len(kc[0]):
+            for i, c in enumerate(kc):
+                samples[i].append(c[::sample_stride])
+    if not samples[0]:
+        return 0
+    scols = [np.concatenate(s) for s in samples]
+    order = np.lexsort(tuple(reversed(scols)))
+    pos = (np.arange(1, n_partitions)
+           * (len(order) / n_partitions)).astype(np.int64)
+    pos = np.clip(pos, 0, len(order) - 1)
+    splitters = [tuple(c[order[p]] for c in scols) for p in pos]
+
+    # pass 2: range-partition every chunk into host buckets
+    def pid_of(cols_dict):
+        kc = [_sortable(cols_dict[k]) for k in keys]
+        pid = np.zeros(len(kc[0]), np.int64)
+        for s in splitters:
+            pid += _lex_gt(kc, s)
+        return pid
+
+    parts = _scatter_chunks(chunks(), pid_of, n_partitions)
+
+    # range order: per-bucket device sort, spill in splitter order
+    total = 0
+    for p in range(n_partitions):
+        full = parts[p]
+        n = len(next(iter(full.values()))) if full else 0
+        if n == 0:
+            continue
+        t = Table.from_pydict(full, capacity=pow2_bucket(n))
+        res = sort_table(t, keys)
+        total += n
+        if sink is not None:
+            sink(res.to_pandas())
+        del res, t, full
+        parts[p] = None  # free the spill as we go
+    return total
