@@ -251,12 +251,33 @@ def _run_tpch(sf, reps, tag_hbm: bool = False, ooc_report=None):
                             lambda: res["r"].table.nrows, reps)
             _emit(f"tpch_{qname}_sf{sf}_wall", t * 1e3, "ms")
         except Exception as e:
+            if _is_crash(e):
+                # the TPU WORKER died (observed at SF10: q1's over-
+                # allocation comes back as UNAVAILABLE "worker process
+                # crashed", not a clean RESOURCE_EXHAUSTED). The
+                # backend is unusable in this process from here on —
+                # record it, queue the query's out-of-core completion,
+                # and abandon the remaining queries (the at-scale
+                # driver respawns a fresh process for them)
+                _emit(f"tpch_{qname}_sf{sf}_device_crash", 1,
+                      type(e).__name__)
+                if qname in ("q1", "q5"):
+                    ooc_pending.append(qname)
+                if attempted is not None:
+                    attempted.append(qname)
+                if crashed is not None:
+                    crashed.append(qname)
+                if ooc_report is not None:
+                    ooc_report.extend(ooc_pending)
+                return
             if not _is_oom(e):
                 raise
             _emit(f"tpch_{qname}_sf{sf}_oom", 1, type(e).__name__)
             res.clear()
             if qname in ("q1", "q5"):
                 ooc_pending.append(qname)
+        if attempted is not None:
+            attempted.append(qname)
     # regrow events: CompiledQuery memoizes the scale each (query,
     # shape) settled at — >1 means the capacity ladder re-dispatched
     for fn, cq in tpch._COMPILED.items():
