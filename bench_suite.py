@@ -137,14 +137,40 @@ def main():
     from cylon_tpu import tpch
     from cylon_tpu.tpch import dbgen
 
-    _run_tpch(sf, reps)
+    acct = _run_tpch(sf, reps)
+    if acct["skipped"]:
+        # a device crash truncated the suite and killed THIS process's
+        # backend: finish the unattempted queries in fresh processes
+        crash_log: list = []
+        agg = {"tpch_attempted": acct["attempted"],
+               "tpch_crashed": acct["crashed"],
+               "tpch_ooc": acct["ooc_pending"]}
+        _tpch_respawn("--tpch", acct["skipped"], agg, crash_log)
+        if agg.get("tpch_skipped"):
+            # recorded DNF with NAMES: queries no respawn ever reached
+            # (each process already emitted its own ooc_dropped lines
+            # for lost out-of-core completions — no re-report here)
+            print(json.dumps({"metric": f"tpch_sf{sf}_never_attempted",
+                              "value": len(agg["tpch_skipped"]),
+                              "unit": "queries",
+                              "queries": agg["tpch_skipped"]}))
+        for msg in crash_log:
+            print(json.dumps({"metric": "tpch_respawn_failure",
+                              "detail": msg}))
 
     # 6. TPU ragged exchange: the flagship lax.ragged_all_to_all path,
     # runtime-proven on the real chip (W=1 mesh still compiles and
     # executes the ragged collective, the 64-bit split and
-    # Pallas-under-shard_map on real Mosaic — VERDICT r3 missing #3)
+    # Pallas-under-shard_map on real Mosaic — VERDICT r3 missing #3).
+    # A TPC-H device crash killed THIS process's backend — skip with a
+    # recorded DNF instead of dying on the first dispatch (section 7
+    # runs in its own child either way)
     if jax.devices()[0].platform in ("tpu", "axon"):
-        tpu_exchange_main()
+        if acct["crashed"]:
+            _emit("tpu_exchange_skipped_dead_backend", 1,
+                  "device crash earlier in suite")
+        else:
+            tpu_exchange_main()
 
     # 7. exchange path (separate process: the CPU mesh needs XLA_FLAGS
     # set before jax imports, and must not disturb this process's
@@ -162,6 +188,19 @@ def _is_oom(e: Exception) -> bool:
     return (isinstance(e, MemoryError)
             or "RESOURCE_EXHAUSTED" in str(e)
             or "ResourceExhausted" in str(e))
+
+
+def _is_crash(e: Exception) -> bool:
+    """Did the DEVICE WORKER die (vs a clean in-process OOM)? Observed
+    at SF10: over-allocation comes back as UNAVAILABLE "worker process
+    crashed" — the backend is unusable in this process afterwards, so
+    the caller must respawn to continue. Also matches the resilience
+    layer's Code.Unavailable (injected preemptions)."""
+    s = str(e)
+    if "UNAVAILABLE" in s or "worker process crashed" in s:
+        return True
+    code = getattr(e, "code", None)
+    return getattr(code, "name", None) == "Unavailable"
 
 
 def _hbm_stats(tag: str):
@@ -192,7 +231,18 @@ def _run_tpch(sf, reps, tag_hbm: bool = False, ooc_report=None):
     running their out-of-core fallbacks here — the at-scale driver runs
     them in a separate process, because an execution-time OOM leaves
     the failed run's device buffers unreclaimable in-process on this
-    backend (the fallback would start with HBM already full)."""
+    backend (the fallback would start with HBM already full).
+
+    Returns ``{"attempted", "crashed", "skipped", "ooc_pending"}``
+    (cross-process aggregation rides the sentinel JSON) — ``skipped``
+    is the selected queries a device crash left untried, the exact set a
+    respawned process should re-run via CYLON_BENCH_TPCH_QUERIES;
+    ``ooc_pending`` is the out-of-core completions still owed (crash
+    path with no ``ooc_report`` cannot run them in this process — the
+    backend is dead — so they are RETURNED and emitted as
+    ``ooc_dropped`` rather than silently lost). A crash also emits
+    attempted/crashed/skipped count metrics, so a truncated suite is
+    visible in the metrics JSON instead of silently DNF."""
     import numpy as np
 
     from cylon_tpu import tpch
@@ -235,11 +285,21 @@ def _run_tpch(sf, reps, tag_hbm: bool = False, ooc_report=None):
     # shared across queries
     eager = os.environ.get("CYLON_BENCH_TPCH_MODE") == "eager"
     ooc_pending: list = []
+    attempted: list = []
+    crashed: list = []
     scalar_q = ("q6", "q14", "q17", "q19")
     names = [f"q{i}" for i in range(1, 23)]
-    for qname in names:
-        if only is not None and qname not in only:
-            continue
+    selected = [q for q in names if only is None or q in only]
+
+    def _accounting(pending=()):
+        skipped = [q for q in selected if q not in attempted]
+        _emit(f"tpch_sf{sf}_attempted", len(attempted), "queries")
+        _emit(f"tpch_sf{sf}_crashed", len(crashed), "queries")
+        _emit(f"tpch_sf{sf}_skipped", len(skipped), "queries")
+        return {"attempted": list(attempted), "crashed": list(crashed),
+                "skipped": skipped, "ooc_pending": list(pending)}
+
+    for qname in selected:
         qfn = getattr(tpch, qname) if eager else tpch.compiled(qname)
         res = {}
         try:
@@ -257,27 +317,33 @@ def _run_tpch(sf, reps, tag_hbm: bool = False, ooc_report=None):
                 # crashed", not a clean RESOURCE_EXHAUSTED). The
                 # backend is unusable in this process from here on —
                 # record it, queue the query's out-of-core completion,
-                # and abandon the remaining queries (the at-scale
-                # driver respawns a fresh process for them)
+                # and abandon the remaining queries (the driver
+                # respawns a fresh process for exactly the skipped
+                # set — see _tpch_respawn / scale_main)
                 _emit(f"tpch_{qname}_sf{sf}_device_crash", 1,
                       type(e).__name__)
+                attempted.append(qname)
+                crashed.append(qname)
                 if qname in ("q1", "q5"):
                     ooc_pending.append(qname)
-                if attempted is not None:
-                    attempted.append(qname)
-                if crashed is not None:
-                    crashed.append(qname)
                 if ooc_report is not None:
                     ooc_report.extend(ooc_pending)
-                return
+                else:
+                    # no collector and a dead backend: the OOC
+                    # completions cannot run in this process — record
+                    # the drop (and return it) instead of losing it
+                    for q in ooc_pending:
+                        _emit(f"tpch_{q}_sf{sf}_ooc_dropped", 1,
+                              "device crash; complete via --scale or "
+                              "a fresh --tpch run")
+                return _accounting(ooc_pending)
             if not _is_oom(e):
                 raise
             _emit(f"tpch_{qname}_sf{sf}_oom", 1, type(e).__name__)
             res.clear()
             if qname in ("q1", "q5"):
                 ooc_pending.append(qname)
-        if attempted is not None:
-            attempted.append(qname)
+        attempted.append(qname)
     # regrow events: CompiledQuery memoizes the scale each (query,
     # shape) settled at — >1 means the capacity ladder re-dispatched
     for fn, cq in tpch._COMPILED.items():
@@ -289,7 +355,7 @@ def _run_tpch(sf, reps, tag_hbm: bool = False, ooc_report=None):
         _hbm_stats(f"tpch_sf{sf}_end")
     if ooc_report is not None:
         ooc_report.extend(ooc_pending)
-        return
+        return _accounting()
     # out-of-core completion for the OOM'd queries (VERDICT r4 missing
     # #2) — AFTER dropping the device-resident ingest (dfs holds e.g.
     # SF10's ~10 GB lineitem; the streaming runs need that HBM back).
@@ -303,6 +369,7 @@ def _run_tpch(sf, reps, tag_hbm: bool = False, ooc_report=None):
         dfs = None
         gc.collect()
         _tpch_ooc(data, ooc_pending, sf)
+    return _accounting()
 
 
 def _tpch_ooc(data, qnames, sf):
@@ -324,6 +391,63 @@ def _tpch_ooc(data, qnames, sf):
             _emit(f"tpch_{qname}_sf{sf}_ooc_oom", 1, type(e).__name__)
 
 
+def _spawn_sentinel(flag, extra_env=None):
+    """Run this file in a child process with ``flag``, collecting its
+    sentinel-JSON report (the process-boundary contract scale_main's
+    docstring explains). Returns ``(returncode, report | None)`` —
+    None means the child died without reporting (a crash, not a
+    recorded result)."""
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("r", suffix=".json",
+                                     delete=False) as f:
+        sentinel = f.name
+    child_env = dict(os.environ)
+    child_env.update(extra_env or {})
+    child_env["CYLON_SCALE_SENTINEL"] = sentinel
+    rc = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), flag],
+        env=child_env).returncode
+    try:
+        with open(sentinel) as f:
+            part = json.load(f)
+    except (OSError, ValueError):
+        part = None
+    finally:
+        os.unlink(sentinel)
+    return rc, part
+
+
+def _tpch_respawn(flag, skipped, agg, crash_log):
+    """Crash-respawn driver: a device crash abandons every query after
+    it AND leaves the crashed process's backend unusable, so the only
+    way to finish the suite is a FRESH process restricted (via
+    CYLON_BENCH_TPCH_QUERIES) to exactly the unattempted set. Loops
+    until the suite completes, a child dies without reporting, or a
+    respawn makes no progress (every child attempts >= 1 query — a
+    crashed query counts as attempted — so the skipped set strictly
+    shrinks on any healthy child). Children's attempted/crashed/
+    ooc-pending lists accumulate into ``agg``; the surviving skipped
+    set lands in ``agg["tpch_skipped"]`` — non-empty means recorded
+    DNF, never a silent one."""
+    prev = None
+    while skipped and skipped != prev:
+        prev = skipped
+        _emit("tpch_respawn_queries", len(skipped), "queries")
+        rc, part = _spawn_sentinel(flag, {
+            "CYLON_BENCH_TPCH_QUERIES": ",".join(sorted(skipped))})
+        if part is None:
+            crash_log.append(
+                f"tpch respawn ({flag}) exited rc={rc} with no "
+                "sentinel")
+            break
+        for k in ("tpch_attempted", "tpch_crashed", "tpch_ooc"):
+            agg[k] = agg.get(k, []) + part.get(k, [])
+        skipped = part.get("tpch_skipped", [])
+    agg["tpch_skipped"] = skipped
+    return agg
+
+
 def scale_main():
     """--scale: the at-scale proof runs (VERDICT r3 missing #2) on the
     real chip — TPC-H at CYLON_BENCH_TPCH_SF (1 / 10) and the
@@ -342,29 +466,13 @@ def scale_main():
     straight through to this process's stdout. The chip is leased one
     process at a time — children run sequentially and exit cleanly
     before the parent touches the device."""
-    import tempfile
-
     n = int(os.environ.get("CYLON_BENCH_ROWS", 0))
     sf = float(os.environ.get("CYLON_BENCH_TPCH_SF", 0))
     report = {}
     crashed = []
     legs = (["join", "sort"] if n else []) + (["tpch"] if sf else [])
     for leg in legs:
-        with tempfile.NamedTemporaryFile("r", suffix=".json",
-                                         delete=False) as f:
-            sentinel = f.name
-        child_env = dict(os.environ)
-        child_env["CYLON_SCALE_SENTINEL"] = sentinel
-        rc = subprocess.run(
-            [sys.executable, os.path.abspath(__file__),
-             f"--scale-incore={leg}"], env=child_env).returncode
-        try:
-            with open(sentinel) as f:
-                part = json.load(f)
-        except (OSError, ValueError):
-            part = None
-        finally:
-            os.unlink(sentinel)
+        rc, part = _spawn_sentinel(f"--scale-incore={leg}")
         if part is None:
             # the child died without reporting (not a recorded OOM — a
             # crash). Record it, but DON'T abort yet: earlier legs'
@@ -376,6 +484,22 @@ def scale_main():
                            "with no sentinel")
             continue
         report.update(part)
+        if leg == "tpch" and part.get("tpch_skipped"):
+            # a device crash truncated the suite mid-leg: respawn fresh
+            # processes for the unattempted queries (accumulating their
+            # attempted/crashed/ooc reports into this parent's view)
+            _tpch_respawn(f"--scale-incore={leg}",
+                          part["tpch_skipped"], report, crashed)
+    if "tpch_attempted" in report:
+        _emit(f"tpch_sf{sf}_total_attempted",
+              len(report["tpch_attempted"]), "queries")
+        _emit(f"tpch_sf{sf}_total_crashed",
+              len(report.get("tpch_crashed", [])), "queries")
+        _emit(f"tpch_sf{sf}_total_skipped",
+              len(report.get("tpch_skipped", [])), "queries")
+        if report.get("tpch_skipped"):
+            crashed.append("tpch queries never attempted after "
+                           f"respawns: {report['tpch_skipped']}")
 
     if report.get("join_oom"):
         # out-of-core completion (VERDICT r4 missing #2): host-
@@ -516,8 +640,11 @@ def scale_incore_main(leg: str):
             report["sort_oom"] = True
     elif leg == "tpch":
         pending: list = []
-        _run_tpch(sf, reps, tag_hbm=True, ooc_report=pending)
+        acct = _run_tpch(sf, reps, tag_hbm=True, ooc_report=pending)
         report["tpch_ooc"] = pending
+        report["tpch_attempted"] = acct["attempted"]
+        report["tpch_crashed"] = acct["crashed"]
+        report["tpch_skipped"] = acct["skipped"]
     else:
         raise ValueError(f"unknown --scale-incore leg {leg!r}")
 
@@ -525,6 +652,26 @@ def scale_incore_main(leg: str):
     if sentinel:
         with open(sentinel, "w") as f:
             json.dump(report, f)
+
+
+def tpch_main():
+    """--tpch: the TPC-H leg alone, in its own process — the respawn
+    target main() uses after a device crash (a fresh process is the
+    only way to a working backend). CYLON_BENCH_TPCH_QUERIES restricts
+    the set; accounting reports through CYLON_SCALE_SENTINEL when the
+    parent set one."""
+    import cylon_tpu as ct  # noqa: F401  (enables x64 + cache)
+
+    reps = int(os.environ.get("CYLON_BENCH_REPS", 3))
+    sf = float(os.environ.get("CYLON_BENCH_TPCH_SF", 0.1))
+    acct = _run_tpch(sf, reps)
+    sentinel = os.environ.get("CYLON_SCALE_SENTINEL")
+    if sentinel:
+        with open(sentinel, "w") as f:
+            json.dump({"tpch_attempted": acct["attempted"],
+                       "tpch_crashed": acct["crashed"],
+                       "tpch_skipped": acct["skipped"],
+                       "tpch_ooc": acct["ooc_pending"]}, f)
 
 
 def tpu_exchange_main():
@@ -732,6 +879,8 @@ if __name__ == "__main__":
         scale_incore_main(leg)
     elif "--scale" in sys.argv:
         scale_main()
+    elif "--tpch" in sys.argv:
+        tpch_main()
     elif "--weak-scaling" in sys.argv:
         if "--xla_force_host_platform_device_count" not in \
                 os.environ.get("XLA_FLAGS", ""):

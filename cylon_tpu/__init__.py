@@ -69,13 +69,17 @@ from cylon_tpu.context import CylonEnv, TPUConfig, LocalConfig
 from cylon_tpu.errors import (
     CylonError,
     Code,
+    DataLossError,
     IndexError_,
     InvalidArgument,
     KeyError_,
     NotImplemented_,
     OutOfCapacity,
+    TransientError,
     TypeError_,
 )
+from cylon_tpu.config import RetryPolicy
+from cylon_tpu.resilience import FaultPlan, FaultRule
 from cylon_tpu.table import Table
 from cylon_tpu.series import Series
 from cylon_tpu.frame import DataFrame, GroupByDataFrame, concat, merge, read_csv
@@ -93,6 +97,11 @@ __all__ = [
     "CylonEnv",
     "CylonError",
     "Code",
+    "DataLossError",
+    "FaultPlan",
+    "FaultRule",
+    "RetryPolicy",
+    "TransientError",
     "IndexError_",
     "InvalidArgument",
     "JoinAlgorithm",

@@ -234,6 +234,15 @@ class Column:
                 if out.dtype.kind == "f":
                     out = out.copy()
                     out[mask] = np.nan
+                elif out.dtype.kind in "Mm":
+                    # temporal nulls decode to native NaT, keeping the
+                    # datetime64/timedelta64 dtype (an object column of
+                    # None would lose sortability and dtype on every
+                    # to_pandas round trip — e.g. the out-of-core spill)
+                    out = out.copy()
+                    out[mask] = (np.datetime64("NaT")
+                                 if out.dtype.kind == "M"
+                                 else np.timedelta64("NaT"))
                 else:
                     out = out.astype(object)
                     out[mask] = None

@@ -74,6 +74,30 @@ class SortOptions:
 
 
 @dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Knobs for the retry/backoff engine (:mod:`cylon_tpu.resilience`).
+
+    No reference analog: ``cylon::Status`` threads error codes but never
+    retries. Delays follow ``min(base_delay * multiplier**k, max_delay)``
+    with a DETERMINISTIC jitter drawn from ``seed`` — two processes with
+    the same policy back off identically, so failure traces replay
+    exactly (the property the fault-injection harness tests against).
+
+    The process-wide default policy reads env overrides:
+    ``CYLON_TPU_RETRY_ATTEMPTS`` / ``_BASE_DELAY`` / ``_MAX_DELAY`` /
+    ``_MULTIPLIER`` / ``_JITTER`` (see
+    :func:`cylon_tpu.resilience.default_policy`).
+    """
+
+    max_attempts: int = 3      # total attempts, including the first
+    base_delay: float = 0.05   # seconds before the first retry
+    max_delay: float = 2.0     # backoff ceiling (pre-jitter)
+    multiplier: float = 2.0    # exponential growth per retry
+    jitter: float = 0.1        # +- fraction, deterministic from seed
+    seed: int = 0
+
+
+@dataclasses.dataclass(frozen=True)
 class CSVReadOptions:
     """Parity: ``io/csv_read_config.hpp:28-152`` — every builder method
     becomes a field (UseThreads, WithDelimiter, IgnoreEmptyLines,

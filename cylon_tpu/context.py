@@ -90,13 +90,54 @@ class CylonEnv:
     def __init__(self, config: CommConfig | None = None, distributed: bool = True):
         config = config if config is not None else TPUConfig()
         self._config = config
+        self._fault_plan = None
         if isinstance(config, TPUConfig) and config.multihost:
+            from cylon_tpu import resilience
+
             kw = {}
             if config.coordinator_address is not None:
                 kw.update(coordinator_address=config.coordinator_address,
                           num_processes=config.num_processes,
                           process_id=config.process_id)
-            jax.distributed.initialize(**kw)
+
+            # the DCN bootstrap is the one place a worker's absence is
+            # EXPECTED to heal (preempted pods rejoin): retry with
+            # backoff instead of failing the whole program on the first
+            # coordinator timeout (reference: mpirun just dies)
+            def _bootstrap():
+                resilience.inject("worker", "multihost bootstrap",
+                                  env=self)
+                try:
+                    jax.distributed.initialize(**kw)
+                except Exception as e:
+                    # a failed connect can leave the global distributed
+                    # state half-set, turning every re-attempt into
+                    # "initialize should only be called once" — clear
+                    # OUR half-initialized state so the retry is real.
+                    # That exact "called once" error means live state
+                    # existed BEFORE this call (initialize checks it
+                    # first): leave it alone — tearing down a running
+                    # job's coordinator as a side effect is worse than
+                    # re-raising.
+                    if "only be called once" not in str(e):
+                        try:
+                            jax.distributed.shutdown()
+                        except Exception:
+                            pass
+                    raise
+
+            def _bootstrap_retryable(e):
+                # jax surfaces coordinator trouble as RuntimeError /
+                # XlaRuntimeError text, not typed OS errors — without
+                # this the retry would only ever cover injected faults
+                return resilience.is_retryable(e) or (
+                    isinstance(e, RuntimeError)
+                    and any(s in str(e) for s in (
+                        "DEADLINE_EXCEEDED", "UNAVAILABLE",
+                        "onnection", "oordinator")))
+
+            resilience.retrying(_bootstrap, label="multihost bootstrap",
+                                retry_on=_bootstrap_retryable)
 
         if isinstance(config, LocalConfig) or not distributed:
             devices = [jax.devices()[0]]
@@ -138,6 +179,19 @@ class CylonEnv:
                 f"devices_per_slice={dps} does not divide the "
                 f"{len(devices)}-device world")
         return dps if dps < len(devices) else 0
+
+    # -- resilience (no parity: the reference has no recovery story) ----
+    def set_fault_plan(self, plan) -> "CylonEnv":
+        """Register a :class:`cylon_tpu.resilience.FaultPlan` on this
+        env: mesh ops that take an env (shuffle/dist_join/...) check it
+        at their injection points before the process-wide plan. Pass
+        ``None`` to clear."""
+        self._fault_plan = plan
+        return self
+
+    @property
+    def fault_plan(self):
+        return self._fault_plan
 
     # -- string KV config store (parity: ctx/cylon_context.hpp:32,69-77
     #    AddConfig/GetConfig/GetConfigs) ---------------------------------

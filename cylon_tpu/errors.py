@@ -26,6 +26,12 @@ class Code(enum.IntEnum):
     SerializationError = 11
     GpuMemoryError = 12  # kept for numeric parity; unused on TPU
     RError = 13
+    # 14/15 are unused by the reference enum; they take the gRPC
+    # UNAVAILABLE / DATA_LOSS numbers for the resilience layer
+    # (cylon_tpu.resilience) — the reference has no recovery story to
+    # mirror, so these are TPU-rebuild extensions, not parity codes.
+    Unavailable = 14
+    DataLoss = 15
     CodeGenError = 40
     ExpressionValidationError = 41
     ExecutionError = 42
@@ -65,6 +71,25 @@ class IOError_(CylonError):
 
 class NotImplemented_(CylonError):
     code = Code.NotImplemented
+
+
+class TransientError(CylonError):
+    """A failure that retrying is expected to fix: worker preemption,
+    flaky IO, an injected fault. :func:`cylon_tpu.resilience.is_retryable`
+    keys on this class (and on ``Code.Unavailable`` generally) — raise it
+    from any source that wants the retry engine to re-attempt."""
+
+    code = Code.Unavailable
+
+
+class DataLossError(CylonError):
+    """A row-accounting invariant failed: a multi-pass pipeline saw a
+    different number of rows going in than coming out. This converts
+    silent truncation (an exhausted iterator, a dropped spill bucket, a
+    lossy exchange) into a loud failure. Never retryable — the data is
+    already gone; the source or manifest must be repaired."""
+
+    code = Code.DataLoss
 
 
 class OutOfCapacity(CylonError):

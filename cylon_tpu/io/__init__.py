@@ -12,6 +12,7 @@ and a distributed read slices row blocks across the mesh
 from concurrent.futures import ThreadPoolExecutor
 from typing import Sequence
 
+from cylon_tpu import resilience
 from cylon_tpu.config import CSVReadOptions, CSVWriteOptions
 from cylon_tpu.errors import IOError_
 from cylon_tpu.table import Table
@@ -81,11 +82,23 @@ def _arrow_csv_opts(options: CSVReadOptions):
 
 
 def _arrow_csv_read(path, options: CSVReadOptions):
+    """One CSV parse, under the retry engine: transient failures
+    (``resilience.is_retryable`` — tunneled-FS connection resets, the
+    ``io_read`` injection point) re-attempt with backoff; parse errors
+    and missing files raise immediately (deterministic, not worth
+    retrying). Every sharded/chunked/threaded reader funnels through
+    here, so the whole CSV surface inherits the policy."""
     import pyarrow.csv as pacsv
 
     read_opts, parse_opts, convert = _arrow_csv_opts(options)
-    return pacsv.read_csv(path, read_options=read_opts,
-                          parse_options=parse_opts, convert_options=convert)
+
+    def _read():
+        resilience.inject("io_read", str(path))
+        return pacsv.read_csv(path, read_options=read_opts,
+                              parse_options=parse_opts,
+                              convert_options=convert)
+
+    return resilience.retrying(_read, label=f"read_csv {path}")
 
 
 def read_csv(paths, options: CSVReadOptions | None = None,
@@ -414,10 +427,16 @@ def read_csv_chunks(path, chunk_rows: int,
         raise IOError_(f"chunk_rows must be positive, got {chunk_rows}")
     options = options or CSVReadOptions()
     read_opts, parse_opts, convert = _arrow_csv_opts(options)
+
+    def _open():
+        resilience.inject("io_read", str(path))
+        return pacsv.open_csv(path, read_options=read_opts,
+                              parse_options=parse_opts,
+                              convert_options=convert)
+
     try:
-        reader = pacsv.open_csv(path, read_options=read_opts,
-                                parse_options=parse_opts,
-                                convert_options=convert)
+        reader = resilience.retrying(_open,
+                                     label=f"read_csv_chunks {path}")
     except Exception as e:
         raise IOError_(f"csv chunk read failed: {e}") from e
     return _csv_chunk_iter(reader, chunk_rows)
@@ -459,8 +478,14 @@ def read_parquet_chunks(path, chunk_rows: int,
 
     if chunk_rows <= 0:
         raise IOError_(f"chunk_rows must be positive, got {chunk_rows}")
+
+    def _open():
+        resilience.inject("io_read", str(path))
+        return pq.ParquetFile(path)  # eager: missing file raises here
+
     try:
-        pf = pq.ParquetFile(path)   # eager: missing file raises here
+        pf = resilience.retrying(_open,
+                                 label=f"read_parquet_chunks {path}")
     except Exception as e:
         raise IOError_(f"parquet chunk read failed: {e}") from e
     return _parquet_chunk_iter(pf, chunk_rows, columns)
@@ -579,14 +604,20 @@ def read_parquet(paths, env=None, capacity: int | None = None,
         columns = options.use_cols
     single = isinstance(paths, (str, bytes))
     path_list = [paths] if single else list(paths)
+
+    def _read_one(p):
+        def _r():
+            resilience.inject("io_read", str(p))
+            return pq.read_table(p, columns=columns)
+
+        return resilience.retrying(_r, label=f"read_parquet {p}")
+
     try:
         if len(path_list) == 1 or not options.concurrent_file_reads:
-            atables = [pq.read_table(p, columns=columns)
-                       for p in path_list]
+            atables = [_read_one(p) for p in path_list]
         else:
             with ThreadPoolExecutor(max_workers=min(8, len(path_list))) as ex:
-                atables = list(ex.map(
-                    lambda p: pq.read_table(p, columns=columns), path_list))
+                atables = list(ex.map(_read_one, path_list))
     except Exception as e:
         raise IOError_(f"parquet read failed: {e}") from e
     import pyarrow as pa

@@ -30,7 +30,8 @@ from typing import Callable, Iterable, Mapping, Sequence
 
 import numpy as np
 
-from cylon_tpu.errors import InvalidArgument
+from cylon_tpu import resilience
+from cylon_tpu.errors import DataLossError, InvalidArgument
 
 __all__ = ["host_partition_chunks", "ooc_join", "ooc_groupby", "ooc_sort"]
 
@@ -83,16 +84,20 @@ def host_partition_chunks(chunks: Iterable[Mapping[str, np.ndarray]],
 
 def _as_chunks(src, chunk_rows: int):
     """Accept a dict of host arrays (sliced into chunks), or any
-    iterable of dicts / Tables (used as-is)."""
+    iterable of dicts / Tables (used as-is). Every chunk passes the
+    ``chunk_source`` injection point — the place a streaming source
+    (tunneled parquet reader, network stream) fails in production."""
     from cylon_tpu.table import Table
 
     if isinstance(src, Mapping):
         n = len(next(iter(src.values())))
         for lo in range(0, n, chunk_rows):
+            resilience.inject("chunk_source")
             yield {k: np.asarray(v)[lo:lo + chunk_rows]
                    for k, v in src.items()}
         return
     for c in src:
+        resilience.inject("chunk_source")
         if isinstance(c, Table):
             # to_pandas decodes dictionary columns to values — codes
             # are TABLE-LOCAL and must not cross the host spill raw
@@ -247,16 +252,24 @@ def _lex_gt(cols: Sequence[np.ndarray], split) -> np.ndarray:
 
 
 def _sortable(a: np.ndarray) -> np.ndarray:
-    """Key column encoded for partition comparisons. Ints/datetimes
-    pass through in their own dtype (no precision loss). Floats map to
+    """Key column encoded for partition comparisons. Ints pass through
+    in their own dtype (no precision loss). Floats map to
     order-preserving uint64 (the sign-flip bit trick), with NaN
     canonicalised to a pattern ABOVE +inf — so NaNs range-partition
     strictly last, after real infinities, matching the device sort's
-    (and pandas') inf-before-NaN placement."""
+    (and pandas') inf-before-NaN placement. Datetimes likewise map to
+    order-preserving uint64 with NaT canonicalised to the TOP pattern:
+    the raw int64 NaT sentinel is INT64_MIN, and NaT comparisons are
+    always-False in numpy, so passing the dtype through would silently
+    route every NaT row to bucket 0 while the per-bucket device sort
+    (null validity) and pandas both place them last."""
     a = np.asarray(a)
     if a.dtype.kind not in "iufM":
         raise InvalidArgument(
             f"ooc_sort keys must be numeric/datetime, got {a.dtype}")
+    if a.dtype.kind == "M":
+        u = a.view(np.int64).astype(np.uint64) ^ np.uint64(1 << 63)
+        return np.where(np.isnat(a), np.uint64(0xFFFFFFFFFFFFFFFF), u)
     if a.dtype.kind != "f":
         return a
     f = np.ascontiguousarray(a, np.float64)
@@ -270,11 +283,16 @@ def _scatter_chunks(chunks, pid_fn, n_partitions: int) -> list[dict]:
     """Shared partition scatter: route every chunk's rows into
     ``n_partitions`` host buckets by ``pid_fn(cols) -> int64[n]``,
     returning one dense ``{col: np.ndarray}`` per partition (empty
-    partitions keep the schema)."""
+    partitions keep the schema). Rows-in vs rows-out is verified — a
+    ``pid_fn`` straying outside ``[0, n_partitions)`` (or any scatter
+    bug) raises :class:`~cylon_tpu.errors.DataLossError` instead of
+    silently shrinking the spill."""
+    acct = resilience.RowAccount("host_partition_chunks")
     parts: list[dict[str, list]] = [{} for _ in range(n_partitions)]
     schema: dict[str, np.dtype] = {}
     for chunk in chunks:
         cols = {k: np.asarray(v) for k, v in chunk.items()}
+        acct.add_in(len(next(iter(cols.values()))) if cols else 0)
         pid = pid_fn(cols)
         order = np.argsort(pid, kind="stable")
         bounds = np.searchsorted(pid[order], np.arange(n_partitions + 1))
@@ -292,27 +310,44 @@ def _scatter_chunks(chunks, pid_fn, n_partitions: int) -> list[dict]:
                        else p[name][0]) if name in p
                 else np.empty(0, dt)  # keep schema on empty partitions
                 for name, dt in schema.items()}
+        acct.add_out(len(next(iter(full.values()))) if full else 0)
         out.append(full)
+    acct.verify()
     return out
 
 
 def ooc_sort(src, by, n_partitions: int = 8, chunk_rows: int = 1 << 22,
              sink: Callable | None = None,
-             sample_stride: int = 8192) -> int:
+             sample_stride: int = 8192,
+             resume_dir: str | None = None) -> int:
     """Out-of-core sort: the host-DRAM twin of ``dist_sort``'s
     sample-sort (sample -> splitters -> range partition -> per-range
     device sort), completing sorts whose in-core working set exceeds
     one chip's HBM. Two passes over ``src`` (a host column dict, a
-    chunk iterable, or a zero-arg callable returning a FRESH chunk
-    iterator — e.g. ``lambda: read_parquet_chunks(path, 1 << 22)``;
-    non-callable iterators are consumed by pass 1, so streaming
-    sources must come as callables): pass 1 strided-samples the keys
-    and picks ``n_partitions - 1`` splitter tuples; pass 2
-    range-partitions every chunk into host buckets by vectorised
-    lexicographic compare. Each bucket then device-sorts with the
-    normal fused program and spills via ``sink(pandas_df)`` IN RANGE
-    ORDER — the concatenation of the sink calls is the globally
-    sorted table. Returns total rows.
+    re-iterable of chunks, or a zero-arg callable returning a FRESH
+    chunk iterator — e.g. ``lambda: read_parquet_chunks(path, 1 <<
+    22)``; one-shot iterators/generators are REJECTED up front, since
+    pass 1 would exhaust them and pass 2 would silently sort nothing):
+    pass 1 strided-samples the keys and picks ``n_partitions - 1``
+    splitter tuples; pass 2 range-partitions every chunk into host
+    buckets by vectorised lexicographic compare. Each bucket then
+    device-sorts with the normal fused program and spills via
+    ``sink(pandas_df)`` IN RANGE ORDER — the concatenation of the sink
+    calls is the globally sorted table. Returns total rows.
+
+    Loss accounting: pass-1 and pass-2 row counts must agree (a source
+    that yields fewer rows on its second iteration raises
+    :class:`~cylon_tpu.errors.DataLossError`), and the spilled bucket
+    total must equal the pass-2 count.
+
+    ``resume_dir`` makes pass 2 RESUMABLE: every completed bucket's
+    sorted output spills to a :class:`cylon_tpu.resilience.SpillStore`
+    there (manifest updated atomically per bucket), so a killed run
+    re-invoked with the same arguments replays completed buckets from
+    the store and recomputes only from the first incomplete one — the
+    output is identical to a fault-free run. A manifest whose
+    fingerprint (keys + splitters) does not match is discarded, never
+    resumed against the wrong plan.
 
     Parity: ``dist_sort``'s sample-sort structure
     (``table.cpp DistributedSort`` -> sample + SortImpl) with "another
@@ -324,14 +359,34 @@ def ooc_sort(src, by, n_partitions: int = 8, chunk_rows: int = 1 << 22,
     keys = [by] if isinstance(by, str) else list(by)
     if callable(src):
         chunks = lambda: _as_chunks(src(), chunk_rows)  # noqa: E731
+    elif isinstance(src, Mapping):
+        chunks = lambda: _as_chunks(src, chunk_rows)    # noqa: E731
     else:
+        try:
+            probe = iter(src)
+        except TypeError:
+            raise InvalidArgument(
+                "ooc_sort source must be a column Mapping, a "
+                "re-iterable of chunks, or a zero-arg callable "
+                f"returning a fresh chunk iterator; got "
+                f"{type(src).__name__}") from None
+        if probe is src:
+            raise InvalidArgument(
+                "ooc_sort needs TWO passes over src, but a one-shot "
+                "iterator/generator was passed — pass 1 would exhaust "
+                "it and pass 2 would silently sort 0 rows. Wrap it in "
+                "a zero-arg callable returning a fresh iterator, e.g. "
+                "lambda: read_parquet_chunks(path, chunk_rows)")
         chunks = lambda: _as_chunks(src, chunk_rows)    # noqa: E731
 
     # pass 1: strided per-column key samples (each keeps its own
-    # dtype) -> equi-spaced splitter tuples
+    # dtype) -> equi-spaced splitter tuples; rows counted for the
+    # pass-1 vs pass-2 conservation check
+    rows_pass1 = 0
     samples: list[list[np.ndarray]] = [[] for _ in keys]
     for chunk in chunks():
         kc = [_sortable(np.asarray(chunk[k])) for k in keys]
+        rows_pass1 += len(kc[0])
         if len(kc[0]):
             for i, c in enumerate(kc):
                 samples[i].append(c[::sample_stride])
@@ -344,6 +399,12 @@ def ooc_sort(src, by, n_partitions: int = 8, chunk_rows: int = 1 << 22,
     pos = np.clip(pos, 0, len(order) - 1)
     splitters = [tuple(c[order[p]] for c in scols) for p in pos]
 
+    store = None
+    if resume_dir is not None:
+        fp = resilience.fingerprint_arrays(tuple(keys), n_partitions,
+                                           splitters)
+        store = resilience.SpillStore(resume_dir, fingerprint=fp)
+
     # pass 2: range-partition every chunk into host buckets
     def pid_of(cols_dict):
         kc = [_sortable(cols_dict[k]) for k in keys]
@@ -353,19 +414,55 @@ def ooc_sort(src, by, n_partitions: int = 8, chunk_rows: int = 1 << 22,
         return pid
 
     parts = _scatter_chunks(chunks(), pid_of, n_partitions)
+    # _scatter_chunks verifies chunk rows == bucket rows internally, so
+    # the bucket sizes ARE the pass-2 row count
+    sizes = [len(next(iter(p.values()))) if p else 0 for p in parts]
+    rows_pass2 = sum(sizes)
+    if rows_pass2 != rows_pass1:
+        raise DataLossError(
+            f"ooc_sort: pass 1 saw {rows_pass1} rows but pass 2 saw "
+            f"{rows_pass2} — the source is not replayable (an "
+            "exhausted/truncating iterator?); pass a zero-arg callable "
+            "that returns a fresh iterator each call")
 
-    # range order: per-bucket device sort, spill in splitter order
+    # range order: per-bucket device sort, spill in splitter order.
+    # With a store, completed buckets replay from their durable spill
+    # (identical bytes, no recompute) and each fresh bucket is spilled
+    # + recorded BEFORE its sink call, so a kill between buckets never
+    # loses acknowledged work.
     total = 0
     for p in range(n_partitions):
         full = parts[p]
-        n = len(next(iter(full.values()))) if full else 0
+        n = sizes[p]
+        done = store.completed_rows(p) if store is not None else None
+        if done is not None:
+            if done != n:
+                raise DataLossError(
+                    f"ooc_sort: resume manifest records {done} rows "
+                    f"for bucket {p} but the re-scattered source has "
+                    f"{n} — the source changed since the manifest was "
+                    "written; clear the resume_dir")
+            if n and sink is not None:
+                import pandas as pd
+
+                sink(pd.DataFrame(store.read_bucket(p)))
+            total += n
+            parts[p] = None
+            continue
         if n == 0:
+            if store is not None:
+                store.write_bucket(p, {}, 0)
             continue
         t = Table.from_pydict(full, capacity=pow2_bucket(n))
         res = sort_table(t, keys)
+        pdf = res.to_pandas()
+        if store is not None:
+            store.write_bucket(
+                p, {c: pdf[c].to_numpy() for c in pdf.columns}, n)
         total += n
         if sink is not None:
-            sink(res.to_pandas())
-        del res, t, full
+            sink(pdf)
+        del res, t, full, pdf
         parts[p] = None  # free the spill as we go
+    resilience.check_conservation("ooc_sort", rows_pass2, total)
     return total

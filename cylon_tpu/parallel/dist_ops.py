@@ -23,11 +23,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from cylon_tpu import dtypes
+from cylon_tpu import dtypes, resilience
 from cylon_tpu.column import Column
 from cylon_tpu.config import SortOptions
 from cylon_tpu.context import CylonEnv, WORKER_AXIS
-from cylon_tpu.errors import InvalidArgument, OutOfCapacity
+from cylon_tpu.errors import DataLossError, InvalidArgument, OutOfCapacity
 from cylon_tpu.ops import groupby as _groupby
 from cylon_tpu.ops.join import join as _join_fn
 from cylon_tpu.ops import kernels, setops as _setops
@@ -144,7 +144,40 @@ def _shard_cap(t: Table) -> int:
             else t.capacity)
 
 
-def _adaptive(build, args, adaptive: bool):
+def _counts_memo(t: Table) -> np.ndarray:
+    """Host counts memoized on the (functionally immutable) Table
+    instance — the `_probe_memo` trick: repeated eager exchanges of the
+    same table pay the input-count sync ONCE, not per exchange."""
+    memo = t.__dict__.get("_host_counts_memo")
+    if memo is None:
+        memo = t.__dict__["_host_counts_memo"] = dtable.host_counts(t)
+    return memo
+
+
+def _account_exchange_rows(label: str, args, out_counts) -> None:
+    """Row-conservation invariant for row-preserving exchanges
+    (shuffle/repartition): the summed post-exchange shard counts must
+    equal the summed input counts, or rows were silently lost in the
+    collective — raise :class:`~cylon_tpu.errors.DataLossError`. Skipped
+    when any INPUT is poisoned (its own overflow already carries the
+    truncation mark, and its true count is unknowable). Costs one
+    memoized [W]-count fetch per input table;
+    ``CYLON_TPU_ROW_ACCOUNTING=0`` disables."""
+    rows_in = 0
+    for t in args:
+        tc = _counts_memo(t)
+        if (tc > _shard_cap(t)).any():
+            return  # poisoned input: truncation already marked upstream
+        rows_in += int(tc.sum())
+    rows_out = int(np.asarray(out_counts).sum())
+    if rows_in != rows_out:
+        raise DataLossError(
+            f"{label}: {rows_in} rows entered the exchange but "
+            f"{rows_out} came out — rows were silently dropped or "
+            "duplicated across the collective")
+
+
+def _adaptive(build, args, adaptive: bool, conserve: str | None = None):
     """Dispatch ``build()(*args)`` with automatic capacity regrow.
 
     The reference's exchange allocates receives as counts arrive, so any
@@ -183,6 +216,8 @@ def _adaptive(build, args, adaptive: bool):
         counts = dtable.host_counts(out)         # host sync
         cap_l = _shard_cap(out)
         if (counts <= cap_l).all():
+            if conserve is not None and resilience.accounting_enabled():
+                _account_exchange_rows(conserve, args, counts)
             return out
         # regrow cannot repair an INPUT that already overflowed some
         # upstream explicit bound — its data is truncated for good
@@ -344,6 +379,7 @@ def shuffle(env: CylonEnv, table: Table, key_cols: Sequence[str],
 
     if partitioning not in ("hash", "modulo"):
         raise InvalidArgument(f"unknown partitioning {partitioning!r}")
+    resilience.inject("exchange", "shuffle", env=env)
     if bucket_cap is not None and env.is_hierarchical:
         raise InvalidArgument(
             "bucket_cap is a flat-world per-(sender,dest) bound; on a "
@@ -389,7 +425,8 @@ def shuffle(env: CylonEnv, table: Table, key_cols: Sequence[str],
 
         return _smap(env, body, 1)
 
-    return _adaptive(build, (table,), out_capacity is None)
+    return _adaptive(build, (table,), out_capacity is None,
+                     conserve="shuffle")
 
 
 @traced("dist_filter")
@@ -443,6 +480,7 @@ def repartition(env: CylonEnv, table: Table,
                 out_capacity: int | None = None) -> Table:
     """Round-robin row rebalancing (parity: Java ``roundRobinPartition``,
     ``Table.java:191`` / ``ModuloPartitionKernel``)."""
+    resilience.inject("exchange", "repartition", env=env)
     table = _prep(env, table)
     w = env.world_size
     ax = env.world_axes
@@ -465,7 +503,8 @@ def repartition(env: CylonEnv, table: Table,
 
         return _smap(env, body, 1)
 
-    return _adaptive(build, (table,), out_capacity is None)
+    return _adaptive(build, (table,), out_capacity is None,
+                     conserve="repartition")
 
 
 # -------------------------------------------------------------------- join
@@ -498,6 +537,7 @@ def dist_join(env: CylonEnv, left: Table, right: Table, *,
 
         return _adaptive(build1, (lt, rt), out_capacity is None)
 
+    resilience.inject("exchange", "dist_join", env=env)
     left = _prep(env, left)
     right = _prep(env, right)
     # align key dictionaries once, host-side, so the per-shard join's

@@ -1,0 +1,426 @@
+"""Resilience layer: fault injection, retry/backoff, loss accounting.
+
+The reference threads ``cylon::Status{code, msg}`` through every call
+(``cpp/src/cylon/status.hpp``) but has no recovery story: a failed rank
+fails the mpirun job. A TPU deployment is different — workers are
+preempted, tunneled IO flakes, and an out-of-core pass can die hours in
+— so the rebuild grows the three mechanisms a production stack needs
+before any scale claim is honest:
+
+1. **Deterministic fault injection** (:class:`FaultPlan`): named
+   injection points threaded through the spill store, chunk sources,
+   IO readers, the mesh exchange and the multihost bootstrap. A plan
+   fires configured :class:`~cylon_tpu.errors.CylonError`\\ s on the Nth
+   hit of a point (or probabilistically from a seeded RNG), and
+   ``reset()`` replays the exact same failure sequence — tests assert
+   recovery against byte-identical fault schedules.
+
+2. **Retry/backoff** (:func:`retrying`): exponential backoff with
+   deterministic jitter (:class:`cylon_tpu.config.RetryPolicy`),
+   driven by :func:`is_retryable` over ``errors.Code`` —
+   ``Code.Unavailable`` / :class:`~cylon_tpu.errors.TransientError`
+   retry, everything else raises immediately.
+
+3. **Loss accounting** (:class:`RowAccount`): multi-pass pipelines
+   (``outofcore.ooc_sort``, ``host_partition_chunks``, the eager
+   shuffle drivers) count rows-in vs rows-out and raise
+   :class:`~cylon_tpu.errors.DataLossError` on mismatch — silent
+   truncation becomes a loud failure.
+
+:class:`SpillStore` rounds the layer out: a directory-backed bucket
+spill with an atomically-updated completion manifest, so a killed
+out-of-core pass resumes at the first incomplete bucket instead of
+restarting (see ``outofcore.ooc_sort(resume_dir=...)``).
+"""
+
+import contextlib
+import dataclasses
+import hashlib
+import json
+import os
+import threading
+import time
+
+import numpy as np
+
+from cylon_tpu.config import RetryPolicy
+from cylon_tpu.errors import (Code, CylonError, DataLossError,
+                              InvalidArgument, TransientError)
+
+__all__ = [
+    "INJECTION_POINTS", "FaultRule", "FaultPlan", "install", "active",
+    "active_plan", "inject", "is_retryable", "default_policy",
+    "backoff_delays", "retrying", "RowAccount", "accounting_enabled",
+    "SpillStore",
+]
+
+#: Named places the engine agrees to fail on demand. Each maps to a real
+#: failure domain: ``spill_write``/``spill_read`` — the out-of-core
+#: spill store; ``chunk_source`` — every chunk an out-of-core pass pulls
+#: (``outofcore._as_chunks``); ``io_read`` — the CSV/Parquet readers;
+#: ``exchange`` — the mesh shuffle dispatch; ``worker`` — worker
+#: preemption (exercised by the multihost bootstrap).
+INJECTION_POINTS = ("spill_write", "spill_read", "chunk_source",
+                    "io_read", "exchange", "worker")
+
+
+# ------------------------------------------------------------ fault plans
+@dataclasses.dataclass
+class FaultRule:
+    """One configured failure. Counting rules (the default) fire on hits
+    ``nth .. nth + times - 1`` of ``point`` (``times <= 0`` = every hit
+    from ``nth`` on — a permanently-dead resource). ``prob > 0`` fires
+    probabilistically instead, drawing from the plan's seeded RNG so a
+    ``reset()`` replays the identical schedule. ``error`` is the
+    exception instance (or class) to raise; default is a
+    :class:`~cylon_tpu.errors.TransientError` describing the hit —
+    i.e. a simulated preemption the retry engine may absorb."""
+
+    point: str
+    nth: int = 1
+    times: int = 1
+    error: "Exception | type | None" = None
+    prob: float = 0.0
+
+
+class FaultPlan:
+    """A deterministic, replayable failure schedule.
+
+    Register process-wide with :func:`install` / :func:`active`, or on a
+    :class:`~cylon_tpu.context.CylonEnv` via ``env.set_fault_plan`` for
+    the mesh-op points. ``fired`` records every (point, hit#, detail)
+    that raised; ``reset()`` rewinds counters AND the RNG, so driving
+    the same workload twice produces the same ``fired`` log — the
+    replay-determinism contract the tests pin down.
+    """
+
+    def __init__(self, rules=(), seed: int = 0):
+        self.rules = [r if isinstance(r, FaultRule) else FaultRule(**r)
+                      for r in rules]
+        for r in self.rules:
+            if r.point not in INJECTION_POINTS:
+                raise InvalidArgument(
+                    f"unknown injection point {r.point!r}; valid: "
+                    f"{INJECTION_POINTS}")
+            if r.prob == 0.0 and r.nth < 1:
+                raise InvalidArgument(f"nth must be >= 1, got {r.nth}")
+            if not 0.0 <= r.prob <= 1.0:
+                raise InvalidArgument(f"prob {r.prob} not in [0, 1]")
+        self.seed = seed
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> "FaultPlan":
+        """Rewind hit counters and the RNG: the next run replays the
+        exact same failure schedule."""
+        with self._lock:
+            self._hits = {p: 0 for p in INJECTION_POINTS}
+            self._rng = np.random.default_rng(self.seed)
+            self._fired: list[tuple] = []
+        return self
+
+    @property
+    def fired(self) -> list:
+        """Log of every firing: (point, hit number, detail)."""
+        return list(self._fired)
+
+    def hits(self, point: str) -> int:
+        return self._hits[point]
+
+    def check(self, point: str, detail: str = "") -> None:
+        """Record one hit of ``point``; raise if any rule fires."""
+        with self._lock:
+            self._hits[point] += 1
+            k = self._hits[point]
+            err = None
+            for r in self.rules:
+                if r.point != point:
+                    continue
+                if r.prob > 0.0:
+                    # draw EVERY hit (not only when firing) so the
+                    # stream position — and therefore the schedule —
+                    # depends only on the hit sequence
+                    fire = bool(self._rng.random() < r.prob)
+                else:
+                    hi = None if r.times <= 0 else r.nth + r.times - 1
+                    fire = k >= r.nth and (hi is None or k <= hi)
+                if fire and err is None:
+                    self._fired.append((point, k, detail))
+                    err = (r.error() if isinstance(r.error, type)
+                           else r.error)
+                    if err is None:
+                        err = TransientError(
+                            f"injected fault at {point!r} (hit {k}"
+                            + (f": {detail}" if detail else "") + ")")
+        if err is not None:
+            raise err
+
+
+_LOCK = threading.Lock()
+_ACTIVE: "FaultPlan | None" = None
+
+
+def install(plan: "FaultPlan | None") -> "FaultPlan | None":
+    """Set the process-wide active plan (None clears). Returns the
+    previous plan so callers can restore it."""
+    global _ACTIVE
+    with _LOCK:
+        prev, _ACTIVE = _ACTIVE, plan
+    return prev
+
+
+def active_plan() -> "FaultPlan | None":
+    return _ACTIVE
+
+
+@contextlib.contextmanager
+def active(plan: FaultPlan):
+    """``with resilience.active(plan): ...`` — scoped installation."""
+    prev = install(plan)
+    try:
+        yield plan
+    finally:
+        install(prev)
+
+
+def inject(point: str, detail: str = "", env=None) -> None:
+    """Instrumentation hook: a no-op unless a plan is active. ``env``
+    lets mesh ops prefer a plan registered on their CylonEnv over the
+    process-wide one."""
+    if point not in _POINT_SET:
+        raise InvalidArgument(f"unknown injection point {point!r}")
+    plan = getattr(env, "_fault_plan", None) if env is not None else None
+    plan = plan if plan is not None else _ACTIVE
+    if plan is not None:
+        plan.check(point, detail)
+
+
+_POINT_SET = frozenset(INJECTION_POINTS)
+
+
+# ---------------------------------------------------------- retry engine
+#: codes whose failures are worth re-attempting; everything else is
+#: deterministic (bad input, capacity, real data loss) and re-raises
+_RETRYABLE_CODES = frozenset({Code.Unavailable})
+#: transient OS-level failures (tunneled/remote IO); NOT FileNotFoundError
+#: etc. — a missing file does not appear on retry
+_RETRYABLE_OS = (ConnectionError, TimeoutError, InterruptedError)
+
+
+def is_retryable(exc: BaseException) -> bool:
+    """Classification over ``errors.Code``: TransientError and any
+    CylonError carrying ``Code.Unavailable`` retry; other CylonErrors
+    never do; transient OS errors (connection/timeout/EINTR) retry."""
+    if isinstance(exc, TransientError):
+        return True
+    if isinstance(exc, CylonError):
+        return exc.code in _RETRYABLE_CODES
+    return isinstance(exc, _RETRYABLE_OS)
+
+
+def default_policy() -> RetryPolicy:
+    """The process default :class:`~cylon_tpu.config.RetryPolicy`, with
+    env overrides (read per call so tests can flip them)."""
+    e = os.environ
+    return RetryPolicy(
+        max_attempts=int(e.get("CYLON_TPU_RETRY_ATTEMPTS", "3")),
+        base_delay=float(e.get("CYLON_TPU_RETRY_BASE_DELAY", "0.05")),
+        max_delay=float(e.get("CYLON_TPU_RETRY_MAX_DELAY", "2.0")),
+        multiplier=float(e.get("CYLON_TPU_RETRY_MULTIPLIER", "2.0")),
+        jitter=float(e.get("CYLON_TPU_RETRY_JITTER", "0.1")),
+    )
+
+
+def backoff_delays(policy: RetryPolicy):
+    """Infinite generator of backoff delays for ``policy``:
+    ``min(base * multiplier**k, max_delay)`` with deterministic +-jitter
+    drawn from ``policy.seed`` — the same policy always yields the same
+    sequence (exposed for tests and for reasoning about worst cases)."""
+    rng = np.random.default_rng(policy.seed)
+    d = float(policy.base_delay)
+    while True:
+        j = 1.0 + policy.jitter * (2.0 * rng.random() - 1.0)
+        yield min(d, policy.max_delay) * j
+        d = min(d * policy.multiplier, policy.max_delay)
+
+
+def retrying(fn, policy: "RetryPolicy | None" = None, *,
+             retry_on=None, sleep_fn=None, label: str | None = None):
+    """Call ``fn()`` with retry/backoff; return its result.
+
+    Retries only failures ``retry_on`` (default :func:`is_retryable`)
+    classifies as transient, up to ``policy.max_attempts`` total
+    attempts, sleeping a :func:`backoff_delays` step between attempts
+    (``sleep_fn`` overrides ``time.sleep`` — tests pass a recorder).
+    The final failure re-raises the original exception unchanged."""
+    policy = policy or default_policy()
+    classify = retry_on or is_retryable
+    sleep = time.sleep if sleep_fn is None else sleep_fn
+    delays = backoff_delays(policy)
+    attempts = max(int(policy.max_attempts), 1)
+    for attempt in range(1, attempts + 1):
+        try:
+            return fn()
+        except Exception as e:
+            if attempt >= attempts or not classify(e):
+                raise
+            d = next(delays)
+            from cylon_tpu.utils.logging import get_logger
+
+            get_logger().warning(
+                "%sattempt %d/%d failed (%s: %s); retrying in %.3fs",
+                f"{label}: " if label else "", attempt, attempts,
+                type(e).__name__, e, d)
+            sleep(d)
+
+
+# ------------------------------------------------------- loss accounting
+def accounting_enabled() -> bool:
+    """Row accounting defaults ON; ``CYLON_TPU_ROW_ACCOUNTING=0`` turns
+    the eager shuffle-driver checks off (they cost one extra [W]-count
+    fetch per eager exchange — ~100 ms on a tunneled chip)."""
+    return os.environ.get("CYLON_TPU_ROW_ACCOUNTING", "1") \
+        not in ("0", "off")
+
+
+class RowAccount:
+    """Rows-in vs rows-out invariant for a multi-pass pipeline."""
+
+    def __init__(self, label: str):
+        self.label = label
+        self.rows_in = 0
+        self.rows_out = 0
+
+    def add_in(self, n) -> "RowAccount":
+        self.rows_in += int(n)
+        return self
+
+    def add_out(self, n) -> "RowAccount":
+        self.rows_out += int(n)
+        return self
+
+    def verify(self, what: str = "rows") -> None:
+        if self.rows_in != self.rows_out:
+            raise DataLossError(
+                f"{self.label}: {self.rows_in} {what} in vs "
+                f"{self.rows_out} out — data was silently dropped or "
+                "duplicated")
+
+
+def check_conservation(label: str, rows_in, rows_out,
+                       what: str = "rows") -> None:
+    """One-shot :class:`RowAccount`."""
+    RowAccount(label).add_in(rows_in).add_out(rows_out).verify(what)
+
+
+# ----------------------------------------------------------- spill store
+class SpillStore:
+    """Directory-backed bucket spill with a completion manifest.
+
+    One ``bucket<p>.npz`` per completed range/partition plus
+    ``manifest.json`` recording ``{bucket: rows}`` — updated atomically
+    (tmp + rename) AFTER the bucket's data is durably written, so a kill
+    at any instant leaves either a complete, recorded bucket or nothing.
+    A ``fingerprint`` (hash of the pass's keys/splitters) guards reuse:
+    a store opened with a different fingerprint discards stale state
+    instead of resuming against the wrong plan.
+
+    Writes and reads run under :func:`retrying` and hit the
+    ``spill_write`` / ``spill_read`` injection points — this is the
+    "out-of-core spill store" the retry engine wraps.
+    """
+
+    MANIFEST = "manifest.json"
+
+    def __init__(self, root: str, fingerprint: str = "",
+                 policy: "RetryPolicy | None" = None):
+        self.root = str(root)
+        self._policy = policy or default_policy()
+        os.makedirs(self.root, exist_ok=True)
+        self._mpath = os.path.join(self.root, self.MANIFEST)
+        m = self._load_manifest()
+        if m is None or m.get("fingerprint") != fingerprint:
+            # discard stale state — but ONLY files this store's naming
+            # scheme owns (bucketNNNNN.npz + manifest); a resume_dir
+            # accidentally pointed at a directory of unrelated .npz
+            # data must never be wiped
+            import re
+
+            own = re.compile(r"^bucket\d{5}\.npz(\.tmp)?$")
+            for f in os.listdir(self.root):
+                if own.match(f) or f in (self.MANIFEST,
+                                         self.MANIFEST + ".tmp"):
+                    os.unlink(os.path.join(self.root, f))
+            m = {"fingerprint": fingerprint, "completed": {}}
+            self._write_manifest(m)
+        self._m = m
+
+    def _load_manifest(self):
+        try:
+            with open(self._mpath) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _write_manifest(self, m) -> None:
+        tmp = self._mpath + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(m, f)
+        os.replace(tmp, self._mpath)
+
+    def _bucket_path(self, p: int) -> str:
+        return os.path.join(self.root, f"bucket{int(p):05d}.npz")
+
+    @property
+    def completed(self) -> dict:
+        """{bucket index: rows} for every durably completed bucket."""
+        return {int(k): int(v) for k, v in self._m["completed"].items()}
+
+    def completed_rows(self, p: int) -> "int | None":
+        v = self._m["completed"].get(str(int(p)))
+        return None if v is None else int(v)
+
+    def write_bucket(self, p: int, cols: dict, rows: int) -> None:
+        """Durably spill one bucket's columns, then record completion.
+        Empty buckets record 0 rows with no file."""
+        path = self._bucket_path(p)
+
+        def _write():
+            inject("spill_write", f"bucket {p}")
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                np.savez(f, **cols)
+            os.replace(tmp, path)
+
+        if rows:
+            retrying(_write, self._policy, label=f"spill_write[{p}]")
+        self._m["completed"][str(int(p))] = int(rows)
+        self._write_manifest(self._m)
+
+    def read_bucket(self, p: int) -> dict:
+        """Reload a completed bucket's columns (insertion order kept)."""
+        path = self._bucket_path(p)
+
+        def _read():
+            inject("spill_read", f"bucket {p}")
+            with np.load(path, allow_pickle=True) as z:
+                return {k: z[k] for k in z.files}
+
+        return retrying(_read, self._policy, label=f"spill_read[{p}]")
+
+
+def fingerprint_arrays(*parts) -> str:
+    """Stable hex digest of heterogeneous plan state (key names, ints,
+    numpy scalars/arrays) — the spill-store reuse guard."""
+    h = hashlib.sha256()
+    for part in parts:
+        if isinstance(part, (list, tuple)):
+            h.update(fingerprint_arrays(*part).encode())
+        elif isinstance(part, np.ndarray) or isinstance(part, np.generic):
+            a = np.asarray(part)
+            h.update(str(a.dtype).encode())
+            h.update(a.tobytes())
+        else:
+            h.update(repr(part).encode())
+        h.update(b"|")
+    return h.hexdigest()

@@ -23,6 +23,15 @@ import numpy as np
 import pytest
 
 
+def pytest_configure(config):
+    # tier-1 runs `-m 'not slow'`; registering the marker keeps
+    # `--strict-markers` viable and documents the contract
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running; excluded from the tier-1 gate "
+        "(-m 'not slow')")
+
+
 @pytest.fixture(scope="session")
 def env8():
     """A distributed CylonEnv over all 8 virtual devices."""

@@ -1,0 +1,145 @@
+"""Lint-ish guard for the benchmark drivers.
+
+``bench_suite.py`` only executes on real hardware runs, so an undefined
+name (the round-5 NameError: ``_is_crash``/``attempted``/``crashed``
+referenced but never defined) ships invisibly past the CPU test tier
+and detonates mid-benchmark, masking the real device error. This guard
+compiles the drivers AND walks their ASTs with a pyflakes-style
+scope-aware undefined-name check, so that class of bug fails tier-1.
+"""
+
+import ast
+import builtins
+import pathlib
+import py_compile
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+DRIVERS = ["bench_suite.py", "bench.py"]
+
+_FN = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _add_arg_names(args: ast.arguments, names: set):
+    for a in (args.posonlyargs + args.args + args.kwonlyargs):
+        names.add(a.arg)
+    if args.vararg:
+        names.add(args.vararg.arg)
+    if args.kwarg:
+        names.add(args.kwarg.arg)
+
+
+def _bound_names(node, names: set):
+    """Names BOUND directly in ``node``'s scope: assignments (incl.
+    walrus, aug/ann, for/with/except targets, comprehension targets —
+    over-approximated into the enclosing scope), imports, and nested
+    def/class names. Does not descend into nested function bodies
+    (their locals are invisible here)."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, _FN + (ast.ClassDef,)):
+            names.add(child.name)
+            continue  # nested scope: its bindings are not ours
+        if isinstance(child, ast.Lambda):
+            continue
+        if isinstance(child, ast.Name) and isinstance(
+                child.ctx, (ast.Store, ast.Del)):
+            names.add(child.id)
+        elif isinstance(child, (ast.Import, ast.ImportFrom)):
+            for alias in child.names:
+                if alias.name == "*":
+                    continue
+                names.add((alias.asname or alias.name).split(".")[0])
+        elif isinstance(child, ast.ExceptHandler) and child.name:
+            names.add(child.name)
+        elif isinstance(child, (ast.Global, ast.Nonlocal)):
+            names.update(child.names)
+        _bound_names(child, names)
+
+
+def _check_scope(node, visible: set, problems: list):
+    """Walk loads in ``node``'s scope; recurse into nested functions
+    with their own locals layered on top of ``visible``."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, _FN):
+            sub = set(visible)
+            _add_arg_names(child.args, sub)
+            _bound_names(child, sub)
+            for dec in child.decorator_list:
+                _check_scope(dec, visible, problems)
+            _check_scope(child, sub, problems)
+            continue
+        if isinstance(child, ast.Lambda):
+            sub = set(visible)
+            _add_arg_names(child.args, sub)
+            _bound_names(child, sub)
+            _check_scope(child, sub, problems)
+            continue
+        if isinstance(child, ast.ClassDef):
+            # class bodies are rare in drivers; check them as a plain
+            # nested view of the enclosing scope
+            sub = set(visible)
+            _bound_names(child, sub)
+            _check_scope(child, sub, problems)
+            continue
+        if isinstance(child, ast.Name) and isinstance(child.ctx, ast.Load):
+            if child.id not in visible:
+                problems.append((child.lineno, child.id))
+        _check_scope(child, visible, problems)
+
+
+def undefined_names(path: pathlib.Path) -> list:
+    tree = ast.parse(path.read_text(), filename=str(path))
+    module_scope = set(dir(builtins)) | {
+        "__file__", "__name__", "__doc__", "__package__", "__spec__"}
+    _bound_names(tree, module_scope)
+    problems: list = []
+    _check_scope(tree, module_scope, problems)
+    return sorted(set(problems))
+
+
+@pytest.mark.parametrize("driver", DRIVERS)
+def test_driver_compiles(driver):
+    py_compile.compile(str(REPO / driver), doraise=True)
+
+
+@pytest.mark.parametrize("driver", DRIVERS)
+def test_driver_has_no_undefined_names(driver):
+    bad = undefined_names(REPO / driver)
+    assert not bad, (
+        f"{driver} references undefined names (the class of bug that "
+        f"shipped the _run_tpch NameError): {bad}")
+
+
+def test_checker_catches_the_original_bug(tmp_path):
+    """Self-test: the exact round-5 failure shape — a name used in a
+    function that is defined nowhere — is flagged."""
+    p = tmp_path / "buggy.py"
+    p.write_text(
+        "def _run(sf):\n"
+        "    try:\n"
+        "        pass\n"
+        "    except Exception as e:\n"
+        "        if _is_crash(e):\n"
+        "            attempted.append(sf)\n"
+    )
+    bad = undefined_names(p)
+    assert {n for _, n in bad} == {"_is_crash", "attempted"}
+
+
+def test_checker_accepts_closures_and_comprehensions(tmp_path):
+    p = tmp_path / "ok.py"
+    p.write_text(
+        "import os\n"
+        "X = 1\n"
+        "def outer(a):\n"
+        "    acc = []\n"
+        "    def inner(b):\n"
+        "        acc.append(a + b + X)\n"
+        "    vals = [y * 2 for y in range(a)]\n"
+        "    f = lambda z: z + a\n"
+        "    with open(os.devnull) as fh:\n"
+        "        pass\n"
+        "    return inner, vals, f, fh\n"
+    )
+    assert undefined_names(p) == []

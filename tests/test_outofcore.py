@@ -279,8 +279,12 @@ def test_ooc_sort_callable_source_and_empty(rng):
 
 def test_ooc_sort_inf_nan_and_mixed_dtypes(rng):
     """The partition encode keeps inf < NaN (both last bucket-wards),
-    never promotes across key dtypes (datetime + float multi-key), and
-    holds int64 exactness above 2^53."""
+    canonicalises datetime NaT ABOVE every valid timestamp (NaT rows
+    range-partition into the LAST bucket, where the per-bucket device
+    sort and pandas both place them — raw int64 NaT is INT64_MIN, which
+    would silently land them in bucket 0), never promotes across key
+    dtypes (datetime + float multi-key), and holds int64 exactness
+    above 2^53."""
     from cylon_tpu.outofcore import ooc_sort
 
     n = 4000
@@ -290,6 +294,8 @@ def test_ooc_sort_inf_nan_and_mixed_dtypes(rng):
     v[rng.integers(0, n, 50)] = -np.inf
     d = np.datetime64("2020-01-01") + rng.integers(
         0, 40, n).astype("timedelta64[D]")
+    d[rng.integers(0, n, 300)] = np.datetime64("NaT")
+    assert np.isnat(d).any()
     src = {"d": d, "v": v, "i": rng.integers(0, n, n).astype(np.int64)}
     parts = []
     total = ooc_sort(src, ["d", "v"], n_partitions=4, chunk_rows=900,
@@ -297,7 +303,10 @@ def test_ooc_sort_inf_nan_and_mixed_dtypes(rng):
     assert total == n
     got = pd.concat(parts, ignore_index=True)
     want = pd.DataFrame(src).sort_values(["d", "v"]).reset_index(drop=True)
-    np.testing.assert_array_equal(got["d"].to_numpy(), want["d"].to_numpy())
+    gd, wd = got["d"].to_numpy(), want["d"].to_numpy()
+    assert ((gd == wd) | (np.isnat(gd) & np.isnat(wd))).all()
+    # every NaT row sorts after every valid timestamp (pandas placement)
+    assert not np.isnat(gd)[: n - np.isnat(d).sum()].any()
     gv, wv = got["v"].to_numpy(), want["v"].to_numpy()
     assert ((gv == wv) | (np.isnan(gv) & np.isnan(wv))).all()
 
