@@ -50,6 +50,17 @@ def _emit(metric, value, unit, baseline=None):
     print(json.dumps(line))
 
 
+def _subproc_timeout():
+    """Ceiling (seconds) on every child process the suite spawns —
+    CYLON_BENCH_SUBPROC_TIMEOUT, default 3600, <= 0 disables. A child
+    that hangs (wedged device, stuck collective) is killed at the
+    ceiling and classified as a CRASH, so the respawn paths re-run its
+    unattempted queries instead of the whole harness hanging forever
+    with no diagnostics."""
+    v = float(os.environ.get("CYLON_BENCH_SUBPROC_TIMEOUT", "3600"))
+    return v if v > 0 else None
+
+
 def main():
     import jax
 
@@ -178,8 +189,14 @@ def main():
     child_env = dict(os.environ)
     child_env["XLA_FLAGS"] = (child_env.get("XLA_FLAGS", "")
                               + " --xla_force_host_platform_device_count=8")
-    subprocess.run([sys.executable, os.path.abspath(__file__),
-                    "--exchange"], env=child_env, check=False)
+    try:
+        subprocess.run([sys.executable, os.path.abspath(__file__),
+                        "--exchange"], env=child_env, check=False,
+                       timeout=_subproc_timeout())
+    except subprocess.TimeoutExpired:
+        # recorded DNF for the leg; the rest of the suite already ran
+        _emit("exchange_leg_timeout", 1,
+              "child killed at CYLON_BENCH_SUBPROC_TIMEOUT")
 
 
 def _is_oom(e: Exception) -> bool:
@@ -299,6 +316,30 @@ def _run_tpch(sf, reps, tag_hbm: bool = False, ooc_report=None):
         return {"attempted": list(attempted), "crashed": list(crashed),
                 "skipped": skipped, "ooc_pending": list(pending)}
 
+    def _checkpoint():
+        # per-query progress snapshot to the sentinel: if this process
+        # is KILLED mid-query (parent timeout on a hang, OOM-killer),
+        # the parent still learns exactly what was attempted and
+        # charges the in-flight query as the crash (_classify_timeout)
+        sentinel = os.environ.get("CYLON_SCALE_SENTINEL")
+        if not sentinel:
+            return
+        try:
+            # tmp + rename: the parent may KILL this process at any
+            # instant (that is the point), and a torn half-written
+            # JSON would read as "no report" — losing the whole
+            # checkpoint history
+            with open(sentinel + ".tmp", "w") as f:
+                json.dump({
+                    "tpch_attempted": list(attempted),
+                    "tpch_crashed": list(crashed),
+                    "tpch_skipped": [q for q in selected
+                                     if q not in attempted],
+                    "tpch_ooc": list(ooc_pending)}, f)
+            os.replace(sentinel + ".tmp", sentinel)
+        except OSError:
+            pass  # checkpointing must never fail the run
+
     for qname in selected:
         qfn = getattr(tpch, qname) if eager else tpch.compiled(qname)
         res = {}
@@ -344,6 +385,7 @@ def _run_tpch(sf, reps, tag_hbm: bool = False, ooc_report=None):
             if qname in ("q1", "q5"):
                 ooc_pending.append(qname)
         attempted.append(qname)
+        _checkpoint()
     # regrow events: CompiledQuery memoizes the scale each (query,
     # shape) settled at — >1 means the capacity ladder re-dispatched
     for fn, cq in tpch._COMPILED.items():
@@ -394,9 +436,12 @@ def _tpch_ooc(data, qnames, sf):
 def _spawn_sentinel(flag, extra_env=None):
     """Run this file in a child process with ``flag``, collecting its
     sentinel-JSON report (the process-boundary contract scale_main's
-    docstring explains). Returns ``(returncode, report | None)`` —
-    None means the child died without reporting (a crash, not a
-    recorded result)."""
+    docstring explains). Returns ``(returncode, report | None,
+    timed_out)`` — a None report means the child died without
+    reporting (a crash, not a recorded result); ``timed_out`` means it
+    was KILLED at the :func:`_subproc_timeout` ceiling (a hang — the
+    report, if any, is the child's last per-query checkpoint, and the
+    caller classifies the in-flight query as crashed)."""
     import tempfile
 
     with tempfile.NamedTemporaryFile("r", suffix=".json",
@@ -405,17 +450,47 @@ def _spawn_sentinel(flag, extra_env=None):
     child_env = dict(os.environ)
     child_env.update(extra_env or {})
     child_env["CYLON_SCALE_SENTINEL"] = sentinel
-    rc = subprocess.run(
-        [sys.executable, os.path.abspath(__file__), flag],
-        env=child_env).returncode
+    timed_out = False
+    try:
+        rc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), flag],
+            env=child_env, timeout=_subproc_timeout()).returncode
+    except subprocess.TimeoutExpired:
+        rc, timed_out = -9, True  # run() killed the child on expiry
     try:
         with open(sentinel) as f:
             part = json.load(f)
     except (OSError, ValueError):
         part = None
     finally:
-        os.unlink(sentinel)
-    return rc, part
+        for p in (sentinel, sentinel + ".tmp"):
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+    return rc, part, timed_out
+
+
+def _classify_timeout(part, queried):
+    """A timed-out TPC-H child was killed mid-query: its sentinel (the
+    per-query checkpoint ``_run_tpch`` maintains, possibly absent if it
+    hung before the first query completed) lists what it finished, so
+    the hang victim is the first selected query not yet attempted.
+    Classify that query as attempted+crashed — exactly how an in-child
+    device crash reports — so ``_tpch_respawn`` strictly shrinks the
+    skipped set and re-runs the remainder in a fresh process."""
+    part = dict(part or {})
+    names = [f"q{i}" for i in range(1, 23)]
+    selected = [q for q in names if q in queried]
+    attempted = list(part.get("tpch_attempted", []))
+    hung = next((q for q in selected if q not in attempted), None)
+    if hung is not None:
+        attempted.append(hung)
+        part["tpch_crashed"] = part.get("tpch_crashed", []) + [hung]
+    part["tpch_attempted"] = attempted
+    part["tpch_skipped"] = [q for q in selected if q not in attempted]
+    part.setdefault("tpch_ooc", [])
+    return part
 
 
 def _tpch_respawn(flag, skipped, agg, crash_log):
@@ -434,9 +509,16 @@ def _tpch_respawn(flag, skipped, agg, crash_log):
     while skipped and skipped != prev:
         prev = skipped
         _emit("tpch_respawn_queries", len(skipped), "queries")
-        rc, part = _spawn_sentinel(flag, {
+        rc, part, timed_out = _spawn_sentinel(flag, {
             "CYLON_BENCH_TPCH_QUERIES": ",".join(sorted(skipped))})
-        if part is None:
+        if timed_out:
+            # a HUNG child (killed at the timeout ceiling) is a crash:
+            # charge the in-flight query and re-run the remainder
+            part = _classify_timeout(part, set(skipped))
+            crash_log.append(
+                f"tpch respawn ({flag}) timed out; "
+                f"{part['tpch_crashed'][-1:]} classified as crashed")
+        elif part is None:
             crash_log.append(
                 f"tpch respawn ({flag}) exited rc={rc} with no "
                 "sentinel")
@@ -472,8 +554,22 @@ def scale_main():
     crashed = []
     legs = (["join", "sort"] if n else []) + (["tpch"] if sf else [])
     for leg in legs:
-        rc, part = _spawn_sentinel(f"--scale-incore={leg}")
-        if part is None:
+        rc, part, timed_out = _spawn_sentinel(f"--scale-incore={leg}")
+        if timed_out and leg == "tpch":
+            # hung child killed at the ceiling: classify the in-flight
+            # query as crashed (from its per-query checkpoint) and let
+            # the respawn path below finish the remainder
+            only = os.environ.get("CYLON_BENCH_TPCH_QUERIES")
+            queried = ({q.strip() for q in only.split(",")} if only
+                       else {f"q{i}" for i in range(1, 23)})
+            part = _classify_timeout(part, queried)
+            crashed.append(f"--scale-incore={leg} timed out; "
+                           "in-flight query classified as crashed")
+        elif timed_out:
+            crashed.append(f"--scale-incore={leg} killed at "
+                           "CYLON_BENCH_SUBPROC_TIMEOUT (hang)")
+            continue
+        elif part is None:
             # the child died without reporting (not a recorded OOM — a
             # crash). Record it, but DON'T abort yet: earlier legs'
             # out-of-core completions must still run ("slow is fine,
@@ -650,8 +746,9 @@ def scale_incore_main(leg: str):
 
     sentinel = os.environ.get("CYLON_SCALE_SENTINEL")
     if sentinel:
-        with open(sentinel, "w") as f:
+        with open(sentinel + ".tmp", "w") as f:
             json.dump(report, f)
+        os.replace(sentinel + ".tmp", sentinel)
 
 
 def tpch_main():
@@ -667,11 +764,12 @@ def tpch_main():
     acct = _run_tpch(sf, reps)
     sentinel = os.environ.get("CYLON_SCALE_SENTINEL")
     if sentinel:
-        with open(sentinel, "w") as f:
+        with open(sentinel + ".tmp", "w") as f:
             json.dump({"tpch_attempted": acct["attempted"],
                        "tpch_crashed": acct["crashed"],
                        "tpch_skipped": acct["skipped"],
                        "tpch_ooc": acct["ooc_pending"]}, f)
+        os.replace(sentinel + ".tmp", sentinel)
 
 
 def tpu_exchange_main():
@@ -891,9 +989,15 @@ if __name__ == "__main__":
             child_env["XLA_FLAGS"] = (
                 child_env.get("XLA_FLAGS", "")
                 + " --xla_force_host_platform_device_count=8")
-            sys.exit(subprocess.run(
-                [sys.executable, os.path.abspath(__file__),
-                 "--weak-scaling"], env=child_env).returncode)
+            try:
+                sys.exit(subprocess.run(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--weak-scaling"], env=child_env,
+                    timeout=_subproc_timeout()).returncode)
+            except subprocess.TimeoutExpired:
+                _emit("weak_scaling_timeout", 1,
+                      "child killed at CYLON_BENCH_SUBPROC_TIMEOUT")
+                sys.exit(124)
         weak_scaling_main()
     else:
         main()

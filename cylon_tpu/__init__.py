@@ -70,6 +70,7 @@ from cylon_tpu.errors import (
     CylonError,
     Code,
     DataLossError,
+    DeadlineExceeded,
     IndexError_,
     InvalidArgument,
     KeyError_,
@@ -78,8 +79,9 @@ from cylon_tpu.errors import (
     TransientError,
     TypeError_,
 )
-from cylon_tpu.config import RetryPolicy
+from cylon_tpu.config import DeadlinePolicy, RetryPolicy
 from cylon_tpu.resilience import FaultPlan, FaultRule
+from cylon_tpu.watchdog import deadline
 from cylon_tpu.table import Table
 from cylon_tpu.series import Series
 from cylon_tpu.frame import DataFrame, GroupByDataFrame, concat, merge, read_csv
@@ -98,9 +100,12 @@ __all__ = [
     "CylonError",
     "Code",
     "DataLossError",
+    "DeadlineExceeded",
+    "DeadlinePolicy",
     "FaultPlan",
     "FaultRule",
     "RetryPolicy",
+    "deadline",
     "TransientError",
     "IndexError_",
     "InvalidArgument",

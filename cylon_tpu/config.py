@@ -97,6 +97,52 @@ class RetryPolicy:
     seed: int = 0
 
 
+#: Default per-section deadline budgets (seconds) for the watchdog
+#: layer (:mod:`cylon_tpu.watchdog`); ``None`` = unbounded, preserving
+#: the historical wait-forever semantics unless an ambient
+#: ``watchdog.deadline(...)`` scope is active. Each section is
+#: env-overridable per call via ``CYLON_TPU_DEADLINE_<SECTION>``
+#: (uppercased section name; ``0`` or negative clears it back to
+#: unbounded), so a deployment can bound e.g. every barrier at 300 s
+#: without touching code.
+DEADLINE_SECTIONS: "dict[str, float | None]" = {
+    "barrier": None,         # CylonEnv.barrier device drain
+    "bootstrap": None,       # jax.distributed.initialize (multihost)
+    "overflow_fetch": None,  # plan._check_overflow batched device_get
+    "spill_io": None,        # SpillStore bucket write/read
+    "ooc_pass": None,        # out-of-core join/groupby/sort passes
+    "exchange": None,        # shuffle/repartition/dist_join dispatch
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class DeadlinePolicy:
+    """Knobs for the deadline/watchdog layer (:mod:`cylon_tpu.watchdog`).
+
+    No reference analog: the reference's async all-to-all surfaces
+    progress via its ``isComplete()`` loop but every host-side wait
+    still blocks forever. Here a monitor thread (started lazily — never
+    unless some section runs under a deadline) watches registered
+    blocking sections and, when one stalls past its budget, dumps
+    all-thread stack traces to stderr with the section label and
+    elapsed time, then either lets the section raise
+    :class:`~cylon_tpu.errors.DeadlineExceeded` (``action="raise"``,
+    the default) or kills the process (``action="abort"`` — the honest
+    policy for a wedged collective no raise can unwind; exit code 70).
+
+    The process default reads env overrides per call (see
+    :func:`cylon_tpu.watchdog.default_deadline_policy`):
+    ``CYLON_TPU_WATCHDOG_POLL`` / ``CYLON_TPU_DEADLINE_ACTION`` /
+    ``CYLON_TPU_DEADLINE_DUMP``.
+    """
+
+    #: monitor re-scan cadence while an already-dumped section is still
+    #: stalled (waits for undumped expiries are exact/event-driven)
+    poll_interval: float = 0.05
+    action: str = "raise"        # "raise" | "abort" (os._exit(70))
+    dump_stacks: bool = True     # all-thread stacks to stderr on stall
+
+
 @dataclasses.dataclass(frozen=True)
 class CSVReadOptions:
     """Parity: ``io/csv_read_config.hpp:28-152`` — every builder method

@@ -92,7 +92,7 @@ class CylonEnv:
         self._config = config
         self._fault_plan = None
         if isinstance(config, TPUConfig) and config.multihost:
-            from cylon_tpu import resilience
+            from cylon_tpu import resilience, watchdog
 
             kw = {}
             if config.coordinator_address is not None:
@@ -104,12 +104,27 @@ class CylonEnv:
             # EXPECTED to heal (preempted pods rejoin): retry with
             # backoff instead of failing the whole program on the first
             # coordinator timeout (reference: mpirun just dies)
+            abandoned = {"n": 0, "claimed": False}
+
             def _bootstrap():
                 resilience.inject("worker", "multihost bootstrap",
                                   env=self)
                 try:
                     jax.distributed.initialize(**kw)
                 except Exception as e:
+                    if ("only be called once" in str(e)
+                            and abandoned["n"]):
+                        # a deadline-abandoned earlier attempt of OURS
+                        # set the global state between retries — the
+                        # slow-but-healthy coordinator case. Claim it
+                        # as the live bootstrap; the claim also stops
+                        # the abandoned attempt's failure path from
+                        # tearing that state down (below). If the
+                        # abandoned connect later fails anyway, the
+                        # first collective surfaces it — a claim on a
+                        # dead mesh cannot be detected here.
+                        abandoned["claimed"] = True
+                        return
                     # a failed connect can leave the global distributed
                     # state half-set, turning every re-attempt into
                     # "initialize should only be called once" — clear
@@ -119,7 +134,12 @@ class CylonEnv:
                     # first): leave it alone — tearing down a running
                     # job's coordinator as a side effect is worse than
                     # re-raising.
-                    if "only be called once" not in str(e):
+                    # ... unless a LATER attempt already claimed this
+                    # bootstrap as live (we are the abandoned worker
+                    # failing after the fact): shutting down then would
+                    # destroy the state the running program depends on.
+                    if "only be called once" not in str(e) \
+                            and not abandoned["claimed"]:
                         try:
                             jax.distributed.shutdown()
                         except Exception:
@@ -136,7 +156,27 @@ class CylonEnv:
                         "DEADLINE_EXCEEDED", "UNAVAILABLE",
                         "onnection", "oordinator")))
 
-            resilience.retrying(_bootstrap, label="multihost bootstrap",
+            # each attempt is bounded by the "bootstrap" watchdog
+            # section (retryable: a preempted coordinator/peer may come
+            # back), so a coordinator that neither answers nor refuses
+            # — the hang mode retries alone can never see — dumps
+            # stacks, raises DeadlineExceeded, and re-attempts.
+            # Abandoned (timed-out) attempts are counted so a later
+            # attempt can recognise their delayed success (see the
+            # "only be called once" branch in _bootstrap).
+            def _attempt():
+                from cylon_tpu.errors import DeadlineExceeded
+
+                try:
+                    return watchdog.bounded(
+                        _bootstrap, "bootstrap",
+                        detail="jax.distributed.initialize")
+                except DeadlineExceeded:
+                    abandoned["n"] += 1
+                    raise
+
+            resilience.retrying(_attempt,
+                                label="multihost bootstrap",
                                 retry_on=_bootstrap_retryable)
 
         if isinstance(config, LocalConfig) or not distributed:
@@ -287,13 +327,28 @@ class CylonEnv:
         return NamedSharding(self._mesh, PartitionSpec())
 
     # -- lifecycle (parity: Barrier/Finalize) -----------------------------
-    def barrier(self):
-        """Block host until all devices drained (parity: ctx Barrier)."""
+    def barrier(self, timeout: "float | None" = None):
+        """Block host until all devices drained (parity: ctx Barrier).
+
+        ``timeout`` (seconds) bounds the wait through the watchdog
+        layer: on expiry all-thread stacks are dumped and
+        :class:`~cylon_tpu.errors.DeadlineExceeded` (section
+        ``"barrier"``, never retryable — a peer that missed the
+        barrier left the mesh unrecoverable) is raised. Default None
+        preserves the historical block-forever semantics unless an
+        ambient ``watchdog.deadline`` scope or
+        ``CYLON_TPU_DEADLINE_BARRIER`` is active."""
         import jax.numpy as jnp
 
-        x = jax.device_put(jnp.zeros(self.world_size, jnp.int32),
-                           self.row_sharding)
-        jax.block_until_ready(jax.jit(lambda v: v.sum())(x))
+        from cylon_tpu import watchdog
+
+        def _drain():
+            x = jax.device_put(jnp.zeros(self.world_size, jnp.int32),
+                               self.row_sharding)
+            jax.block_until_ready(jax.jit(lambda v: v.sum())(x))
+
+        watchdog.bounded(_drain, "barrier", timeout=timeout,
+                         detail=f"world={self.world_size}")
 
     def finalize(self):
         self._finalized = True

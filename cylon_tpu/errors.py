@@ -26,12 +26,16 @@ class Code(enum.IntEnum):
     SerializationError = 11
     GpuMemoryError = 12  # kept for numeric parity; unused on TPU
     RError = 13
-    # 14/15 are unused by the reference enum; they take the gRPC
+    # 14/15/16 are unused by the reference enum; 14/15 take the gRPC
     # UNAVAILABLE / DATA_LOSS numbers for the resilience layer
     # (cylon_tpu.resilience) — the reference has no recovery story to
     # mirror, so these are TPU-rebuild extensions, not parity codes.
+    # gRPC's DEADLINE_EXCEEDED number (4) is already the reference's
+    # Invalid, so the deadline/watchdog layer (cylon_tpu.watchdog)
+    # takes the next free slot instead.
     Unavailable = 14
     DataLoss = 15
+    DeadlineExceeded = 16
     CodeGenError = 40
     ExpressionValidationError = 41
     ExecutionError = 42
@@ -90,6 +94,32 @@ class DataLossError(CylonError):
     already gone; the source or manifest must be repaired."""
 
     code = Code.DataLoss
+
+
+class DeadlineExceeded(CylonError):
+    """A named blocking section (``cylon_tpu.watchdog``) stalled past
+    its deadline: a barrier no peer completed, a multihost bootstrap
+    whose coordinator never answered, a device fetch against a wedged
+    chip, spill IO against a hung filesystem. The watchdog dumps
+    all-thread stacks to stderr before this is raised, so the stall
+    site is diagnosable post-mortem.
+
+    ``retryable`` is classified per section
+    (:data:`cylon_tpu.watchdog.SECTIONS`): bootstrap/IO deadlines may
+    heal on retry (a preempted peer rejoins, a mount recovers);
+    mid-collective deadlines never do — the mesh state is
+    unrecoverable, a re-issued collective would deadlock against the
+    half-completed one. :func:`cylon_tpu.resilience.is_retryable`
+    consults this flag."""
+
+    code = Code.DeadlineExceeded
+
+    def __init__(self, msg: str = "", *, section: "str | None" = None,
+                 elapsed: "float | None" = None, retryable: bool = False):
+        super().__init__(msg)
+        self.section = section
+        self.elapsed = elapsed
+        self.retryable = bool(retryable)
 
 
 class OutOfCapacity(CylonError):

@@ -30,7 +30,7 @@ from typing import Callable, Iterable, Mapping, Sequence
 
 import numpy as np
 
-from cylon_tpu import resilience
+from cylon_tpu import resilience, watchdog
 from cylon_tpu.errors import DataLossError, InvalidArgument
 
 __all__ = ["host_partition_chunks", "ooc_join", "ooc_groupby", "ooc_sort"]
@@ -92,11 +92,16 @@ def _as_chunks(src, chunk_rows: int):
     if isinstance(src, Mapping):
         n = len(next(iter(src.values())))
         for lo in range(0, n, chunk_rows):
+            watchdog.check("ooc_pass", "chunk source")
             resilience.inject("chunk_source")
             yield {k: np.asarray(v)[lo:lo + chunk_rows]
                    for k, v in src.items()}
         return
     for c in src:
+        # cooperative deadline checkpoint per chunk: an ooc pass under
+        # a deadline raises promptly BETWEEN chunks (the watched
+        # section around the whole pass only raises on exit)
+        watchdog.check("ooc_pass", "chunk source")
         resilience.inject("chunk_source")
         if isinstance(c, Table):
             # to_pandas decodes dictionary columns to values — codes
@@ -107,6 +112,7 @@ def _as_chunks(src, chunk_rows: int):
             yield c
 
 
+@watchdog.watched("ooc_pass", "ooc_join")
 def ooc_join(left, right, on, how: str = "inner",
              n_partitions: int = 8, chunk_rows: int = 1 << 22,
              sink: Callable | None = None,
@@ -138,6 +144,7 @@ def ooc_join(left, right, on, how: str = "inner",
 
     total = 0
     for p in range(n_partitions):
+        watchdog.check("ooc_pass", f"join partition {p}")
         lp, rp = lparts[p], rparts[p]
         ln = len(next(iter(lp.values()))) if lp else 0
         rn = len(next(iter(rp.values()))) if rp else 0
@@ -187,6 +194,7 @@ def ooc_join(left, right, on, how: str = "inner",
     return total
 
 
+@watchdog.watched("ooc_pass", "ooc_groupby")
 def ooc_groupby(src, by: Sequence[str], aggs,
                 chunk_rows: int = 1 << 22,
                 transform: Callable | None = None):
@@ -316,6 +324,7 @@ def _scatter_chunks(chunks, pid_fn, n_partitions: int) -> list[dict]:
     return out
 
 
+@watchdog.watched("ooc_pass", "ooc_sort")
 def ooc_sort(src, by, n_partitions: int = 8, chunk_rows: int = 1 << 22,
              sink: Callable | None = None,
              sample_stride: int = 8192,
@@ -432,6 +441,7 @@ def ooc_sort(src, by, n_partitions: int = 8, chunk_rows: int = 1 << 22,
     # loses acknowledged work.
     total = 0
     for p in range(n_partitions):
+        watchdog.check("ooc_pass", f"sort bucket {p}")
         full = parts[p]
         n = sizes[p]
         done = store.completed_rows(p) if store is not None else None

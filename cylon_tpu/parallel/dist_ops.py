@@ -23,7 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from cylon_tpu import dtypes, resilience
+from cylon_tpu import dtypes, resilience, watchdog
 from cylon_tpu.column import Column
 from cylon_tpu.config import SortOptions
 from cylon_tpu.context import CylonEnv, WORKER_AXIS
@@ -365,6 +365,7 @@ def _padded_exchange(env: CylonEnv) -> bool:
     return env.platform == "cpu"
 
 
+@watchdog.watched("exchange", "shuffle")
 @traced("shuffle")
 def shuffle(env: CylonEnv, table: Table, key_cols: Sequence[str],
             out_capacity: int | None = None,
@@ -475,6 +476,7 @@ def dist_head(table: Table, n: int) -> Table:
     return table.with_nrows(new)
 
 
+@watchdog.watched("exchange", "repartition")
 @traced("repartition")
 def repartition(env: CylonEnv, table: Table,
                 out_capacity: int | None = None) -> Table:
@@ -508,6 +510,7 @@ def repartition(env: CylonEnv, table: Table,
 
 
 # -------------------------------------------------------------------- join
+@watchdog.watched("exchange", "dist_join")
 @traced("dist_join")
 def dist_join(env: CylonEnv, left: Table, right: Table, *,
               on=None, left_on=None, right_on=None, how: str = "inner",

@@ -209,7 +209,14 @@ def _check_overflow(out, bad=None) -> None:
                     leaves.append(c.validity)
     if bad is not None:
         leaves.append(bad)
-    jax.device_get(leaves)   # batch; host values now cached per array
+    from cylon_tpu import watchdog
+
+    # batch; host values now cached per array. The one synchronous
+    # device->host wait of a compiled-query call — a wedged chip hangs
+    # exactly here, so it is a bounded watchdog section (never
+    # retryable: re-fetching from a wedged device re-hangs)
+    watchdog.bounded(lambda: jax.device_get(leaves), "overflow_fetch",
+                     detail=f"{len(leaves)} leaves")
     if bad is not None and bool(np.asarray(bad)):
         raise OutOfCapacity(
             "an op inside the compiled query overflowed its "

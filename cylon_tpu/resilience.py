@@ -36,6 +36,7 @@ restarting (see ``outofcore.ooc_sort(resume_dir=...)``).
 import contextlib
 import dataclasses
 import hashlib
+import itertools
 import json
 import os
 import threading
@@ -45,7 +46,8 @@ import numpy as np
 
 from cylon_tpu.config import RetryPolicy
 from cylon_tpu.errors import (Code, CylonError, DataLossError,
-                              InvalidArgument, TransientError)
+                              DeadlineExceeded, InvalidArgument,
+                              TransientError)
 
 __all__ = [
     "INJECTION_POINTS", "FaultRule", "FaultPlan", "install", "active",
@@ -74,13 +76,32 @@ class FaultRule:
     ``reset()`` replays the identical schedule. ``error`` is the
     exception instance (or class) to raise; default is a
     :class:`~cylon_tpu.errors.TransientError` describing the hit —
-    i.e. a simulated preemption the retry engine may absorb."""
+    i.e. a simulated preemption the retry engine may absorb.
+
+    ``delay > 0`` is **delay mode**: a firing hit SLEEPS ``delay``
+    seconds at the fault point instead of raising (pass ``error`` too
+    for a slow *failing* call) — the deterministic way to inject a
+    hang, since a hang never raises and only the watchdog layer
+    (:mod:`cylon_tpu.watchdog`) can see it. Which hits fire follows
+    the same counting/seeded-prob schedule as raising rules, so delay
+    schedules replay exactly too. :meth:`hang` is the documented
+    alias for an effectively-unbounded delay."""
 
     point: str
     nth: int = 1
     times: int = 1
     error: "Exception | type | None" = None
     prob: float = 0.0
+    delay: float = 0.0
+
+    @classmethod
+    def hang(cls, point: str, seconds: float = 3600.0,
+             **kw) -> "FaultRule":
+        """A rule that HANGS at ``point`` (sleeps ``seconds``, default
+        an hour — far past any sane deadline) instead of raising: the
+        injectable twin of a wedged peer or dead mount, detectable
+        only by ``watchdog.deadline`` bounds."""
+        return cls(point, delay=float(seconds), **kw)
 
 
 class FaultPlan:
@@ -106,6 +127,9 @@ class FaultPlan:
                 raise InvalidArgument(f"nth must be >= 1, got {r.nth}")
             if not 0.0 <= r.prob <= 1.0:
                 raise InvalidArgument(f"prob {r.prob} not in [0, 1]")
+            if r.delay < 0:
+                raise InvalidArgument(
+                    f"delay must be >= 0, got {r.delay}")
         self.seed = seed
         self._lock = threading.Lock()
         self.reset()
@@ -128,11 +152,12 @@ class FaultPlan:
         return self._hits[point]
 
     def check(self, point: str, detail: str = "") -> None:
-        """Record one hit of ``point``; raise if any rule fires."""
+        """Record one hit of ``point``; raise — or, for delay-mode
+        rules, sleep — if any rule fires (first matching rule wins)."""
         with self._lock:
             self._hits[point] += 1
             k = self._hits[point]
-            err = None
+            hit = None
             for r in self.rules:
                 if r.point != point:
                     continue
@@ -144,20 +169,30 @@ class FaultPlan:
                 else:
                     hi = None if r.times <= 0 else r.nth + r.times - 1
                     fire = k >= r.nth and (hi is None or k <= hi)
-                if fire and err is None:
+                if fire and hit is None:
                     self._fired.append((point, k, detail))
-                    err = (r.error() if isinstance(r.error, type)
-                           else r.error)
-                    if err is None:
-                        err = TransientError(
-                            f"injected fault at {point!r} (hit {k}"
-                            + (f": {detail}" if detail else "") + ")")
+                    hit = r
+        if hit is None:
+            return
+        if hit.delay > 0:
+            # injected hang: sleep OUTSIDE the plan lock so other
+            # threads' injection points stay live while this one stalls
+            time.sleep(hit.delay)
+        err = hit.error() if isinstance(hit.error, type) else hit.error
+        if err is None and hit.delay == 0:
+            err = TransientError(
+                f"injected fault at {point!r} (hit {k}"
+                + (f": {detail}" if detail else "") + ")")
         if err is not None:
             raise err
 
 
 _LOCK = threading.Lock()
 _ACTIVE: "FaultPlan | None" = None
+
+#: monotonic suffix for per-attempt spill tmp files (see
+#: SpillStore.write_bucket — concurrent attempts must never share one)
+_TMP_SEQ = itertools.count()
 
 
 def install(plan: "FaultPlan | None") -> "FaultPlan | None":
@@ -210,7 +245,13 @@ _RETRYABLE_OS = (ConnectionError, TimeoutError, InterruptedError)
 def is_retryable(exc: BaseException) -> bool:
     """Classification over ``errors.Code``: TransientError and any
     CylonError carrying ``Code.Unavailable`` retry; other CylonErrors
-    never do; transient OS errors (connection/timeout/EINTR) retry."""
+    never do; transient OS errors (connection/timeout/EINTR) retry.
+    DeadlineExceeded defers to its per-section flag
+    (``watchdog.SECTIONS``): bootstrap/spill-IO deadlines retry, a
+    deadline mid-collective never does — the mesh state is
+    unrecoverable."""
+    if isinstance(exc, DeadlineExceeded):
+        return bool(getattr(exc, "retryable", False))
     if isinstance(exc, TransientError):
         return True
     if isinstance(exc, CylonError):
@@ -327,7 +368,11 @@ class SpillStore:
 
     Writes and reads run under :func:`retrying` and hit the
     ``spill_write`` / ``spill_read`` injection points — this is the
-    "out-of-core spill store" the retry engine wraps.
+    "out-of-core spill store" the retry engine wraps. Each attempt is
+    additionally bounded by the ``spill_io`` watchdog section
+    (:func:`cylon_tpu.watchdog.bounded`): under a deadline, a hung
+    mount raises a *retryable* DeadlineExceeded, so the retry engine
+    absorbs IO hangs exactly like raised IO errors.
     """
 
     MANIFEST = "manifest.json"
@@ -346,7 +391,7 @@ class SpillStore:
             # data must never be wiped
             import re
 
-            own = re.compile(r"^bucket\d{5}\.npz(\.tmp)?$")
+            own = re.compile(r"^bucket\d{5}\.npz(\.tmp\S*)?$")
             for f in os.listdir(self.root):
                 if own.match(f) or f in (self.MANIFEST,
                                          self.MANIFEST + ".tmp"):
@@ -387,13 +432,31 @@ class SpillStore:
 
         def _write():
             inject("spill_write", f"bucket {p}")
-            tmp = path + ".tmp"
-            with open(tmp, "wb") as f:
-                np.savez(f, **cols)
-            os.replace(tmp, path)
+            # per-attempt unique tmp: a deadline-abandoned worker may
+            # still be writing ITS tmp when the retry starts — a shared
+            # name would interleave two writers in one inode and
+            # os.replace could install the torn file as a "completed"
+            # bucket. Distinct inodes + atomic replace keep whichever
+            # rename lands last a complete, valid write.
+            tmp = (f"{path}.tmp{os.getpid()}_"
+                   f"{threading.get_ident()}_{next(_TMP_SEQ)}")
+            try:
+                with open(tmp, "wb") as f:
+                    np.savez(f, **cols)
+                os.replace(tmp, path)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
 
         if rows:
-            retrying(_write, self._policy, label=f"spill_write[{p}]")
+            from cylon_tpu import watchdog
+
+            retrying(lambda: watchdog.bounded(
+                _write, "spill_io", detail=f"write bucket {p}"),
+                self._policy, label=f"spill_write[{p}]")
         self._m["completed"][str(int(p))] = int(rows)
         self._write_manifest(self._m)
 
@@ -406,7 +469,11 @@ class SpillStore:
             with np.load(path, allow_pickle=True) as z:
                 return {k: z[k] for k in z.files}
 
-        return retrying(_read, self._policy, label=f"spill_read[{p}]")
+        from cylon_tpu import watchdog
+
+        return retrying(lambda: watchdog.bounded(
+            _read, "spill_io", detail=f"read bucket {p}"),
+            self._policy, label=f"spill_read[{p}]")
 
 
 def fingerprint_arrays(*parts) -> str:

@@ -19,6 +19,9 @@ import jax
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
+import faulthandler
+import sys
+
 import numpy as np
 import pytest
 
@@ -30,6 +33,33 @@ def pytest_configure(config):
         "markers",
         "slow: long-running; excluded from the tier-1 gate "
         "(-m 'not slow')")
+
+
+@pytest.fixture(autouse=True)
+def _hang_diagnostics():
+    """Arm ``faulthandler.dump_traceback_later`` around every test: a
+    future hang in CI produces all-thread stack traces on the REAL
+    stderr fd before the outer ``timeout -k`` kills the run opaquely.
+    CYLON_TEST_HANG_DUMP (seconds, default 300 — well under the 870 s
+    tier-1 budget) tunes it; the per-test cancel keeps slow-but-alive
+    tests from dumping. faulthandler needs a true fd, so this targets
+    ``sys.__stderr__`` (pytest's capture replaces ``sys.stderr`` with
+    a fd-less buffer) and degrades to a no-op where even that has no
+    usable fileno."""
+    timeout = float(os.environ.get("CYLON_TEST_HANG_DUMP", "300"))
+    armed = False
+    try:
+        if timeout > 0 and sys.__stderr__ is not None:
+            faulthandler.dump_traceback_later(
+                timeout, file=sys.__stderr__)
+            armed = True
+    except (ValueError, AttributeError, OSError):
+        pass
+    try:
+        yield
+    finally:
+        if armed:
+            faulthandler.cancel_dump_traceback_later()
 
 
 @pytest.fixture(scope="session")

@@ -478,7 +478,7 @@ def test_tpch_respawn_loop_until_complete(bench_suite_mod, monkeypatch):
     def fake_spawn(flag, extra_env=None):
         spawns.append((flag, (extra_env or {})
                        .get("CYLON_BENCH_TPCH_QUERIES")))
-        return 0, next(script)
+        return 0, next(script), False
 
     monkeypatch.setattr(bench_suite_mod, "_spawn_sentinel", fake_spawn)
     agg = {"tpch_attempted": ["q1"], "tpch_crashed": ["q1"]}
@@ -498,9 +498,45 @@ def test_tpch_respawn_gives_up_without_sentinel(bench_suite_mod,
     """A respawned child dying without a sentinel is a recorded DNF:
     the loop stops and the remaining set stays visible in the agg."""
     monkeypatch.setattr(bench_suite_mod, "_spawn_sentinel",
-                        lambda flag, extra_env=None: (137, None))
+                        lambda flag, extra_env=None: (137, None, False))
     agg: dict = {}
     crash_log: list = []
     bench_suite_mod._tpch_respawn("--tpch", ["q2", "q9"], agg, crash_log)
     assert agg["tpch_skipped"] == ["q2", "q9"]
     assert len(crash_log) == 1 and "rc=137" in crash_log[0]
+
+
+def test_tpch_respawn_timeout_charges_inflight_query(bench_suite_mod,
+                                                     monkeypatch):
+    """A child killed at CYLON_BENCH_SUBPROC_TIMEOUT is a crash, not a
+    harness hang: its per-query checkpoint names what it finished, the
+    in-flight query is charged as crashed, and the loop re-runs the
+    remainder — strict progress even when the child NEVER checkpoints
+    (first query charged)."""
+    script = iter([
+        # child 1: hung mid-q6 (q5 checkpointed), killed at the ceiling
+        (-9, {"tpch_attempted": ["q5"], "tpch_crashed": [],
+              "tpch_skipped": ["q6", "q7"], "tpch_ooc": []}, True),
+        # child 2: finishes the remainder
+        (0, {"tpch_attempted": ["q7"], "tpch_crashed": [],
+             "tpch_skipped": [], "tpch_ooc": []}, False),
+    ])
+    monkeypatch.setattr(bench_suite_mod, "_spawn_sentinel",
+                        lambda flag, extra_env=None: next(script))
+    agg: dict = {}
+    crash_log: list = []
+    bench_suite_mod._tpch_respawn("--tpch", ["q5", "q6", "q7"], agg,
+                                  crash_log)
+    assert agg["tpch_attempted"] == ["q5", "q6", "q7"]
+    assert agg["tpch_crashed"] == ["q6"]
+    assert agg["tpch_skipped"] == []
+    assert len(crash_log) == 1 and "timed out" in crash_log[0]
+    # a hung child with NO checkpoint still makes progress: the first
+    # query of its set is the victim
+    monkeypatch.setattr(
+        bench_suite_mod, "_spawn_sentinel",
+        lambda flag, extra_env=None: (-9, None, True))
+    agg2: dict = {}
+    bench_suite_mod._tpch_respawn("--tpch", ["q2", "q9"], agg2, [])
+    assert "q2" in agg2["tpch_crashed"] and "q9" in agg2["tpch_crashed"]
+    assert agg2["tpch_skipped"] == []

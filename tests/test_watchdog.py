@@ -1,0 +1,404 @@
+"""Deadline & watchdog layer (ISSUE 2 acceptance bar): deadline expiry
+raises DeadlineExceeded naming the section AFTER the watchdog dumped
+all-thread stacks to stderr; an injected ``delay`` fault at the
+``exchange`` point under a millisecond deadline is detected and
+stack-dumped within threshold; retryable classification is
+per-section; nested deadline scopes take the tighter bound; and the
+no-deadline fast path spawns neither a monitor nor worker threads.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cylon_tpu import resilience, watchdog
+from cylon_tpu.config import DeadlinePolicy
+from cylon_tpu.errors import (Code, DeadlineExceeded, InvalidArgument,
+                              TransientError)
+from cylon_tpu.resilience import FaultPlan, FaultRule, is_retryable
+from cylon_tpu.watchdog import bounded, check, deadline, watched_section
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    """No leaked fault plans or timing history between tests."""
+    yield
+    resilience.install(None)
+    watchdog.clear_timings()
+
+
+# ------------------------------------------------------- deadline scopes
+def test_deadline_scope_remaining_and_exit():
+    assert watchdog.active_deadline() is None
+    assert watchdog.remaining() is None
+    with deadline(5.0):
+        r = watchdog.remaining()
+        assert r is not None and 4.0 < r <= 5.0
+    assert watchdog.active_deadline() is None
+
+
+def test_nested_deadline_inner_tighter_wins():
+    with deadline(10.0):
+        with deadline(0.05):
+            assert watchdog.remaining() <= 0.05
+            with pytest.raises(DeadlineExceeded):
+                bounded(lambda: time.sleep(1.0), "barrier")
+        # back in the outer scope: plenty of budget again
+        assert watchdog.remaining() > 5.0
+
+
+def test_nested_deadline_inner_cannot_extend_outer():
+    with deadline(0.04):
+        with deadline(60.0):
+            # the looser inner scope must NOT extend the outer budget
+            assert watchdog.remaining() <= 0.04
+
+
+# ----------------------------------------------- bounded: raise + dump
+def test_expiry_raises_named_section_after_stack_dump(capsys):
+    with deadline(0.05):
+        with pytest.raises(DeadlineExceeded) as ei:
+            bounded(lambda: time.sleep(3.0), "barrier",
+                    detail="test drain")
+    e = ei.value
+    assert e.section == "barrier"
+    assert "'barrier'" in str(e) and "test drain" in str(e)
+    assert e.code == Code.DeadlineExceeded
+    assert e.elapsed is not None and e.elapsed >= 0.04
+    err = capsys.readouterr().err
+    # all-thread stacks hit stderr BEFORE the raise, with the section
+    # label and elapsed time in the header
+    assert "cylon_tpu watchdog" in err and "'barrier'" in err
+    assert "stalled" in err and "--- thread" in err
+    assert "test drain" in err
+
+
+def test_bounded_returns_result_and_propagates_errors():
+    with deadline(5.0):
+        assert bounded(lambda: 42, "barrier") == 42
+        with pytest.raises(ZeroDivisionError):
+            bounded(lambda: 1 // 0, "barrier")
+
+
+def test_bounded_explicit_timeout_without_scope():
+    t0 = time.monotonic()
+    with pytest.raises(DeadlineExceeded) as ei:
+        bounded(lambda: time.sleep(3.0), "spill_io", timeout=0.05)
+    assert time.monotonic() - t0 < 2.0  # unblocked promptly, not at 3 s
+    assert ei.value.section == "spill_io"
+
+
+def test_bounded_already_expired_scope_raises_immediately():
+    with deadline(0.0):
+        with pytest.raises(DeadlineExceeded):
+            bounded(lambda: 1, "overflow_fetch")
+
+
+def test_unknown_section_rejected():
+    with pytest.raises(InvalidArgument):
+        bounded(lambda: 1, "no_such_section")
+
+
+# ------------------------------------------------------------ fast path
+def test_no_deadline_fast_path_is_inline_and_unmonitored(monkeypatch):
+    """Zero overhead without a scope: fn runs on the CALLING thread and
+    nothing is ever registered with the monitor (so no monitor thread
+    can start on its behalf)."""
+    def _boom(rec):
+        raise AssertionError("fast path must not touch the monitor")
+
+    monkeypatch.setattr(watchdog._MONITOR, "register", _boom)
+    seen = {}
+
+    def fn():
+        seen["tid"] = threading.get_ident()
+        return 7
+
+    assert bounded(fn, "barrier") == 7
+    assert seen["tid"] == threading.get_ident()  # no worker thread
+
+
+def test_monitor_thread_never_starts_without_scope():
+    """Acceptance bar, demonstrated end to end in a FRESH process: a
+    run that exercises bounded sections (barrier, a fault-free spill
+    write) without any deadline scope never starts the monitor
+    thread."""
+    import subprocess
+    import sys
+    import tempfile
+
+    code = (
+        "import os, threading, tempfile\n"
+        "os.environ.setdefault('JAX_PLATFORMS', 'cpu')\n"
+        "import numpy as np\n"
+        "from cylon_tpu import CylonEnv, LocalConfig, watchdog\n"
+        "from cylon_tpu.resilience import SpillStore\n"
+        "env = CylonEnv(LocalConfig(), distributed=False)\n"
+        "env.barrier()\n"
+        "with tempfile.TemporaryDirectory() as d:\n"
+        "    SpillStore(d, 'fp').write_bucket(0, {'a': np.arange(3)}, 3)\n"
+        "assert watchdog._MONITOR.thread is None, 'monitor started!'\n"
+        "assert not any(t.name == 'cylon-tpu-watchdog'\n"
+        "               for t in threading.enumerate())\n"
+        "print('FAST_PATH_CLEAN')\n"
+    )
+    out = subprocess.run([sys.executable, "-c", code],
+                         capture_output=True, text=True, timeout=180)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "FAST_PATH_CLEAN" in out.stdout
+
+
+def test_env_default_bounds_section_without_scope(monkeypatch):
+    monkeypatch.setenv("CYLON_TPU_DEADLINE_BARRIER", "0.05")
+    with pytest.raises(DeadlineExceeded) as ei:
+        bounded(lambda: time.sleep(3.0), "barrier")
+    assert ei.value.section == "barrier"
+    # <= 0 clears back to unbounded
+    monkeypatch.setenv("CYLON_TPU_DEADLINE_BARRIER", "0")
+    assert bounded(lambda: 5, "barrier") == 5
+    monkeypatch.setenv("CYLON_TPU_DEADLINE_BARRIER", "nope")
+    with pytest.raises(InvalidArgument):
+        bounded(lambda: 5, "barrier")
+
+
+# ------------------------------------------------- retry classification
+def test_retryable_classification_per_section():
+    """bootstrap/spill-IO deadlines retry (peer may rejoin, mount may
+    recover); mid-collective ones never (mesh state unrecoverable)."""
+    verdicts = {}
+    for section in watchdog.SECTIONS:
+        with pytest.raises(DeadlineExceeded) as ei:
+            with deadline(0.02):
+                bounded(lambda: time.sleep(0.5), section)
+        verdicts[section] = is_retryable(ei.value)
+    assert verdicts == {"barrier": False, "bootstrap": True,
+                        "overflow_fetch": False, "spill_io": True,
+                        "ooc_pass": False, "exchange": False}
+
+
+def test_retrying_absorbs_retryable_deadline():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] == 1:
+            return bounded(lambda: time.sleep(1.0), "bootstrap",
+                           timeout=0.02)
+        return "joined"
+
+    assert resilience.retrying(flaky, sleep_fn=lambda d: None) == "joined"
+    assert calls["n"] == 2
+
+
+# ------------------------------------------------- fault-injected hangs
+def test_fault_rule_delay_mode_sleeps_instead_of_raising():
+    plan = FaultPlan([FaultRule("exchange", nth=2, delay=0.08)])
+    with resilience.active(plan):
+        t0 = time.monotonic()
+        resilience.inject("exchange")          # hit 1: clean
+        assert time.monotonic() - t0 < 0.05
+        resilience.inject("exchange")          # hit 2: sleeps, no raise
+        assert time.monotonic() - t0 >= 0.08
+        resilience.inject("exchange")          # hit 3: clean again
+    assert [f[:2] for f in plan.fired] == [("exchange", 2)]
+
+
+def test_fault_rule_delay_plus_error_is_slow_failure():
+    plan = FaultPlan([FaultRule("io_read", delay=0.05,
+                                error=TransientError("slow death"))])
+    t0 = time.monotonic()
+    with pytest.raises(TransientError, match="slow death"):
+        plan.check("io_read")
+    assert time.monotonic() - t0 >= 0.05
+
+
+def test_hang_alias_and_validation():
+    r = FaultRule.hang("exchange")
+    assert r.delay == 3600.0 and r.point == "exchange"
+    assert FaultRule.hang("worker", seconds=0.25).delay == 0.25
+    with pytest.raises(InvalidArgument):
+        FaultPlan([FaultRule("exchange", delay=-1.0)])
+
+
+def test_injected_exchange_hang_detected_and_dumped(env8, rng, capsys):
+    """THE acceptance scenario: a delay fault at the ``exchange`` point
+    under a 50 ms deadline raises DeadlineExceeded naming the section,
+    after the watchdog dumped all-thread stacks to stderr — and the
+    dump landed while the hang was still in progress (within
+    threshold), not post-hoc."""
+    from cylon_tpu import Table
+    from cylon_tpu.parallel import shuffle
+
+    t = Table.from_pydict({"k": rng.integers(0, 50, 64)
+                           .astype(np.int64)})
+    plan = FaultPlan([FaultRule.hang("exchange", seconds=0.4)])
+    with resilience.active(plan):
+        with pytest.raises(DeadlineExceeded) as ei:
+            with deadline(0.05):
+                shuffle(env8, t, ["k"])
+    assert ei.value.section == "exchange"
+    assert "'exchange'" in str(ei.value)
+    assert plan.fired and plan.fired[0][0] == "exchange"
+    err = capsys.readouterr().err
+    assert "cylon_tpu watchdog" in err and "'exchange'" in err
+    assert "--- thread" in err
+    rec = watchdog.timings("exchange")[-1]
+    assert rec.expired
+    # dumped while the 0.4 s injected hang was still sleeping
+    assert rec.dump_after is not None and rec.dump_after < 0.4
+
+
+# ------------------------------------------------ cooperative sections
+def test_check_raises_promptly_between_chunks():
+    with deadline(0.02):
+        time.sleep(0.05)
+        with pytest.raises(DeadlineExceeded) as ei:
+            check("ooc_pass", "chunk 3")
+    assert ei.value.section == "ooc_pass"
+    assert "chunk 3" in str(ei.value)
+    check("ooc_pass")  # no scope: no-op
+
+
+def test_ooc_pass_deadline_raises_between_chunks():
+    from cylon_tpu.outofcore import ooc_sort
+
+    src = {"k": np.arange(4096, dtype=np.int64)}
+    plan = FaultPlan([FaultRule.hang("chunk_source", seconds=0.1)])
+    with resilience.active(plan):
+        with deadline(0.05):
+            with pytest.raises(DeadlineExceeded) as ei:
+                ooc_sort(src, "k", n_partitions=2, chunk_rows=256)
+    assert ei.value.section == "ooc_pass"
+
+
+def test_watched_section_late_raise_chains_body_error():
+    """A region that broke AFTER blowing its deadline reports the
+    deadline (the operative failure) with the body error chained."""
+    with pytest.raises(DeadlineExceeded) as ei:
+        with deadline(0.01):
+            with watched_section("exchange", detail="wedge"):
+                time.sleep(0.05)
+                raise RuntimeError("collective fell apart")
+    assert isinstance(ei.value.__cause__, RuntimeError)
+    # ... but inside the budget, the body error propagates untouched
+    with pytest.raises(RuntimeError):
+        with deadline(10.0):
+            with watched_section("exchange"):
+                raise RuntimeError("real bug")
+
+
+# -------------------------------------------------- barrier & spill io
+def test_barrier_timeout_argument(env1):
+    env1.barrier()               # default: unbounded, works as before
+    env1.barrier(timeout=30.0)   # bounded, completes well inside
+    with pytest.raises(DeadlineExceeded) as ei:
+        with deadline(0.0):      # pre-expired scope: prompt raise
+            env1.barrier()
+    assert ei.value.section == "barrier"
+
+
+def test_spill_io_deadline_with_injected_hang(tmp_path):
+    """SpillStore bucket IO is a bounded ``spill_io`` section: an
+    injected hang at the spill_write point that blows the budget
+    MID-CALL raises a RETRYABLE DeadlineExceeded (the failure domain
+    the retry engine already wraps)."""
+    plan = FaultPlan([FaultRule("spill_write", nth=1, delay=0.3)])
+    # single-attempt policy: the first (retryable) failure surfaces raw
+    store = resilience.SpillStore(
+        str(tmp_path / "a"), fingerprint="fp",
+        policy=resilience.RetryPolicy(max_attempts=1))
+    with resilience.active(plan):
+        with deadline(0.05):
+            with pytest.raises(DeadlineExceeded) as ei:
+                store.write_bucket(0, {"a": np.arange(3)}, 3)
+    assert ei.value.section == "spill_io"
+    assert ei.value.retryable and is_retryable(ei.value)
+
+
+def test_spill_io_env_budget_retry_absorbs_hang(tmp_path, monkeypatch):
+    """With a per-attempt env budget (not an absolute scope), the
+    retry engine absorbs an injected spill_read hang end to end:
+    attempt 1 hangs and expires, attempt 2 has a fresh budget and no
+    fault left, and the read returns the bucket."""
+    store = resilience.SpillStore(str(tmp_path / "b"),
+                                  fingerprint="fp")
+    store.write_bucket(0, {"a": np.arange(4)}, 4)
+    monkeypatch.setenv("CYLON_TPU_DEADLINE_SPILL_IO", "0.05")
+    plan = FaultPlan([FaultRule("spill_read", nth=1, delay=0.3)])
+    with resilience.active(plan):
+        out = store.read_bucket(0)
+    assert list(out["a"]) == [0, 1, 2, 3]
+    assert any(r.expired for r in watchdog.timings("spill_io"))
+
+
+def test_expired_scope_on_entry_is_not_retryable():
+    """An attempt that starts with the ambient scope already expired
+    gets zero budget — retrying cannot help, so it is classified
+    non-retryable regardless of section (and still recorded)."""
+    watchdog.clear_timings()
+    with deadline(0.0):
+        with pytest.raises(DeadlineExceeded) as ei:
+            bounded(lambda: 1, "bootstrap")
+    assert not ei.value.retryable and not is_retryable(ei.value)
+    recs = watchdog.timings("bootstrap")
+    assert recs and recs[-1].expired
+
+
+# ------------------------------------------------- timings & stragglers
+def test_timing_records_and_straggler_report():
+    watchdog.clear_timings()
+    with deadline(5.0):
+        bounded(lambda: time.sleep(0.01), "overflow_fetch",
+                detail="8 leaves")
+    with watched_section("exchange", detail="shuffle"):
+        time.sleep(0.005)
+    recs = watchdog.timings()
+    assert {r.section for r in recs} >= {"overflow_fetch", "exchange"}
+    of = watchdog.timings("overflow_fetch")[-1]
+    assert of.elapsed >= 0.01 and not of.expired and of.budget <= 5.0
+    rep = watchdog.straggler_report()
+    assert rep["overflow_fetch"]["count"] == 1
+    assert rep["exchange"]["expired"] == 0
+    assert rep["exchange"]["max_s"] >= 0.005
+
+
+def test_active_sections_visible_while_blocked():
+    seen = {}
+
+    def peek():
+        # runs on the bounded worker: the section is live right now
+        seen["live"] = watchdog.active_sections()
+        return 1
+
+    with deadline(5.0):
+        bounded(peek, "barrier", detail="introspect")
+    assert any(s == "barrier" and d == "introspect"
+               for s, d, _ in seen["live"])
+
+
+# ------------------------------------------------------- policy knobs
+def test_default_policy_env_overrides(monkeypatch):
+    p = watchdog.default_deadline_policy()
+    assert p == DeadlinePolicy()
+    monkeypatch.setenv("CYLON_TPU_WATCHDOG_POLL", "0.01")
+    monkeypatch.setenv("CYLON_TPU_DEADLINE_ACTION", "abort")
+    monkeypatch.setenv("CYLON_TPU_DEADLINE_DUMP", "0")
+    p = watchdog.default_deadline_policy()
+    assert (p.poll_interval, p.action, p.dump_stacks) == \
+        (0.01, "abort", False)
+
+
+def test_abort_policy_exits_process(monkeypatch):
+    """action="abort": after dumping, the watchdog kills the process
+    (os._exit(70)) — the only honest policy for a wedged collective no
+    raise can unwind. os._exit is recorded, not executed, here."""
+    exits = []
+    monkeypatch.setattr(watchdog.os, "_exit",
+                        lambda code: exits.append(code))
+    monkeypatch.setenv("CYLON_TPU_DEADLINE_ACTION", "abort")
+    with pytest.raises(DeadlineExceeded):
+        with deadline(0.02):
+            bounded(lambda: time.sleep(0.3), "barrier")
+    assert exits == [70]
