@@ -43,11 +43,34 @@ def _timeit(fn, sync, reps):
     return min(ts)
 
 
+def _metrics_block():
+    """Registry snapshot block embedded in EVERY bench record: byte /
+    overflow / retry / padding context rides the perf trajectory, not
+    just wall time (the required keys are pinned by
+    ``tests/test_bench_guard.py`` so a future PR cannot silently drop
+    them). Failure-proof: a bench must never die on telemetry."""
+    try:
+        from cylon_tpu import telemetry
+
+        return telemetry.bench_metrics()
+    except Exception as e:  # pragma: no cover - import-time breakage
+        return {"telemetry_error": f"{type(e).__name__}: {e}"}
+
+
+def _emit_record(line: dict):
+    """The ONE stdout sink for bench JSON records — every record gets
+    the telemetry ``metrics`` block attached here (the bench guard
+    lints that no other call site prints ``json.dumps`` directly)."""
+    line = dict(line)
+    line["metrics"] = _metrics_block()
+    print(json.dumps(line))
+
+
 def _emit(metric, value, unit, baseline=None):
     line = {"metric": metric, "value": round(value, 1), "unit": unit}
     if baseline:
         line["vs_baseline"] = round(value / baseline, 3)
-    print(json.dumps(line))
+    _emit_record(line)
 
 
 def _subproc_timeout():
@@ -161,13 +184,13 @@ def main():
             # recorded DNF with NAMES: queries no respawn ever reached
             # (each process already emitted its own ooc_dropped lines
             # for lost out-of-core completions — no re-report here)
-            print(json.dumps({"metric": f"tpch_sf{sf}_never_attempted",
-                              "value": len(agg["tpch_skipped"]),
-                              "unit": "queries",
-                              "queries": agg["tpch_skipped"]}))
+            _emit_record({"metric": f"tpch_sf{sf}_never_attempted",
+                          "value": len(agg["tpch_skipped"]),
+                          "unit": "queries",
+                          "queries": agg["tpch_skipped"]})
         for msg in crash_log:
-            print(json.dumps({"metric": "tpch_respawn_failure",
-                              "detail": msg}))
+            _emit_record({"metric": "tpch_respawn_failure",
+                          "detail": msg})
 
     # 6. TPU ragged exchange: the flagship lax.ragged_all_to_all path,
     # runtime-proven on the real chip (W=1 mesh still compiles and

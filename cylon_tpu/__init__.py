@@ -80,6 +80,7 @@ from cylon_tpu.errors import (
     TypeError_,
 )
 from cylon_tpu.config import DeadlinePolicy, RetryPolicy
+from cylon_tpu import telemetry
 from cylon_tpu.resilience import FaultPlan, FaultRule
 from cylon_tpu.watchdog import deadline
 from cylon_tpu.table import Table
@@ -131,5 +132,6 @@ __all__ = [
     "read_csv_chunks",
     "read_csv_sharded",
     "read_parquet_chunks",
+    "telemetry",
     "write_csv_sharded",
 ]

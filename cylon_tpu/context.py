@@ -165,8 +165,10 @@ class CylonEnv:
             # attempt can recognise their delayed success (see the
             # "only be called once" branch in _bootstrap).
             def _attempt():
+                from cylon_tpu import telemetry
                 from cylon_tpu.errors import DeadlineExceeded
 
+                telemetry.counter("bootstrap.attempts").inc()
                 try:
                     return watchdog.bounded(
                         _bootstrap, "bootstrap",
@@ -340,15 +342,16 @@ class CylonEnv:
         ``CYLON_TPU_DEADLINE_BARRIER`` is active."""
         import jax.numpy as jnp
 
-        from cylon_tpu import watchdog
+        from cylon_tpu import telemetry, watchdog
 
         def _drain():
             x = jax.device_put(jnp.zeros(self.world_size, jnp.int32),
                                self.row_sharding)
             jax.block_until_ready(jax.jit(lambda v: v.sum())(x))
 
-        watchdog.bounded(_drain, "barrier", timeout=timeout,
-                         detail=f"world={self.world_size}")
+        with telemetry.timer("barrier.wait_seconds").time():
+            watchdog.bounded(_drain, "barrier", timeout=timeout,
+                             detail=f"world={self.world_size}")
 
     def finalize(self):
         self._finalized = True

@@ -30,7 +30,7 @@ from typing import Callable, Iterable, Mapping, Sequence
 
 import numpy as np
 
-from cylon_tpu import resilience, watchdog
+from cylon_tpu import resilience, telemetry, watchdog
 from cylon_tpu.errors import DataLossError, InvalidArgument
 
 __all__ = ["host_partition_chunks", "ooc_join", "ooc_groupby", "ooc_sort"]
@@ -94,6 +94,7 @@ def _as_chunks(src, chunk_rows: int):
         for lo in range(0, n, chunk_rows):
             watchdog.check("ooc_pass", "chunk source")
             resilience.inject("chunk_source")
+            telemetry.counter("ooc.chunks").inc()
             yield {k: np.asarray(v)[lo:lo + chunk_rows]
                    for k, v in src.items()}
         return
@@ -103,6 +104,7 @@ def _as_chunks(src, chunk_rows: int):
         # section around the whole pass only raises on exit)
         watchdog.check("ooc_pass", "chunk source")
         resilience.inject("chunk_source")
+        telemetry.counter("ooc.chunks").inc()
         if isinstance(c, Table):
             # to_pandas decodes dictionary columns to values — codes
             # are TABLE-LOCAL and must not cross the host spill raw
@@ -187,6 +189,7 @@ def ooc_join(left, right, on, how: str = "inner",
                 f"ooc_join partition {p}: output exceeds {cap} rows — "
                 "raise n_partitions")
         total += nrows
+        telemetry.counter("ooc.rows_out", op="join").inc(nrows)
         if sink is not None:
             sink(res.to_pandas())
         del res, lt, rt
@@ -457,6 +460,11 @@ def ooc_sort(src, by, n_partitions: int = 8, chunk_rows: int = 1 << 22,
 
                 sink(pd.DataFrame(store.read_bucket(p)))
             total += n
+            telemetry.counter("ooc.buckets_resumed").inc()
+            # replayed rows count toward rows_out too: a resumed run
+            # produces identical output to a clean one, and must not
+            # read as a row deficit on any dashboard
+            telemetry.counter("ooc.rows_out", op="sort").inc(n)
             parts[p] = None
             continue
         if n == 0:
@@ -470,6 +478,7 @@ def ooc_sort(src, by, n_partitions: int = 8, chunk_rows: int = 1 << 22,
             store.write_bucket(
                 p, {c: pdf[c].to_numpy() for c in pdf.columns}, n)
         total += n
+        telemetry.counter("ooc.rows_out", op="sort").inc(n)
         if sink is not None:
             sink(pdf)
         del res, t, full, pdf

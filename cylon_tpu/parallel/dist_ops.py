@@ -36,7 +36,9 @@ from cylon_tpu.ops.selection import (sort_key_operands as _sort_key_ops,
                                      sort_table as _sort_table)
 from cylon_tpu.ops.dictenc import unify_table_dictionaries
 from cylon_tpu.parallel import dtable
-from cylon_tpu.parallel.shuffle import checked_recv, poison, shuffle_local
+from cylon_tpu.parallel.shuffle import (checked_recv, poison,
+                                        shuffle_local, transport_words,
+                                        wire_rows_per_shard)
 from cylon_tpu.table import Table
 from cylon_tpu.utils.tracing import traced
 
@@ -228,12 +230,16 @@ def _adaptive(build, args, adaptive: bool, conserve: str | None = None):
                     f"input shard row counts {tc.tolist()} exceed its "
                     f"capacity — an upstream op overflowed an explicit "
                     f"out_capacity")
+        from cylon_tpu import telemetry
+
+        telemetry.counter("plan.overflow_events", site="dist").inc()
         if scale >= plan.MAX_SCALE:
             raise OutOfCapacity(
                 f"shard row counts {counts.tolist()} still exceed local "
                 f"capacity {cap_l} at {scale}x the default budget; pass "
                 f"an explicit out_capacity")
         scale *= 2
+        telemetry.counter("plan.capacity_rescales", site="dist").inc()
 
 
 def _normalize_join_keys(on, left_on, right_on):
@@ -271,6 +277,9 @@ def _probe_memo(table: Table, kind: str, key_cols, partitioning: str,
     key = (kind, tuple(key_cols), partitioning, token)
     if key not in memo:
         PROBE_STATS[kind] += 1
+        from cylon_tpu import telemetry
+
+        telemetry.counter("exchange.probes", kind=kind).inc()
         memo[key] = compute()
     return memo[key]
 
@@ -351,6 +360,88 @@ def _probe_hier_mid(env: CylonEnv, table: Table, key_cols,
     return pow2_bucket(mx)
 
 
+def _note_exchange(env: CylonEnv, op: str, tables,
+                   bucket_cap: "int | None" = None,
+                   synced: bool = True) -> None:
+    """Telemetry for one EAGER exchange dispatch.
+
+    Records true payload bytes (valid rows x the packed u32 word
+    width), padded wire bytes (the fixed all-to-all blocks the padded
+    path ships — :func:`cylon_tpu.parallel.shuffle.wire_rows_per_shard`;
+    equal to true bytes on the ragged path, which DMAs exactly what is
+    needed), the path taken (ragged / padded / hier, as ``path=``
+    label on ``exchange.calls``) and the ``exchange.pad_ratio`` gauge.
+
+    Sync policy: true rows come from the per-instance count memo when
+    one exists (free); a fresh fetch happens only when ``synced`` —
+    the dispatch was adaptive, i.e. it already tolerates host syncs —
+    AND row accounting is enabled. All missing memos fill through ONE
+    batched ``device_get`` (not one RPC per table) and later
+    exchanges of the same table instances pay nothing. Explicit-capacity
+    dispatches (the documented no-sync latency escape hatch) and
+    ``CYLON_TPU_ROW_ACCOUNTING=0`` never add a round trip:
+    ``exchange.bytes_true`` simply stays 0 there and only the static
+    padded-wire pricing is recorded. Skipped entirely under an outer
+    trace (whole-query compilation — counts are tracers). The
+    hierarchical padded estimate prices both stages at the input
+    capacity (the stage-1 pid rider column and the probed mid capacity
+    are ignored), and ``dist_groupby``'s decomposable path exchanges
+    pre-combined partials (at most one row per group per sender) while
+    the pricing uses the input rows — both upper-bound approximations.
+    """
+    for t in tables:
+        if isinstance(t.nrows, jax.core.Tracer):
+            return
+    from cylon_tpu import telemetry
+
+    w = env.world_size
+    padded = _padded_exchange(env)
+    path = ("hier" if env.is_hierarchical
+            else "padded" if padded else "ragged")
+    if resilience.accounting_enabled() and synced:
+        pending = [t for t in tables
+                   if "_host_counts_memo" not in t.__dict__
+                   and getattr(t.nrows, "is_fully_addressable", True)]
+        if pending:
+            # ONE batched device_get fills every missing memo: the
+            # pricing fetch costs one RPC per dispatch at most, not
+            # one per table, and repeat exchanges of the same table
+            # instances cost nothing
+            for t, c in zip(pending, jax.device_get(
+                    [t.nrows for t in pending])):
+                t.__dict__["_host_counts_memo"] = np.asarray(c)
+    rows = true_b = pad_b = 0
+    for t in tables:
+        words = transport_words(t)
+        cap_l = _shard_cap(t)
+        r = 0
+        if resilience.accounting_enabled():
+            memo = t.__dict__.get("_host_counts_memo")
+            if memo is not None:
+                r = int(np.minimum(memo, cap_l).sum())
+            elif synced:
+                r = int(np.minimum(_counts_memo(t), cap_l).sum())
+        rows += r
+        true_b += r * words * 4
+        if padded:
+            if env.is_hierarchical:
+                per = (wire_rows_per_shard(env.devices_per_slice,
+                                           cap_l)
+                       + wire_rows_per_shard(env.n_slices, cap_l))
+            else:
+                per = wire_rows_per_shard(w, cap_l, bucket_cap)
+            pad_b += w * per * words * 4
+        else:
+            pad_b += r * words * 4
+    telemetry.counter("exchange.calls", op=op, path=path).inc()
+    telemetry.counter("exchange.rows", op=op).inc(rows)
+    telemetry.counter("exchange.bytes_true", op=op).inc(true_b)
+    telemetry.counter("exchange.bytes_padded", op=op).inc(pad_b)
+    if true_b:
+        telemetry.gauge("exchange.pad_ratio",
+                        op=op).set(pad_b / true_b)
+
+
 def _padded_exchange(env: CylonEnv) -> bool:
     """Will ``exchange_arrays`` take the padded (non-ragged) path on
     this env's mesh? Mirrors ``shuffle._use_ragged`` incl. the
@@ -426,8 +517,11 @@ def shuffle(env: CylonEnv, table: Table, key_cols: Sequence[str],
 
         return _smap(env, body, 1)
 
-    return _adaptive(build, (table,), out_capacity is None,
-                     conserve="shuffle")
+    out = _adaptive(build, (table,), out_capacity is None,
+                    conserve="shuffle")
+    _note_exchange(env, "shuffle", (table,), bucket_cap,
+                   synced=out_capacity is None)
+    return out
 
 
 @traced("dist_filter")
@@ -505,8 +599,11 @@ def repartition(env: CylonEnv, table: Table,
 
         return _smap(env, body, 1)
 
-    return _adaptive(build, (table,), out_capacity is None,
-                     conserve="repartition")
+    out = _adaptive(build, (table,), out_capacity is None,
+                    conserve="repartition")
+    _note_exchange(env, "repartition", (table,),
+                   synced=out_capacity is None)
+    return out
 
 
 # -------------------------------------------------------------------- join
@@ -592,8 +689,12 @@ def dist_join(env: CylonEnv, left: Table, right: Table, *,
 
         return _smap(env, body, 2)
 
-    return _adaptive(build, (left, right),
-                     out_capacity is None and shuffle_capacity is None)
+    out = _adaptive(build, (left, right),
+                    out_capacity is None and shuffle_capacity is None)
+    _note_exchange(env, "dist_join", (left, right),
+                   synced=out_capacity is None
+                   and shuffle_capacity is None)
+    return out
 
 
 # ----------------------------------------------------------------- groupby
@@ -644,7 +745,10 @@ def dist_groupby(env: CylonEnv, table: Table, by: Sequence[str],
 
             return _smap(env, body, 1)
 
-        return _adaptive(build, (table,), adaptive)
+        out = _adaptive(build, (table,), adaptive)
+        _note_exchange(env, "dist_groupby", (table,),
+                       synced=adaptive)
+        return out
 
     # pre-combine plan: user agg -> partial columns + final merge + post
     pre, final, post = _combine_plan(aggs)
@@ -674,7 +778,9 @@ def dist_groupby(env: CylonEnv, table: Table, by: Sequence[str],
 
         return _smap(env, body, 1)
 
-    return _adaptive(build, (table,), adaptive)
+    out = _adaptive(build, (table,), adaptive)
+    _note_exchange(env, "dist_groupby", (table,), synced=adaptive)
+    return out
 
 
 def _combine_plan(aggs):
@@ -777,7 +883,10 @@ def dist_sort(env: CylonEnv, table: Table, by: Sequence[str] | str,
         return _smap(env, _sort_body(env, table, by, asc0, asc, nsamp,
                                      nbins, out_l, w), 1)
 
-    return _adaptive(build, (table,), out_capacity is None)
+    out = _adaptive(build, (table,), out_capacity is None)
+    _note_exchange(env, "dist_sort", (table,),
+                   synced=out_capacity is None)
+    return out
 
 
 def _sort_body(env, table, by, asc0, asc, nsamp, nbins, out_l, w):
@@ -904,7 +1013,8 @@ def _sort_body(env, table, by, asc0, asc, nsamp, nbins, out_l, w):
 
 
 # ----------------------------------------------------------------- set ops
-def _dist_setop(env, a, b, local_op, out_capacity):
+def _dist_setop(env, a, b, local_op, out_capacity,
+                opname: str = "dist_setop"):
     from cylon_tpu.ops.bytescol import align_table_strings
 
     a = _prep(env, a)
@@ -936,7 +1046,9 @@ def _dist_setop(env, a, b, local_op, out_capacity):
 
         return _smap(env, body, 2)
 
-    return _adaptive(build, (a, b), out_capacity is None)
+    out = _adaptive(build, (a, b), out_capacity is None)
+    _note_exchange(env, opname, (a, b), synced=out_capacity is None)
+    return out
 
 
 @traced("dist_union")
@@ -945,7 +1057,7 @@ def dist_union(env: CylonEnv, a: Table, b: Table,
     """Parity: ``DistributedUnion`` (table.cpp:724-748)."""
     return _dist_setop(env, a, b,
                        lambda x, y, oc: _setops.union(x, y, oc),
-                       out_capacity)
+                       out_capacity, opname="dist_union")
 
 
 @traced("dist_intersect")
@@ -954,7 +1066,7 @@ def dist_intersect(env: CylonEnv, a: Table, b: Table,
     """Parity: ``DistributedIntersect``."""
     return _dist_setop(env, a, b,
                        lambda x, y, oc: _setops.intersect(x, y, oc),
-                       out_capacity)
+                       out_capacity, opname="dist_intersect")
 
 
 @traced("dist_subtract")
@@ -963,7 +1075,7 @@ def dist_subtract(env: CylonEnv, a: Table, b: Table,
     """Parity: ``DistributedSubtract``."""
     return _dist_setop(env, a, b,
                        lambda x, y, oc: _setops.subtract(x, y, oc),
-                       out_capacity)
+                       out_capacity, opname="dist_subtract")
 
 
 @traced("dist_unique")
@@ -992,7 +1104,10 @@ def dist_unique(env: CylonEnv, table: Table,
 
         return _smap(env, body, 1)
 
-    return _adaptive(build, (table,), out_capacity is None)
+    out = _adaptive(build, (table,), out_capacity is None)
+    _note_exchange(env, "dist_unique", (table,),
+                   synced=out_capacity is None)
+    return out
 
 
 # ------------------------------------------------- co-located (no-shuffle)

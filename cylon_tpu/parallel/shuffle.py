@@ -194,6 +194,48 @@ def exchange_arrays(arrays, pid, n_local, out_cap: int,
     return outs, n_recv.astype(jnp.int32)
 
 
+def transport_words(table) -> int:
+    """Static u32 words per row the exchange moves for ``table`` —
+    mirrors the :func:`_pack_words` widths (2D bytes columns ride
+    their word matrices, 64-bit values split into two words, everything
+    else one word, plus one word per validity lane). Host-side
+    metadata only: telemetry prices an exchange with it without
+    touching device data (``exchange.bytes_true`` /
+    ``exchange.bytes_padded`` in ``cylon_tpu.parallel.dist_ops``)."""
+    n = 0
+    for c in table.columns.values():
+        d = c.data
+        if getattr(d, "ndim", 1) == 2:
+            n += int(d.shape[1])
+        elif d.dtype.itemsize == 8:
+            n += 2
+        else:
+            n += 1
+        if c.validity is not None:
+            n += 1
+    return n
+
+
+def wire_rows_per_shard(w: int, cap: int,
+                        bucket_cap: "int | None" = None) -> int:
+    """Padded-path wire volume: rows of all-to-all payload ONE shard
+    ships per exchange, independent of the true row counts — the
+    denominator side of the ``exchange.pad_ratio`` gauge.
+
+    The padded blocks are fixed-size: the chunked default ships C
+    rounds of ``[W, ceil(cap/C)]`` blocks (``W * ceil(cap/C) * C``
+    rows — the same math as :func:`_exchange_padded_chunked`, which
+    knows the true counts only as traced values); the probed
+    single-round path ships one ``[W, bucket_cap]`` block. The ragged
+    path has no padding at all (DMA of exactly the bytes needed), so
+    its wire rows == true rows and this function is not consulted."""
+    if bucket_cap is not None:
+        return w * int(bucket_cap)
+    nch = _padded_chunks(w)
+    b = -(-cap // nch)
+    return w * b * nch
+
+
 def _padded_chunks(w: int) -> int:
     """Rounds for the chunked padded exchange. C rounds move the same
     total bytes as one round but cap the transient at W*ceil(cap/C)
@@ -218,6 +260,15 @@ def _exchange_padded_chunked(arrays, pid_sorted, order, n_recv_true,
 
     This replaces the single-round default bucket (= sender capacity,
     a W*cap transient — VERDICT r2 weak #6) on the portable path.
+
+    Padding accounting: the blocks are fixed-size whatever the true
+    counts, so every round ships ``W * B`` rows while only
+    ``n_recv_true`` (a traced value here) carry data. The host-side
+    dispatch records both — :func:`wire_rows_per_shard` reproduces
+    this function's ``W * ceil(cap/C) * C`` block math for the
+    ``exchange.bytes_padded`` counter and ``exchange.pad_ratio`` gauge
+    (see ``dist_ops._note_exchange``), exposing the wasted all-to-all
+    bandwidth per call.
     """
     w = jax.lax.axis_size(axis_name)
     me = jax.lax.axis_index(axis_name)

@@ -37,6 +37,7 @@ import functools
 
 import jax
 
+from cylon_tpu import telemetry
 from cylon_tpu.errors import OutOfCapacity
 
 __all__ = ["capacity_scale", "current_scale", "compile_query",
@@ -209,6 +210,9 @@ def _check_overflow(out, bad=None) -> None:
                     leaves.append(c.validity)
     if bad is not None:
         leaves.append(bad)
+    telemetry.counter("plan.prefetch_bytes").inc(sum(
+        int(getattr(x, "size", 0)) * x.dtype.itemsize
+        for x in leaves if hasattr(x, "dtype")))
     from cylon_tpu import watchdog
 
     # batch; host values now cached per array. The one synchronous
@@ -314,6 +318,16 @@ class CompiledQuery:
         self._fn = fn
         self._check = check
         self._scale_memo: dict = {}  # static key -> known-good scale
+        #: (static key, scale, dyn-arg shape signature) triples already
+        #: dispatched — first sight of a triple is (at most) one fresh
+        #: XLA program build, counted as ``plan.compile_count`` (the
+        #: persistent on-disk cache may make some of these cheap; the
+        #: counter tracks program-shape churn, which is what the
+        #: capacity ladder is sized to bound). The shape signature
+        #: matters: the same static key re-traces when a dynamic
+        #: argument's buffer shapes change (pow2 capacities of bigger
+        #: inputs), and those recompiles are exactly the churn.
+        self._compiled: set = set()
         #: static key -> per-result-table pow2 capacity buckets. After
         #: the first call observes the result sizes, later calls
         #: compile a variant that emits bucket-sized output buffers —
@@ -357,7 +371,14 @@ class CompiledQuery:
         key = (static_pos, static_kw)
         scale = self._scale_memo.get(key, 1)
         buckets = self._size_memo.get(key) if self._check else None
+        shape_sig = tuple(
+            (getattr(x, "shape", None), str(getattr(x, "dtype", "")))
+            for x in jax.tree_util.tree_leaves((tuple(dyn_pos),
+                                                dyn_kw)))
         while True:
+            if (key, scale, shape_sig) not in self._compiled:
+                self._compiled.add((key, scale, shape_sig))
+                telemetry.counter("plan.compile_count").inc()
             raw, bad = self._jitted(scale, static_pos, static_kw,
                                     tuple(dyn_pos), **dyn_kw)
             if not self._check:
@@ -390,9 +411,13 @@ class CompiledQuery:
                     out = None
                 if out is None:
                     # genuine op overflow: regrow the capacity budget
+                    telemetry.counter("plan.overflow_events",
+                                      site="compiled").inc()
                     if scale >= MAX_SCALE:
                         raise err
                     scale *= 2
+                    telemetry.counter("plan.capacity_rescales",
+                                      site="compiled").inc()
                     continue
             self._scale_memo[key] = scale
             observed = tuple(
@@ -483,9 +508,13 @@ def regrow_eager(run, *, bounded: bool):
             t.num_rows  # host sync; raises on overflow
             return t
         except OutOfCapacity:
+            telemetry.counter("plan.overflow_events",
+                              site="eager").inc()
             if scale >= MAX_SCALE:
                 raise
             scale *= 2
+            telemetry.counter("plan.capacity_rescales",
+                              site="eager").inc()
 
 
 def compile_query(fn=None, *, check: bool = True):

@@ -44,6 +44,7 @@ import time
 
 import numpy as np
 
+from cylon_tpu import telemetry
 from cylon_tpu.config import RetryPolicy
 from cylon_tpu.errors import (Code, CylonError, DataLossError,
                               DeadlineExceeded, InvalidArgument,
@@ -174,6 +175,8 @@ class FaultPlan:
                     hit = r
         if hit is None:
             return
+        telemetry.counter("resilience.faults_injected",
+                          point=point).inc()
         if hit.delay > 0:
             # injected hang: sleep OUTSIDE the plan lock so other
             # threads' injection points stay live while this one stalls
@@ -306,6 +309,9 @@ def retrying(fn, policy: "RetryPolicy | None" = None, *,
             if attempt >= attempts or not classify(e):
                 raise
             d = next(delays)
+            code = getattr(getattr(e, "code", None), "name", None) \
+                or type(e).__name__
+            telemetry.counter("resilience.retries", code=code).inc()
             from cylon_tpu.utils.logging import get_logger
 
             get_logger().warning(
@@ -454,9 +460,13 @@ class SpillStore:
         if rows:
             from cylon_tpu import watchdog
 
-            retrying(lambda: watchdog.bounded(
-                _write, "spill_io", detail=f"write bucket {p}"),
-                self._policy, label=f"spill_write[{p}]")
+            with telemetry.timer("spill.write_seconds").time():
+                retrying(lambda: watchdog.bounded(
+                    _write, "spill_io", detail=f"write bucket {p}"),
+                    self._policy, label=f"spill_write[{p}]")
+            telemetry.counter("spill.write_bytes").inc(
+                int(sum(np.asarray(v).nbytes for v in cols.values())))
+            telemetry.counter("spill.write_buckets").inc()
         self._m["completed"][str(int(p))] = int(rows)
         self._write_manifest(self._m)
 
@@ -471,9 +481,14 @@ class SpillStore:
 
         from cylon_tpu import watchdog
 
-        return retrying(lambda: watchdog.bounded(
-            _read, "spill_io", detail=f"read bucket {p}"),
-            self._policy, label=f"spill_read[{p}]")
+        with telemetry.timer("spill.read_seconds").time():
+            out = retrying(lambda: watchdog.bounded(
+                _read, "spill_io", detail=f"read bucket {p}"),
+                self._policy, label=f"spill_read[{p}]")
+        telemetry.counter("spill.read_bytes").inc(
+            int(sum(a.nbytes for a in out.values())))
+        telemetry.counter("spill.read_buckets").inc()
+        return out
 
 
 def fingerprint_arrays(*parts) -> str:

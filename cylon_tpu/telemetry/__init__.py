@@ -1,0 +1,47 @@
+"""cylon_tpu.telemetry — unified metrics: registry, exporters, fleet view.
+
+One process-local, thread-safe registry of typed instruments
+(:class:`Counter` / :class:`Gauge` / :class:`Histogram` /
+:class:`Timer`) with label support replaces the three disjoint
+registries the rebuild had grown (``tracing`` span stats, the
+watchdog's section-timing deque, ad-hoc bench dicts). Hot layers
+instrument through module helpers::
+
+    from cylon_tpu import telemetry
+
+    telemetry.counter("exchange.bytes_true", op="dist_join").inc(nb)
+    with telemetry.timer("barrier.wait_seconds").time():
+        ...
+    snap = telemetry.snapshot()          # in-process, for tests
+    fleet = telemetry.gather_metrics(env)  # merged across ranks
+
+Design contract (mirrors the watchdog's fast path): with no exporter
+configured — ``CYLON_TPU_METRICS_DIR`` unset — instrumentation is dict
+updates only; no thread starts, no file opens. Exporters
+(:mod:`cylon_tpu.telemetry.export`): JSONL snapshot lines + a
+Prometheus text dump per process, armed lazily off the env knob.
+See ``docs/observability.md``.
+"""
+
+from cylon_tpu.telemetry.aggregate import gather_metrics, merge_snapshots
+from cylon_tpu.telemetry.export import (REQUIRED_BENCH_KEYS,
+                                        bench_metrics, json_safe,
+                                        metrics_dir, snapshot_to_json,
+                                        to_prometheus, write_snapshot)
+from cylon_tpu.telemetry.registry import (BUCKET_BOUNDS, Counter, Gauge,
+                                          Histogram, MetricRegistry,
+                                          Timer, add_record, counter,
+                                          delta, gauge, get_records,
+                                          histogram, instruments,
+                                          metric, registry, reset,
+                                          snapshot, timer, total)
+
+__all__ = [
+    "BUCKET_BOUNDS", "Counter", "Gauge", "Histogram", "Timer",
+    "MetricRegistry", "registry", "counter", "gauge", "histogram",
+    "timer", "metric", "instruments", "snapshot", "delta", "reset",
+    "total", "add_record", "get_records", "merge_snapshots",
+    "gather_metrics", "json_safe", "snapshot_to_json", "to_prometheus",
+    "metrics_dir", "write_snapshot", "bench_metrics",
+    "REQUIRED_BENCH_KEYS",
+]

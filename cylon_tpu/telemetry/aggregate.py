@@ -1,0 +1,117 @@
+"""Cross-rank aggregation: merge snapshots, gather the fleet view.
+
+The reference prints per-rank ``j_t``/``w_t`` lines and leaves the
+operator to eyeball 64 stdouts; here every rank's registry snapshot is
+a plain dict, merging is associative (:func:`merge_snapshots` — the
+property the tests pin), and :func:`gather_metrics` collects every
+process's snapshot over the JAX distributed runtime so ONE host can
+print the fleet view. On a single-controller mesh (one process, many
+devices — the test topology) the local snapshot already IS the fleet
+view and no collective runs.
+
+Merge semantics per instrument type:
+
+- counter: sum (bytes moved fleet-wide, total retries);
+- histogram/timer: per-bucket add + count/sum add + min/max combine —
+  exact because every histogram shares the fixed log-spaced bucket
+  ladder (:data:`cylon_tpu.telemetry.registry.BUCKET_BOUNDS`);
+- gauge: max of the set values (a fleet pad-ratio gauge reports the
+  worst rank — the conservative reading for a utilisation metric).
+"""
+
+import json
+
+__all__ = ["merge_snapshots", "gather_metrics"]
+
+
+def _merge_entry(a: dict, b: dict) -> dict:
+    if a.get("type") != b.get("type"):
+        raise ValueError(
+            f"cannot merge {a.get('type')} with {b.get('type')} for "
+            f"metric {a.get('name')!r} — rank registries diverged")
+    out = dict(a)
+    if a["type"] == "counter":
+        out["value"] = a["value"] + b["value"]
+    elif a["type"] == "gauge":
+        # only numeric gauge values merge — a rank whose gauge was
+        # stringified by json_safe must not turn max() into a
+        # lexicographic compare or a mixed-type TypeError
+        def _num(v):
+            return v if isinstance(v, (int, float)) \
+                and not isinstance(v, bool) else None
+
+        av, bv = _num(a.get("value")), _num(b.get("value"))
+        out["value"] = (bv if av is None
+                        else av if bv is None else max(av, bv))
+    else:  # histogram / timer
+        out["count"] = a["count"] + b["count"]
+        out["sum"] = a["sum"] + b["sum"]
+        for field, pick in (("min", min), ("max", max)):
+            av, bv = a.get(field), b.get(field)
+            out[field] = (bv if av is None
+                          else av if bv is None else pick(av, bv))
+        bks = dict(a.get("buckets", {}))
+        for le, n in b.get("buckets", {}).items():
+            bks[le] = bks.get(le, 0) + n
+        out["buckets"] = bks
+    return out
+
+
+def merge_snapshots(snaps) -> dict:
+    """Reduce an iterable of snapshot dicts into one fleet snapshot.
+    Associative and commutative: any merge tree over the same rank set
+    produces the same result (the histogram buckets are fixed and
+    add elementwise; counters add; gauges max)."""
+    out: dict = {}
+    for snap in snaps:
+        for key, entry in snap.items():
+            out[key] = (dict(entry) if key not in out
+                        else _merge_entry(out[key], entry))
+    return out
+
+
+def gather_metrics(env=None, snap: "dict | None" = None) -> dict:
+    """The fleet-wide metric snapshot, merged onto every host.
+
+    Single-process (the virtual test mesh, a single-controller TPU
+    slice): the local snapshot is returned as-is — no collective, no
+    device work. Multi-process (a DCN-spanning ``multihost=True``
+    mesh): each process contributes its JSON-encoded snapshot through
+    one ``process_allgather`` round (length-padded uint8, the standard
+    variable-payload trick) and every process returns the same merged
+    view — counters summed, histograms bucket-merged across ranks.
+
+    ``env`` is accepted for call-site symmetry with the dist ops; the
+    gather rides process topology, not the mesh axes, so it works
+    before any table exists.
+    """
+    from cylon_tpu.telemetry import registry as _r
+
+    del env  # process topology, not mesh axes, drives the gather
+    snap = _r.snapshot() if snap is None else snap
+    import jax
+
+    if jax.process_count() <= 1:
+        return snap
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    from cylon_tpu.telemetry.export import json_safe
+
+    # json_safe (not default=str): a numpy-scalar gauge must arrive at
+    # the merge as a NUMBER on every rank — stringified values would
+    # max()/add lexicographically or crash on mixed types
+    payload = np.frombuffer(
+        json.dumps(json_safe(snap), allow_nan=False).encode(),
+        dtype=np.uint8)
+    n = np.asarray([payload.size], dtype=np.int32)
+    sizes = np.asarray(multihost_utils.process_allgather(n)).reshape(-1)
+    cap = int(sizes.max())
+    buf = np.zeros(cap, np.uint8)
+    buf[:payload.size] = payload
+    gathered = np.asarray(multihost_utils.process_allgather(buf))
+    gathered = gathered.reshape(jax.process_count(), cap)
+    snaps = []
+    for row, size in zip(gathered, sizes):
+        snaps.append(json.loads(bytes(row[:int(size)]).decode()))
+    return merge_snapshots(snaps)

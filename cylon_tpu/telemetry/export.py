@@ -1,0 +1,280 @@
+"""Machine-readable exporters: JSONL snapshots and Prometheus text.
+
+Export is pull/flush-shaped and OFF by default: nothing here runs —
+no thread, no file handle — unless ``CYLON_TPU_METRICS_DIR`` is set
+(then :func:`arm_exporters` installs an atexit flush, plus a periodic
+daemon writer when ``CYLON_TPU_METRICS_INTERVAL`` seconds > 0) or a
+caller invokes :func:`write_snapshot` / :func:`to_prometheus`
+directly. That keeps the instrumented hot paths at dict-update cost,
+mirroring the watchdog's no-scope-no-thread design.
+
+Everything emitted is strict JSON / Prometheus text: non-finite values
+(the ``SpanStat.min_s = float("inf")`` bug class — ``json.dumps``
+happily writes invalid-JSON ``Infinity``) are normalised to ``null``
+(JSONL) or dropped (Prometheus) by :func:`json_safe`.
+"""
+
+import json
+import os
+import re
+import threading
+import time
+
+__all__ = [
+    "json_safe", "snapshot_to_json", "to_prometheus", "metrics_dir",
+    "write_snapshot", "arm_exporters", "bench_metrics",
+    "REQUIRED_BENCH_KEYS",
+]
+
+
+def json_safe(x):
+    """Recursively coerce to strict-JSON values: NaN/±inf become None
+    (``json.dumps(..., allow_nan=False)`` never raises) and non-JSON
+    scalars (numpy scalars, arbitrary objects a gauge was fed) coerce
+    through ``float()`` or ``str()`` — ONE bad instrument must never
+    cost the whole snapshot."""
+    if x is None or isinstance(x, (str, int)):  # bool is an int
+        return x
+    if isinstance(x, float):
+        return x if x == x and x not in (float("inf"),
+                                         float("-inf")) else None
+    if isinstance(x, dict):
+        return {str(k): json_safe(v) for k, v in x.items()}
+    if isinstance(x, (list, tuple)):
+        return [json_safe(v) for v in x]
+    try:
+        return json_safe(float(x))
+    except (TypeError, ValueError):
+        return str(x)
+
+
+def snapshot_to_json(snap: dict) -> str:
+    """One strict-JSON line for a snapshot (or delta) dict."""
+    return json.dumps(json_safe(snap), allow_nan=False,
+                      separators=(",", ":"), sort_keys=True)
+
+
+_PROM_BAD = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _prom_name(name: str) -> str:
+    return "cylon_" + _PROM_BAD.sub("_", name)
+
+
+def _prom_value(v) -> str:
+    """Exact exposition-format number: integers verbatim (a 1.2 GB
+    byte counter must not round through ``%g``'s 6 significant
+    digits), floats at full round-trip precision."""
+    if isinstance(v, bool):
+        v = int(v)
+    if isinstance(v, int):
+        return str(v)
+    return format(float(v), ".17g")
+
+
+def _prom_escape(v: str) -> str:
+    """Label-value escaping per the exposition format: backslash,
+    double quote and newline (an unescaped span name with quotes
+    would make Prometheus reject the whole scrape)."""
+    return (str(v).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
+def _prom_labels(labels: dict, extra: "tuple | None" = None) -> str:
+    items = [(k, str(v)) for k, v in sorted(labels.items())]
+    if extra:
+        items.append(extra)
+    if not items:
+        return ""
+    body = ",".join(f'{_PROM_BAD.sub("_", k)}="{_prom_escape(v)}"'
+                    for k, v in items)
+    return "{" + body + "}"
+
+
+def to_prometheus(snap: "dict | None" = None) -> str:
+    """Prometheus text exposition of a snapshot: counters and gauges
+    as-is, histograms/timers as cumulative ``_bucket{le=...}`` series
+    plus ``_sum``/``_count``. Non-finite values are skipped (a gauge
+    that was never set exports nothing rather than ``NaN``)."""
+    from cylon_tpu.telemetry import registry as _r
+
+    snap = _r.snapshot() if snap is None else snap
+    typed: "dict[str, str]" = {}
+    lines_by_name: "dict[str, list]" = {}
+    for d in snap.values():
+        name = _prom_name(d["name"])
+        labels = d.get("labels", {})
+        kind = d["type"]
+        if kind in ("counter", "gauge"):
+            typed[name] = "counter" if kind == "counter" else "gauge"
+            v = d["value"]
+            if not isinstance(v, int):
+                try:
+                    v = float(v)
+                except (TypeError, ValueError):
+                    continue  # non-numeric gauge: skip the series
+                v = json_safe(v)
+            if v is None:
+                continue
+            lines_by_name.setdefault(name, []).append(
+                f"{name}{_prom_labels(labels)} {_prom_value(v)}")
+        else:
+            typed[name] = "histogram"
+            out = lines_by_name.setdefault(name, [])
+            cum = 0
+            for le, n in sorted(
+                    d.get("buckets", {}).items(),
+                    key=lambda kv: (kv[0] == "+inf",
+                                    float(kv[0]) if kv[0] != "+inf"
+                                    else 0.0)):
+                if le == "+inf":
+                    continue  # the final cumulative line covers it
+                cum += n
+                out.append(f"{name}_bucket"
+                           f"{_prom_labels(labels, ('le', le))} {cum}")
+            out.append(f"{name}_bucket"
+                       f"{_prom_labels(labels, ('le', '+inf'))} "
+                       f"{d['count']}")
+            s = json_safe(float(d["sum"]))
+            out.append(f"{name}_sum{_prom_labels(labels)} "
+                       f"{_prom_value(0.0 if s is None else s)}")
+            out.append(f"{name}_count{_prom_labels(labels)} "
+                       f"{d['count']}")
+    blocks = []
+    for name in sorted(lines_by_name):
+        blocks.append(f"# TYPE {name} {typed[name]}")
+        blocks.extend(lines_by_name[name])
+    return "\n".join(blocks) + ("\n" if blocks else "")
+
+
+def metrics_dir() -> "str | None":
+    """``CYLON_TPU_METRICS_DIR`` (read per call so tests can flip it)."""
+    return os.environ.get("CYLON_TPU_METRICS_DIR") or None
+
+
+def write_snapshot(snap: "dict | None" = None,
+                   directory: "str | None" = None,
+                   reason: str = "flush") -> "str | None":
+    """Append one JSONL snapshot record to
+    ``<dir>/metrics-<pid>.jsonl`` and rewrite the companion
+    ``metrics-<pid>.prom`` Prometheus dump. Returns the JSONL path, or
+    None when no directory is configured. Export failures are logged,
+    never raised — telemetry must not fail the workload."""
+    from cylon_tpu.telemetry import registry as _r
+
+    directory = directory or metrics_dir()
+    if not directory:
+        return None
+    snap = _r.snapshot() if snap is None else snap
+    rec = {"ts": time.time(), "pid": os.getpid(), "reason": reason,
+           "metrics": snap}
+    path = os.path.join(directory, f"metrics-{os.getpid()}.jsonl")
+    try:
+        # serialised: the interval-writer daemon and the atexit flush
+        # can overlap at interpreter shutdown, and two writers on one
+        # tmp path would interleave into a garbled .prom dump
+        with _WRITE_LOCK:
+            os.makedirs(directory, exist_ok=True)
+            with open(path, "a") as f:
+                f.write(snapshot_to_json(rec) + "\n")
+            prom = os.path.join(directory,
+                                f"metrics-{os.getpid()}.prom")
+            tmp = f"{prom}.tmp{threading.get_ident()}"
+            with open(tmp, "w") as f:
+                f.write(to_prometheus(snap))
+            os.replace(tmp, prom)
+    except Exception as e:
+        # never raise: serialization surprises (a gauge set to a
+        # non-JSON value raises TypeError from json.dumps, ValueError
+        # from the Prometheus float()) must not kill the interval
+        # writer thread or surface at atexit, any more than an OSError
+        from cylon_tpu.utils.logging import get_logger
+
+        get_logger().warning("telemetry export to %s failed: %s",
+                             directory, e)
+        return None
+    return path
+
+
+_ARM_LOCK = threading.Lock()
+_ARMED: "set[int]" = set()
+_WRITE_LOCK = threading.Lock()
+
+
+def arm_exporters(reg) -> None:
+    """Install the atexit flush (and the periodic writer when
+    ``CYLON_TPU_METRICS_INTERVAL`` > 0) for ``reg``. Called lazily by
+    the registry on first instrument creation, and only when
+    ``CYLON_TPU_METRICS_DIR`` is set — a process that never configures
+    a directory never reaches here."""
+    with _ARM_LOCK:
+        if id(reg) in _ARMED:
+            return
+        _ARMED.add(id(reg))
+    import atexit
+
+    atexit.register(
+        lambda: write_snapshot(reg.snapshot(), reason="atexit"))
+    try:
+        interval = float(os.environ.get("CYLON_TPU_METRICS_INTERVAL",
+                                        "0"))
+    except ValueError:
+        interval = 0.0
+    if interval > 0:
+        def _loop():
+            while True:
+                time.sleep(interval)
+                write_snapshot(reg.snapshot(), reason="interval")
+
+        threading.Thread(target=_loop, name="cylon-tpu-metrics",
+                         daemon=True).start()
+
+
+#: counter names every bench record's ``metrics`` block must carry —
+#: the schema ``tests/test_bench_guard.py`` pins so a future PR cannot
+#: silently drop telemetry from the perf trajectory. Values default to
+#: 0 when the metric never fired in the run.
+REQUIRED_BENCH_KEYS = (
+    "exchange.calls",
+    "exchange.bytes_true",
+    "exchange.bytes_padded",
+    "exchange.rows",
+    "plan.overflow_events",
+    "plan.capacity_rescales",
+    "plan.compile_count",
+    "resilience.retries",
+    "resilience.faults_injected",
+    "spill.read_bytes",
+    "spill.write_bytes",
+    "watchdog.sections_expired",
+)
+
+
+def bench_metrics() -> dict:
+    """Compact registry view for embedding in bench JSON records:
+    every :data:`REQUIRED_BENCH_KEYS` counter summed across its label
+    series (0 if never fired), the WORST (max) ``exchange.pad_ratio``
+    across its series, and per-section timer totals.
+    Strict-JSON-safe by construction."""
+    from cylon_tpu.telemetry import registry as _r
+
+    out = {k: _r.total(k) for k in REQUIRED_BENCH_KEYS}
+    ratios = []
+    for _, _, inst in _r.instruments("exchange.pad_ratio"):
+        try:  # per-value coercion: one bad gauge must not cost the
+            v = json_safe(float(inst.value))  # whole metrics block
+        except (TypeError, ValueError):
+            continue
+        if v is not None:
+            ratios.append(v)
+    if ratios:
+        out["exchange.pad_ratio"] = max(ratios)
+    sections = {}
+    for _, labels, inst in _r.instruments("watchdog.section_seconds"):
+        sec = labels.get("section", "?")
+        sections[sec] = {"count": inst.count,
+                         "total_s": json_safe(float(inst.sum)),
+                         "max_s": json_safe(inst.max)}
+    if sections:
+        out["watchdog.sections"] = sections
+    return out

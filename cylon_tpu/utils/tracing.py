@@ -5,9 +5,15 @@ glog INFO lines at op boundaries (shuffle timings ``table.cpp:167-177``;
 bench binaries log ``j_t``/``w_t`` per rank,
 ``cpp/src/examples/bench/table_join_dist_test.cpp:38-56``). The rebuild
 formalises that: every public op runs under a :func:`span`, spans
-accumulate into a process-local registry (count/total/min/max), and the
+accumulate into the process telemetry registry
+(:mod:`cylon_tpu.telemetry` — one registry for spans, watchdog section
+timings and engine counters, exportable as JSONL/Prometheus), and the
 same spans emit ``jax.profiler.TraceAnnotation`` so they line up with
 XLA device traces in xprof/tensorboard (:func:`profile_to`).
+
+:func:`span`/:func:`profile_to`/:func:`timings` are kept as thin
+wrappers over the registry so existing callers (and their tests) are
+untouched; :class:`SpanStat` remains the aggregate view type.
 
 Caveat that doesn't exist in the reference: JAX dispatch is async, so a
 span around a jitted call measures *host orchestration* unless
@@ -16,11 +22,13 @@ span around a jitted call measures *host orchestration* unless
 
 import contextlib
 import functools
-import threading
-import time
 from dataclasses import dataclass, field
 
+from cylon_tpu import telemetry
 from cylon_tpu.utils.logging import get_logger
+
+#: the telemetry series spans record into (label ``name`` = span name)
+SPAN_METRIC = "tracing.span_seconds"
 
 
 @dataclass
@@ -36,15 +44,22 @@ class SpanStat:
         self.min_s = min(self.min_s, dt)
         self.max_s = max(self.max_s, dt)
 
-
-_stats: dict[str, SpanStat] = {}
-_lock = threading.Lock()
+    def to_json(self) -> dict:
+        """Strict-JSON-safe dict: an empty stat's ``min_s`` default of
+        ``float("inf")`` would serialise as invalid-JSON ``Infinity``
+        (``json.dumps`` emits it happily), so fields normalise through
+        the one canonical coercion, :func:`telemetry.json_safe`."""
+        return telemetry.json_safe(
+            {"count": self.count, "total_s": self.total_s,
+             "min_s": self.min_s, "max_s": self.max_s})
 
 
 @contextlib.contextmanager
 def span(name: str, sync=None):
     """Time a named region; optionally block on ``sync`` (any pytree of
     jax arrays) so device work is included in the measurement."""
+    import time
+
     import jax
 
     t0 = time.perf_counter()
@@ -55,8 +70,7 @@ def span(name: str, sync=None):
             if sync is not None:
                 jax.block_until_ready(sync)
             dt = time.perf_counter() - t0
-            with _lock:
-                _stats.setdefault(name, SpanStat()).add(dt)
+            telemetry.timer(SPAN_METRIC, name=name).observe(dt)
             get_logger().info("%s: %.3f ms", name, dt * 1e3)
 
 
@@ -77,15 +91,20 @@ def traced(name: str | None = None):
 
 
 def timings() -> dict[str, SpanStat]:
-    """Snapshot of accumulated span statistics."""
-    with _lock:
-        return {k: SpanStat(v.count, v.total_s, v.min_s, v.max_s)
-                for k, v in _stats.items()}
+    """Snapshot of accumulated span statistics — a view over the
+    telemetry registry's :data:`SPAN_METRIC` series."""
+    out = {}
+    for _, labels, inst in telemetry.instruments(SPAN_METRIC):
+        d = inst.dump()  # locked read: count/min/max move together
+        if d["count"] and d["min"] is not None:
+            out[labels["name"]] = SpanStat(
+                d["count"], float(d["sum"]), float(d["min"]),
+                float(d["max"]))
+    return out
 
 
 def reset_timings() -> None:
-    with _lock:
-        _stats.clear()
+    telemetry.reset("tracing.")
 
 
 def report() -> str:

@@ -43,11 +43,15 @@ unrecoverable) — and ``resilience.is_retryable`` consults the flag, so
 ``retrying(lambda: bounded(fn, "bootstrap"))`` re-attempts a bounded
 bootstrap exactly like a raised connection error.
 
-Section completions append timing records — always for
+Section completions feed the telemetry registry
+(:mod:`cylon_tpu.telemetry`: per-section latency histograms, expiry
+counters and a bounded raw-record history) — always for
 :func:`watched_section` regions, and for :func:`bounded` ones whenever
 a deadline was in play (the no-deadline fast path stays record-free by
-design); :func:`timings` / :func:`straggler_report` expose them for
-straggler analysis — the host-side twin of the reference exchange's
+design); :func:`timings` / :func:`straggler_report` are views over
+that registry (``clear_timings()`` is the registry reset scoped to
+the ``watchdog.`` namespace — no second store exists) for straggler
+analysis — the host-side twin of the reference exchange's
 ``isComplete()`` progress visibility.
 
 Hangs are injectable deterministically: ``FaultRule(point,
@@ -57,7 +61,6 @@ raising, so the whole layer is testable at tier-1 with millisecond
 thresholds.
 """
 
-import collections
 import contextlib
 import contextvars
 import dataclasses
@@ -68,6 +71,7 @@ import threading
 import time
 import traceback
 
+from cylon_tpu import telemetry
 from cylon_tpu.config import DEADLINE_SECTIONS, DeadlinePolicy
 from cylon_tpu.errors import DeadlineExceeded, InvalidArgument
 
@@ -222,38 +226,52 @@ class SectionTiming:
     dump_after: "float | None" = None
 
 
-_TIMINGS: "collections.deque[SectionTiming]" = collections.deque(
-    maxlen=1024)
-_TLOCK = threading.Lock()
+#: telemetry series section completions feed (the registry is the one
+#: source of truth — there is no private deque any more):
+#: per-section latency histogram, expiry counter, raw-record history
+SECTION_TIMER = "watchdog.section_seconds"
+SECTION_EXPIRED = "watchdog.sections_expired"
+SECTION_RECORDS = "watchdog.section_timings"
 
 
 def timings(section: "str | None" = None) -> "list[SectionTiming]":
-    """Completed-section timing records, newest last (bounded history)."""
-    with _TLOCK:
-        recs = list(_TIMINGS)
+    """Completed-section timing records, newest last (bounded history,
+    read from the telemetry registry's record store)."""
+    recs = telemetry.get_records(SECTION_RECORDS)
     return recs if section is None else [r for r in recs
                                          if r.section == section]
 
 
 def clear_timings() -> None:
-    with _TLOCK:
-        _TIMINGS.clear()
+    """Clear the section history: ONE registry operation —
+    ``telemetry.reset("watchdog.")`` — because the history lives only
+    in the telemetry registry (no private deque to clear separately,
+    so the two can never diverge; a full ``telemetry.reset()`` clears
+    it too). Scoped to the ``watchdog.`` namespace so an operator
+    resetting straggler stats between query phases does not destroy
+    the run's exchange/spill/plan counters."""
+    telemetry.reset("watchdog.")
 
 
 def straggler_report() -> "dict[str, dict]":
-    """Per-section aggregate over the timing history: count, mean/max
-    elapsed, and how many expired — the quickest way to see which
-    blocking layer is the straggler."""
+    """Per-section aggregate: count, mean/max elapsed, and how many
+    expired — the quickest way to see which blocking layer is the
+    straggler. A pure view over the telemetry registry (the
+    :data:`SECTION_TIMER` histograms and :data:`SECTION_EXPIRED`
+    counters), not a second accumulation."""
     agg: dict[str, dict] = {}
-    for r in timings():
-        a = agg.setdefault(r.section, {"count": 0, "total_s": 0.0,
-                                       "max_s": 0.0, "expired": 0})
-        a["count"] += 1
-        a["total_s"] += r.elapsed
-        a["max_s"] = max(a["max_s"], r.elapsed)
-        a["expired"] += bool(r.expired)
-    for a in agg.values():
-        a["mean_s"] = a["total_s"] / a["count"]
+    for _, labels, inst in telemetry.instruments(SECTION_TIMER):
+        sec = labels.get("section", "?")
+        if not inst.count:
+            continue
+        exp = telemetry.metric(SECTION_EXPIRED, section=sec)
+        agg[sec] = {
+            "count": inst.count,
+            "total_s": inst.sum,
+            "max_s": inst.max if inst.max is not None else 0.0,
+            "expired": exp.value if exp is not None else 0,
+            "mean_s": inst.sum / inst.count,
+        }
     return agg
 
 
@@ -276,10 +294,13 @@ class _Section:
 
 
 def _finish(rec: _Section, expired: bool) -> None:
-    with _TLOCK:
-        _TIMINGS.append(SectionTiming(
-            rec.section, rec.detail, time.monotonic() - rec.started,
-            rec.budget, expired, rec.dump_after))
+    elapsed = time.monotonic() - rec.started
+    telemetry.timer(SECTION_TIMER, section=rec.section).observe(elapsed)
+    if expired:
+        telemetry.counter(SECTION_EXPIRED, section=rec.section).inc()
+    telemetry.add_record(SECTION_RECORDS, SectionTiming(
+        rec.section, rec.detail, elapsed, rec.budget, expired,
+        rec.dump_after))
 
 
 # ------------------------------------------------------------- the monitor

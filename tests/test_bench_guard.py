@@ -127,6 +127,78 @@ def test_checker_catches_the_original_bug(tmp_path):
     assert {n for _, n in bad} == {"_is_crash", "attempted"}
 
 
+# -------------------------------------------- telemetry record schema
+def _json_record_prints(path: pathlib.Path) -> list:
+    """(lineno, enclosing function) of every ``print(json.dumps(...))``
+    in ``path`` — the shape of a bench JSON record hitting stdout."""
+    tree = ast.parse(path.read_text(), filename=str(path))
+    hits = []
+
+    def walk(node, fn_name):
+        for child in ast.iter_child_nodes(node):
+            name = child.name if isinstance(child, _FN) else fn_name
+            if (isinstance(child, ast.Call)
+                    and isinstance(child.func, ast.Name)
+                    and child.func.id == "print"
+                    and child.args
+                    and isinstance(child.args[0], ast.Call)
+                    and isinstance(child.args[0].func, ast.Attribute)
+                    and child.args[0].func.attr == "dumps"
+                    and isinstance(child.args[0].func.value, ast.Name)
+                    and child.args[0].func.value.id == "json"):
+                hits.append((child.lineno, fn_name))
+            walk(child, name)
+
+    walk(tree, "<module>")
+    return hits
+
+
+@pytest.mark.parametrize("driver", DRIVERS)
+def test_all_json_records_route_through_emit_record(driver):
+    """Every bench JSON record must flow through ``_emit_record`` (the
+    one place the telemetry ``metrics`` block is attached) — a direct
+    ``print(json.dumps(...))`` elsewhere would ship records without
+    byte/overflow/retry context, silently dropping telemetry from the
+    perf trajectory."""
+    bad = [(ln, fn) for ln, fn in
+           _json_record_prints(REPO / driver) if fn != "_emit_record"]
+    assert not bad, (
+        f"{driver} prints JSON records outside _emit_record at {bad}; "
+        "route them through _emit_record so the metrics block rides "
+        "along")
+
+
+def test_emit_record_schema_carries_required_metrics(capsys):
+    """Schema check: a record emitted by bench_suite carries a
+    ``metrics`` block with every REQUIRED_BENCH_KEYS counter (0 when
+    the metric never fired), strict-JSON round-trippable."""
+    import json
+
+    import bench_suite
+    from cylon_tpu.telemetry import REQUIRED_BENCH_KEYS
+
+    bench_suite._emit("guard_probe", 1.0, "unit")
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    rec = json.loads(out)
+    assert rec["metric"] == "guard_probe"
+    assert isinstance(rec.get("metrics"), dict), rec
+    missing = [k for k in REQUIRED_BENCH_KEYS if k not in rec["metrics"]]
+    assert not missing, f"metrics block missing required keys {missing}"
+    # strict JSON end to end: no Infinity/NaN survives export
+    json.loads(json.dumps(rec["metrics"], allow_nan=False))
+
+
+def test_bench_headline_record_carries_metrics(capsys):
+    """bench.py's one-line headline record gets the same block."""
+    import json
+
+    import bench
+
+    bench._emit_record({"metric": "probe", "value": 1})
+    rec = json.loads(capsys.readouterr().out.strip())
+    assert "metrics" in rec and isinstance(rec["metrics"], dict)
+
+
 def test_checker_accepts_closures_and_comprehensions(tmp_path):
     p = tmp_path / "ok.py"
     p.write_text(

@@ -1,0 +1,465 @@
+"""Telemetry subsystem: registry, instruments, exporters, aggregation.
+
+Pins the ISSUE 3 contracts: concurrent counters lose no updates,
+histogram merge across ranks is associative, exports are strict JSON
+(no ``Infinity``/``NaN`` — the ``SpanStat.min_s`` bug class),
+``FaultRule`` firings surface as ``resilience.faults_injected``, the
+exchange dispatch prices true vs padded bytes, and the fast path adds
+no threads when no exporter is configured.
+"""
+
+import json
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from cylon_tpu import telemetry
+from cylon_tpu.telemetry.registry import MetricRegistry
+
+requires_shard_map = pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason="jax.shard_map unavailable (the jax-0.4.37 seed gap): the "
+           "distributed dispatch cannot run on this jax")
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+# ------------------------------------------------------------ instruments
+def test_concurrent_counter_increments_lose_no_updates():
+    c = telemetry.counter("t.concurrent")
+    per, nthreads = 5000, 8
+
+    def work():
+        for _ in range(per):
+            c.inc()
+
+    threads = [threading.Thread(target=work) for _ in range(nthreads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.value == per * nthreads
+
+
+def test_labels_are_distinct_series_and_total_sums():
+    telemetry.counter("t.bytes", op="a").inc(3)
+    telemetry.counter("t.bytes", op="b").inc(4)
+    assert telemetry.counter("t.bytes", op="a").value == 3
+    assert telemetry.total("t.bytes") == 7
+    snap = telemetry.snapshot()
+    assert snap["t.bytes{op=a}"]["value"] == 3
+    assert snap["t.bytes{op=b}"]["labels"] == {"op": "b"}
+
+
+def test_gauge_keeps_last_value():
+    g = telemetry.gauge("t.g")
+    g.set(2.5)
+    g.set(1.5)
+    assert telemetry.metric("t.g").value == 1.5
+
+
+def test_histogram_stats_and_buckets():
+    h = telemetry.histogram("t.h")
+    for v in (0.001, 0.002, 4.0):
+        h.observe(v)
+    assert h.count == 3
+    assert h.min == 0.001 and h.max == 4.0
+    assert abs(h.sum - 4.003) < 1e-9
+    assert sum(h.buckets) == 3
+
+
+def test_timer_context_manager_observes_seconds():
+    t = telemetry.timer("t.t", section="x")
+    with t.time():
+        pass
+    assert t.count == 1 and 0 <= t.min < 1.0
+
+
+def test_metric_lookup_does_not_create():
+    assert telemetry.metric("t.absent") is None
+    telemetry.counter("t.present").inc()
+    assert telemetry.metric("t.present").value == 1
+
+
+def test_kind_mismatch_raises():
+    telemetry.counter("t.kind")
+    with pytest.raises(TypeError):
+        telemetry.gauge("t.kind")
+
+
+def test_delta_subtracts_counters_and_histograms():
+    telemetry.counter("t.d").inc(5)
+    telemetry.histogram("t.dh").observe(1.0)
+    prev = telemetry.snapshot()
+    telemetry.counter("t.d").inc(2)
+    telemetry.histogram("t.dh").observe(2.0)
+    d = telemetry.delta(prev)
+    assert d["t.d"]["value"] == 2
+    assert d["t.dh"]["count"] == 1
+    assert sum(d["t.dh"]["buckets"].values()) == 1
+
+
+def test_reset_by_prefix():
+    telemetry.counter("a.x").inc()
+    telemetry.counter("b.y").inc()
+    telemetry.add_record("a.recs", 1)
+    telemetry.reset("a.")
+    assert telemetry.metric("a.x") is None
+    assert telemetry.get_records("a.recs") == []
+    assert telemetry.metric("b.y").value == 1
+
+
+# ------------------------------------------------------------ aggregation
+def _rank_snapshot(seed: int) -> dict:
+    reg = MetricRegistry()
+    rng = np.random.default_rng(seed)
+    reg.counter("exchange.bytes_true", op="join").inc(100 * (seed + 1))
+    h = reg.timer("watchdog.section_seconds", section="exchange")
+    for v in rng.uniform(1e-4, 2.0, 17):
+        h.observe(float(v))
+    reg.gauge("exchange.pad_ratio").set(1.0 + seed)
+    return reg.snapshot()
+
+
+def test_histogram_merge_across_ranks_is_associative():
+    a, b, c = (_rank_snapshot(s) for s in range(3))
+    m = telemetry.merge_snapshots
+    left = m([m([a, b]), c])
+    right = m([a, m([b, c])])
+    assert left == right
+    key = "watchdog.section_seconds{section=exchange}"
+    assert left[key]["count"] == 3 * 17
+    for snap in (a, b, c):
+        for le, n in snap[key]["buckets"].items():
+            assert left[key]["buckets"][le] >= n
+
+
+def test_merge_sums_counters_and_maxes_gauges():
+    a, b, c = (_rank_snapshot(s) for s in range(3))
+    fleet = telemetry.merge_snapshots([a, b, c])
+    assert fleet["exchange.bytes_true{op=join}"]["value"] == 600
+    assert fleet["exchange.pad_ratio"]["value"] == 3.0
+
+
+def test_gather_metrics_single_process_is_local_snapshot():
+    telemetry.counter("t.gather").inc(9)
+    fleet = telemetry.gather_metrics()
+    assert fleet["t.gather"]["value"] == 9
+    assert fleet == telemetry.snapshot()
+
+
+# -------------------------------------------------------------- exporters
+def test_jsonl_export_roundtrip_contains_no_inf_or_nan(tmp_path):
+    telemetry.counter("t.c").inc(2)
+    telemetry.gauge("t.inf").set(float("inf"))
+    telemetry.gauge("t.nan").set(float("nan"))
+    telemetry.timer("t.empty")  # zero observations: min/max are None
+    h = telemetry.histogram("t.h")
+    h.observe(float("inf"))  # overflow-bucketed, excluded from sum
+    path = telemetry.write_snapshot(directory=str(tmp_path))
+    assert path is not None
+    lines = open(path).read().splitlines()
+    assert len(lines) == 1
+    rec = json.loads(lines[0])  # strict parse would choke on Infinity
+    assert "Infinity" not in lines[0] and "NaN" not in lines[0]
+    m = rec["metrics"]
+    assert m["t.c"]["value"] == 2
+    assert m["t.inf"]["value"] is None
+    assert m["t.empty"]["min"] is None
+    assert m["t.h"]["count"] == 1 and m["t.h"]["sum"] == 0.0
+    # round-trip: the parsed snapshot re-exports byte-identically
+    assert telemetry.snapshot_to_json(m) == telemetry.snapshot_to_json(
+        json.loads(telemetry.snapshot_to_json(m)))
+
+
+def test_prometheus_dump_shape(tmp_path):
+    telemetry.counter("exchange.bytes_true", op="shuffle").inc(64)
+    t = telemetry.timer("watchdog.section_seconds", section="exchange")
+    t.observe(0.25)
+    text = telemetry.to_prometheus()
+    assert "# TYPE cylon_exchange_bytes_true counter" in text
+    assert 'cylon_exchange_bytes_true{op="shuffle"} 64' in text
+    assert "# TYPE cylon_watchdog_section_seconds histogram" in text
+    assert ('cylon_watchdog_section_seconds_bucket'
+            '{section="exchange",le="+inf"} 1') in text
+    assert "cylon_watchdog_section_seconds_count" in text
+    assert "inf " not in text.replace('le="+inf"', "")
+    # the .prom companion file lands next to the JSONL
+    telemetry.write_snapshot(directory=str(tmp_path))
+    proms = list(tmp_path.glob("*.prom"))
+    assert proms and proms[0].read_text().startswith("# TYPE")
+
+
+def test_no_exporter_and_no_threads_without_metrics_dir(monkeypatch):
+    monkeypatch.delenv("CYLON_TPU_METRICS_DIR", raising=False)
+    before = set(threading.enumerate())
+    for i in range(100):
+        telemetry.counter("t.fast", op=str(i % 3)).inc()
+    with telemetry.timer("t.fast_timer").time():
+        pass
+    telemetry.snapshot()
+    assert set(threading.enumerate()) == before
+
+
+def test_span_stat_to_json_normalises_inf():
+    from cylon_tpu.utils.tracing import SpanStat
+
+    empty = SpanStat()
+    assert empty.min_s == float("inf")  # the raw default stays
+    js = json.dumps(empty.to_json(), allow_nan=False)  # but exports
+    assert json.loads(js)["min_s"] is None
+    full = SpanStat(2, 0.5, 0.1, 0.4)
+    assert json.loads(json.dumps(full.to_json()))["min_s"] == 0.1
+
+
+def test_tracing_spans_feed_the_registry():
+    from cylon_tpu.utils import tracing
+
+    with tracing.span("t_unit"):
+        pass
+    snap = telemetry.snapshot()
+    key = f"{tracing.SPAN_METRIC}{{name=t_unit}}"
+    assert snap[key]["count"] == 1
+    assert tracing.timings()["t_unit"].count == 1
+    tracing.reset_timings()
+    assert "t_unit" not in tracing.timings()
+
+
+# ------------------------------------------------ engine instrumentation
+def test_faultrule_firing_increments_faults_injected():
+    from cylon_tpu import resilience
+    from cylon_tpu.errors import TransientError
+
+    plan = resilience.FaultPlan([
+        resilience.FaultRule("io_read", nth=2, times=2)])
+    with resilience.active(plan):
+        resilience.inject("io_read")  # hit 1: no fire
+        assert telemetry.total("resilience.faults_injected") == 0
+        for _ in range(2):  # hits 2-3 fire
+            with pytest.raises(TransientError):
+                resilience.inject("io_read")
+    c = telemetry.metric("resilience.faults_injected", point="io_read")
+    assert c is not None and c.value == 2
+    assert plan.fired and len(plan.fired) == 2
+
+
+def test_retrying_counts_retries_by_code():
+    from cylon_tpu import resilience
+    from cylon_tpu.config import RetryPolicy
+    from cylon_tpu.errors import TransientError
+
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise TransientError("flake")
+        return "ok"
+
+    assert resilience.retrying(
+        flaky, RetryPolicy(max_attempts=5, base_delay=0.0),
+        sleep_fn=lambda _: None) == "ok"
+    c = telemetry.metric("resilience.retries", code="Unavailable")
+    assert c is not None and c.value == 2
+
+
+def test_spill_store_records_bytes_and_latency(tmp_path):
+    from cylon_tpu import resilience
+
+    store = resilience.SpillStore(str(tmp_path), fingerprint="fp")
+    cols = {"a": np.arange(100, dtype=np.int64),
+            "b": np.ones(100)}
+    store.write_bucket(0, cols, 100)
+    out = store.read_bucket(0)
+    assert list(out) == ["a", "b"]
+    nbytes = sum(v.nbytes for v in cols.values())
+    assert telemetry.total("spill.write_bytes") == nbytes
+    assert telemetry.total("spill.read_bytes") == nbytes
+    assert telemetry.metric("spill.write_seconds").count == 1
+    assert telemetry.metric("spill.read_seconds").count == 1
+    assert telemetry.total("spill.write_buckets") == 1
+
+
+def test_ooc_chunks_counted():
+    from cylon_tpu.outofcore import host_partition_chunks
+
+    src = {"k": np.arange(64, dtype=np.int64)}
+    from cylon_tpu.outofcore import _as_chunks
+
+    parts = host_partition_chunks(_as_chunks(src, 16), ["k"], 4)
+    assert len(parts) == 4
+    assert telemetry.total("ooc.chunks") == 4
+
+
+def test_transport_words_and_wire_rows():
+    from cylon_tpu import Table
+    from cylon_tpu.parallel.shuffle import (transport_words,
+                                            wire_rows_per_shard)
+
+    t = Table.from_pydict({
+        "k": np.arange(32, dtype=np.int64),       # 2 words
+        "v": np.ones(32),                          # 2 words (f64)
+        "f": np.ones(32, np.float32),              # 1 word
+    })
+    assert transport_words(t) == 5
+    # chunked default: W * ceil(cap/C) * C rows, C = min(W, 8)
+    assert wire_rows_per_shard(8, 1024) == 8 * 128 * 8
+    # probed single round: one [W, bucket_cap] block
+    assert wire_rows_per_shard(8, 1024, bucket_cap=16) == 128
+    # chunk rounding never undercounts the shipped blocks
+    assert wire_rows_per_shard(8, 1000) >= 8 * 1000
+
+
+class _StubEnv:
+    """Host-side stand-in for CylonEnv: _note_exchange reads only
+    topology metadata, so the pricing logic is testable without a
+    dispatchable mesh (jax.shard_map is absent on this jax)."""
+
+    world_size = 8
+    is_hierarchical = False
+    platform = "cpu"
+
+
+def test_note_exchange_prices_true_vs_padded_bytes():
+    from cylon_tpu import Table
+    from cylon_tpu.parallel import dist_ops
+
+    n = 256
+    lt = Table.from_pydict({"k": np.arange(n, dtype=np.int64),
+                            "a": np.ones(n)})
+    rt = Table.from_pydict({"k": np.arange(n, dtype=np.int64),
+                            "b": np.ones(n)})
+    dist_ops._note_exchange(_StubEnv(), "dist_join", (lt, rt))
+    true_b = telemetry.total("exchange.bytes_true")
+    pad_b = telemetry.total("exchange.bytes_padded")
+    # 4 words/row (i64 key + f64 value), both tables fully valid
+    assert true_b == 2 * n * 4 * 4
+    assert pad_b >= true_b  # padded blocks always cover the payload
+    assert telemetry.total("exchange.rows") == 2 * n
+    calls = telemetry.metric("exchange.calls", op="dist_join",
+                             path="padded")
+    assert calls is not None and calls.value == 1
+    ratio = telemetry.metric("exchange.pad_ratio", op="dist_join")
+    assert ratio is not None and ratio.value == pad_b / true_b >= 1.0
+
+
+def test_note_exchange_no_sync_path_prices_only_padding():
+    """Explicit-capacity dispatches (synced=False) must not fetch
+    counts: with no memo present, true bytes stay 0 and only the
+    static padded-wire pricing records — the no-sync escape hatch."""
+    from cylon_tpu import Table
+    from cylon_tpu.parallel import dist_ops
+
+    t = Table.from_pydict({"k": np.arange(64, dtype=np.int64)})
+    assert "_host_counts_memo" not in t.__dict__
+    dist_ops._note_exchange(_StubEnv(), "shuffle", (t,), synced=False)
+    assert "_host_counts_memo" not in t.__dict__  # no fetch happened
+    assert telemetry.total("exchange.bytes_true") == 0
+    assert telemetry.total("exchange.bytes_padded") > 0
+    # once a memo exists (some earlier op paid the sync), it is used
+    dist_ops._counts_memo(t)
+    dist_ops._note_exchange(_StubEnv(), "shuffle", (t,), synced=False)
+    assert telemetry.total("exchange.bytes_true") > 0
+
+
+def test_write_snapshot_survives_bad_gauge_without_losing_others(
+        tmp_path):
+    """One non-JSON instrument value (an object, a numpy scalar) must
+    not cost the snapshot: it coerces through float()/str() and every
+    other series still exports."""
+    telemetry.counter("t.good").inc(7)
+    telemetry.gauge("t.bad").set(object())
+    telemetry.gauge("t.np").set(np.float32(1.5))
+    path = telemetry.write_snapshot(directory=str(tmp_path))
+    assert path is not None
+    m = json.loads(open(path).read().splitlines()[-1])["metrics"]
+    assert m["t.good"]["value"] == 7
+    assert isinstance(m["t.bad"]["value"], str)
+    assert m["t.np"]["value"] == 1.5
+
+
+def test_prometheus_values_are_exact_and_labels_escaped():
+    """Large byte counters must not round through %g, and label values
+    with quotes/backslashes/newlines must escape per the exposition
+    format (an unescaped value rejects the whole scrape)."""
+    telemetry.counter("t.bytes").inc(1_234_567_890)
+    telemetry.counter("t.esc", name='load "x"\\n').inc()
+    text = telemetry.to_prometheus()
+    assert "cylon_t_bytes 1234567890" in text
+    assert r'name="load \"x\"\\n"' in text
+
+
+def test_clear_timings_scoped_to_watchdog_namespace():
+    """clear_timings is the registry reset scoped to watchdog.* — it
+    must not destroy the run's exchange/spill/plan counters."""
+    from cylon_tpu import watchdog
+
+    telemetry.counter("exchange.bytes_true", op="x").inc(64)
+    with watchdog.deadline(5.0):
+        watchdog.bounded(lambda: 1, "overflow_fetch")
+    assert watchdog.straggler_report()
+    watchdog.clear_timings()
+    assert watchdog.straggler_report() == {}
+    assert watchdog.timings() == []
+    assert telemetry.total("exchange.bytes_true") == 64
+
+
+def test_note_exchange_skips_traced_tables():
+    from cylon_tpu import Table
+    from cylon_tpu.parallel import dist_ops
+
+    t = Table.from_pydict({"k": np.arange(8, dtype=np.int64)})
+
+    def probe(nrows):
+        dist_ops._note_exchange(
+            _StubEnv(), "shuffle", (t.with_nrows(nrows),))
+        return nrows
+
+    jax.jit(probe)(jax.numpy.int32(8))
+    assert telemetry.total("exchange.calls") == 0
+
+
+# ----------------------------------------- acceptance: distributed join
+@requires_shard_map
+def test_snapshot_after_dist_join_reports_exchange_and_sections(env8, rng):
+    from cylon_tpu import Table
+    from cylon_tpu.parallel import dist_join, scatter_table
+
+    n = 512
+    lt = scatter_table(env8, Table.from_pydict(
+        {"k": rng.integers(0, 64, n), "a": rng.normal(size=n)}))
+    rt = scatter_table(env8, Table.from_pydict(
+        {"k": rng.integers(0, 64, n), "b": rng.normal(size=n)}))
+    dist_join(env8, lt, rt, on="k", how="inner", out_capacity=16 * n)
+    snap = telemetry.snapshot()
+    assert telemetry.total("exchange.bytes_true") > 0
+    assert telemetry.total("exchange.bytes_padded") > 0
+    sec = snap.get("watchdog.section_seconds{section=exchange}")
+    assert sec is not None and sec["count"] >= 1
+    fleet = telemetry.gather_metrics(env8)
+    assert fleet["exchange.bytes_true{op=dist_join}"]["value"] == \
+        telemetry.total("exchange.bytes_true")
+
+
+def test_bench_metrics_block_is_strict_json_and_complete():
+    from cylon_tpu.telemetry import REQUIRED_BENCH_KEYS, bench_metrics
+
+    telemetry.counter("exchange.calls", op="x", path="padded").inc()
+    telemetry.gauge("exchange.pad_ratio", op="x").set(float("inf"))
+    telemetry.gauge("exchange.pad_ratio", op="y").set(object())
+    blk = bench_metrics()
+    for k in REQUIRED_BENCH_KEYS:
+        assert k in blk
+    assert blk["exchange.calls"] == 1
+    # inf / non-numeric gauges are skipped, never poison the block
+    assert "exchange.pad_ratio" not in blk
+    telemetry.gauge("exchange.pad_ratio", op="z").set(2.5)
+    assert bench_metrics()["exchange.pad_ratio"] == 2.5
+    json.loads(json.dumps(blk, allow_nan=False))
