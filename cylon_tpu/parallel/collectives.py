@@ -44,6 +44,15 @@ def _resolve_axes(axis_name):
             return manual if len(manual) > 1 else manual[0]
     except Exception:
         pass
+    try:
+        # pre-promotion jax has no manual-axis mesh introspection; the
+        # trace context's axis env lists the mapped axes in mesh
+        # (slice-major) order instead
+        names = tuple(jax.core.unsafe_get_axis_names_DO_NOT_USE())
+        if names:
+            return names if len(names) > 1 else names[0]
+    except Exception:
+        pass
     return WORKER_AXIS
 
 

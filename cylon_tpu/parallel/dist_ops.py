@@ -129,13 +129,130 @@ def _partition_keys(lt: Table, cols, vh: dict):
     return keys, vals
 
 
-def _out_cap_local(env, *tables, out_capacity=None, skew=DEFAULT_SKEW):
+def _fill_count_memos(tables) -> None:
+    """Fill every missing ``_host_counts_memo`` through ONE batched
+    ``device_get`` — THE batched variant of :func:`_counts_memo`'s
+    convention, shared by the telemetry pricing and the compiled-query
+    row hint. Tables whose counts are unreachable without a collective
+    (tracers, non-addressable shards) are left memo-less; callers
+    decide whether that means "skip" (:func:`batched_true_rows`) or
+    "fall back to per-table fetches" (:func:`_note_exchange` never
+    reaches here with tracers)."""
+    pending = [t for t in tables
+               if "_host_counts_memo" not in t.__dict__
+               and getattr(t.nrows, "is_fully_addressable", True)
+               and not isinstance(t.nrows, jax.core.Tracer)]
+    if pending:
+        for t, c in zip(pending,
+                        jax.device_get([t.nrows for t in pending])):
+            t.__dict__["_host_counts_memo"] = np.asarray(c)
+
+
+def batched_true_rows(tables) -> "list[int] | None":
+    """Total TRUE rows per table from the per-instance count memos
+    (missing ones filled by :func:`_fill_count_memos` — later eager
+    dispatches on the same instances pay nothing). Returns None when
+    any table is poisoned (its count is a lie) or a count is
+    unreachable without extra blocking work (tracer, or
+    non-addressable shards whose fetch would be one process_allgather
+    collective PER TABLE — a sync this sizing path promises never to
+    add; those callers keep the capacity-based default instead)."""
+    _fill_count_memos(tables)
+    out = []
+    for t in tables:
+        counts = t.__dict__.get("_host_counts_memo")
+        if counts is None:
+            return None  # tracer / non-addressable: unreachable here
+        cap_l = _shard_cap(t)
+        if (counts > cap_l).any():
+            return None
+        out.append(int(np.minimum(counts, cap_l).sum()))
+    return out
+
+
+def _tight_rows_local(env, tables, enabled: bool = True,
+                      per_shard: bool = False):
+    """Per-shard TRUE-row estimate for a defaulted exchange bound — the
+    count-driven half of the tight-capacity path (ISSUE 4 tentpole).
+
+    Eagerly, the (memoized) per-shard count fetch gives the exact total
+    row flow of the exchange; balanced partitioning (hash of
+    non-degenerate keys, round-robin, salted sample-sort splitters)
+    receives ``ceil(total/W)`` per shard, and the pow2 bucket the
+    caller rounds to absorbs the typical imbalance. When real skew
+    exceeds the bucket, the dispatch overflows and the existing
+    :func:`_adaptive` regrow ladder doubles the ambient scale — tight
+    sizing therefore only ever applies to ADAPTIVE dispatches
+    (``enabled``), so the raise-on-overflow contract of explicit
+    capacities is untouched.
+
+    Under an outer trace, counts are tracers; the enclosing
+    :class:`cylon_tpu.plan.CompiledQuery` records a pow2 bucket of its
+    concrete input rows as an ambient hint (``plan.current_row_hint``)
+    — inexact for intermediates, so it keeps the DEFAULT_SKEW headroom
+    and only ever SHRINKS the capacity-derived bound.
+
+    ``per_shard=True`` is the NO-EXCHANGE variant (``colocated_*``):
+    those ops consume whatever placement the upstream shuffle left, so
+    the honest bound is the max over shards of the summed true counts
+    — the fleet mean would overflow (and pointlessly regrow) on any
+    placement skew the upstream exchange already materialised.
+
+    Returns None (caller keeps the capacity×skew default) when tight
+    sizing is off (``CYLON_TPU_TIGHT=0``), regrow is unavailable, any
+    input is poisoned (its true count is a lie), or no count source
+    exists.
+    """
+    from cylon_tpu import plan
+
+    if not enabled or not plan.tight_enabled() \
+            or not plan.adaptive_enabled():
+        return None
+    w = env.world_size
+    total = 0
+    shard_sums = None
+    for t in tables:
+        if isinstance(t.nrows, jax.core.Tracer):
+            hint = plan.current_row_hint()
+            if hint is None:
+                return None
+            return max(-(-int(hint) // w) * DEFAULT_SKEW, 1)
+        counts = _counts_memo(t)
+        cap_l = _shard_cap(t)
+        if (counts > cap_l).any():
+            return None  # poisoned input: true count unknowable
+        c = np.atleast_1d(np.minimum(np.asarray(counts), cap_l))
+        total += int(c.sum())
+        shard_sums = c if shard_sums is None else shard_sums + c
+    if per_shard:
+        # exact placement, no randomness: pow2 rounding in the caller
+        # is the only (upward) slack needed
+        return max(int(shard_sums.max()), 1)
+    est = -(-total // w)
+    # balanced-placement variance margin: hashing ~total balls into W
+    # bins overshoots the mean by O(sqrt(mean·ln W)); 4·sqrt keeps the
+    # first dispatch inside the bucket when the mean sits just under a
+    # power of two (real skew still regrows — that is the fallback's
+    # job, not the margin's)
+    return max(est + 4 * int(est ** 0.5) + 16, 1)
+
+
+def _out_cap_local(env, *tables, out_capacity=None, skew=DEFAULT_SKEW,
+                   tight_rows=None):
     if out_capacity is not None:
         return -(-out_capacity // env.world_size)
     from cylon_tpu import plan
 
     total = sum(dtable.local_capacity(t) for t in tables)
-    return total * skew * plan.current_scale()
+    scale = plan.current_scale()
+    if tight_rows is not None:
+        from cylon_tpu.utils import pow2_bucket
+
+        # the tight bucket never exceeds the old capacity×skew default
+        # (counts near capacity would otherwise pow2-round past it) and
+        # scales with the ambient regrow ladder like the default does
+        return min(pow2_bucket(tight_rows) * scale, total * skew * scale)
+    return total * skew * scale
 
 
 def _shard_cap(t: Table) -> int:
@@ -179,7 +296,9 @@ def _account_exchange_rows(label: str, args, out_counts) -> None:
             "duplicated across the collective")
 
 
-def _adaptive(build, args, adaptive: bool, conserve: str | None = None):
+def _adaptive(build, args, adaptive: bool, conserve: str | None = None,
+              op: str | None = None, tight: bool = False,
+              recv_cap=None):
     """Dispatch ``build()(*args)`` with automatic capacity regrow.
 
     The reference's exchange allocates receives as counts arrive, so any
@@ -204,35 +323,73 @@ def _adaptive(build, args, adaptive: bool, conserve: str | None = None):
     :func:`cylon_tpu.plan.compile_query` (one check for the whole
     query), or set ``CYLON_TPU_ADAPTIVE=0`` to restore round-1
     fire-and-check-at-materialisation behaviour globally.
+
+    ``op``/``tight``/``recv_cap`` carry telemetry for the
+    tight-capacity exchange path: ``exchange.tight_dispatches`` counts
+    dispatches whose bounds came from the count-driven tight bucket,
+    ``exchange.fallback_regrows`` counts the (rare) re-dispatches
+    where real skew outran the bucket, and the
+    ``exchange.headroom_ratio`` gauge records allocated/true rows of
+    the settled RECEIVE buffers — the post-shuffle capacity tax every
+    downstream local kernel pays. ``recv_cap`` is a thunk rebuilding
+    the op's per-shard receive allocation (it reads the ambient scale,
+    so it is evaluated at the settled scale); truth is the summed
+    input rows (exact for row-preserving exchanges, an upper bound for
+    pre-combining ones like the decomposable groupby). The gauge costs
+    no extra sync BY CONSTRUCTION: it only reads count memos that
+    already exist (tight sizing and row accounting fill them
+    pre-dispatch; ``_note_exchange`` back-fills for repeat calls on
+    the legacy path) and stays unset otherwise.
     """
-    from cylon_tpu import plan
+    from cylon_tpu import plan, telemetry
 
     if not plan.adaptive_enabled():
         adaptive = False
+    if tight and op is not None:
+        telemetry.counter("exchange.tight_dispatches", op=op).inc()
     scale = plan.current_scale()
     while True:
         with plan.capacity_scale(scale):
             out = build()(*args)
         if not adaptive or isinstance(out.nrows, jax.core.Tracer):
             return out
-        counts = dtable.host_counts(out)         # host sync
+        counts = _counts_memo(out)               # host sync, memoized
         cap_l = _shard_cap(out)
         if (counts <= cap_l).all():
             if conserve is not None and resilience.accounting_enabled():
                 _account_exchange_rows(conserve, args, counts)
+            if op is not None and recv_cap is not None:
+                # EXISTING memos only — the gauge must never add a
+                # host sync. Tight sizing / row accounting fill them
+                # pre-dispatch, and _note_exchange's batched fill
+                # covers later calls of the same instances on the
+                # legacy path; until then the gauge simply stays unset
+                rows_in = 0
+                for t in args:
+                    tc = t.__dict__.get("_host_counts_memo")
+                    if tc is None:
+                        rows_in = None
+                        break
+                    rows_in += int(np.minimum(tc, _shard_cap(t)).sum())
+                if rows_in:
+                    w = max(getattr(counts, "size", 1), 1)
+                    with plan.capacity_scale(scale):
+                        alloc = recv_cap() * w
+                    telemetry.gauge("exchange.headroom_ratio",
+                                    op=op).set(alloc / rows_in)
             return out
         # regrow cannot repair an INPUT that already overflowed some
         # upstream explicit bound — its data is truncated for good
         for t in args:
-            tc = dtable.host_counts(t)
+            tc = _counts_memo(t)
             if (tc > _shard_cap(t)).any():
                 raise OutOfCapacity(
                     f"input shard row counts {tc.tolist()} exceed its "
                     f"capacity — an upstream op overflowed an explicit "
                     f"out_capacity")
-        from cylon_tpu import telemetry
-
         telemetry.counter("plan.overflow_events", site="dist").inc()
+        if tight and op is not None:
+            telemetry.counter("exchange.fallback_regrows", op=op).inc()
         if scale >= plan.MAX_SCALE:
             raise OutOfCapacity(
                 f"shard row counts {counts.tolist()} still exceed local "
@@ -362,7 +519,8 @@ def _probe_hier_mid(env: CylonEnv, table: Table, key_cols,
 
 def _note_exchange(env: CylonEnv, op: str, tables,
                    bucket_cap: "int | None" = None,
-                   synced: bool = True) -> None:
+                   synced: bool = True,
+                   mid_cap: "int | None" = None) -> None:
     """Telemetry for one EAGER exchange dispatch.
 
     Records true payload bytes (valid rows x the packed u32 word
@@ -383,9 +541,10 @@ def _note_exchange(env: CylonEnv, op: str, tables,
     ``exchange.bytes_true`` simply stays 0 there and only the static
     padded-wire pricing is recorded. Skipped entirely under an outer
     trace (whole-query compilation — counts are tracers). The
-    hierarchical padded estimate prices both stages at the input
-    capacity (the stage-1 pid rider column and the probed mid capacity
-    are ignored), and ``dist_groupby``'s decomposable path exchanges
+    hierarchical padded estimate prices stage 1 at the input capacity
+    (the pid rider column is ignored) and stage 2 at ``mid_cap`` — the
+    gateway buffer stage 2 actually re-ships — when the caller probed
+    one, and ``dist_groupby``'s decomposable path exchanges
     pre-combined partials (at most one row per group per sender) while
     the pricing uses the input rows — both upper-bound approximations.
     """
@@ -399,17 +558,10 @@ def _note_exchange(env: CylonEnv, op: str, tables,
     path = ("hier" if env.is_hierarchical
             else "padded" if padded else "ragged")
     if resilience.accounting_enabled() and synced:
-        pending = [t for t in tables
-                   if "_host_counts_memo" not in t.__dict__
-                   and getattr(t.nrows, "is_fully_addressable", True)]
-        if pending:
-            # ONE batched device_get fills every missing memo: the
-            # pricing fetch costs one RPC per dispatch at most, not
-            # one per table, and repeat exchanges of the same table
-            # instances cost nothing
-            for t, c in zip(pending, jax.device_get(
-                    [t.nrows for t in pending])):
-                t.__dict__["_host_counts_memo"] = np.asarray(c)
+        # ONE batched device_get fills every missing memo: the pricing
+        # fetch costs one RPC per dispatch at most, not one per table,
+        # and repeat exchanges of the same table instances cost nothing
+        _fill_count_memos(tables)
     rows = true_b = pad_b = 0
     for t in tables:
         words = transport_words(t)
@@ -425,9 +577,16 @@ def _note_exchange(env: CylonEnv, op: str, tables,
         true_b += r * words * 4
         if padded:
             if env.is_hierarchical:
+                # stage 2 re-ships the STAGE-1 RECEIVE buffer across
+                # slices, so its wire volume follows the gateway (mid)
+                # capacity — probed from stage-1 true outputs — not the
+                # input capacity (pre-tight-sizing this overcounted the
+                # DCN leg by the full post-shuffle headroom)
                 per = (wire_rows_per_shard(env.devices_per_slice,
                                            cap_l)
-                       + wire_rows_per_shard(env.n_slices, cap_l))
+                       + wire_rows_per_shard(
+                           env.n_slices,
+                           cap_l if mid_cap is None else mid_cap))
             else:
                 per = wire_rows_per_shard(w, cap_l, bucket_cap)
             pad_b += w * per * words * 4
@@ -499,8 +658,12 @@ def shuffle(env: CylonEnv, table: Table, key_cols: Sequence[str],
             lambda: _probe_hier_mid(env, table, key_cols, partitioning,
                                     vh))
 
+    tight = _tight_rows_local(env, (table,),
+                              enabled=out_capacity is None)
+
     def build():
-        out_l = _out_cap_local(env, table, out_capacity=out_capacity)
+        out_l = _out_cap_local(env, table, out_capacity=out_capacity,
+                               tight_rows=tight)
 
         def body(t):
             lt, inof = _checked_local(t)
@@ -518,9 +681,12 @@ def shuffle(env: CylonEnv, table: Table, key_cols: Sequence[str],
         return _smap(env, body, 1)
 
     out = _adaptive(build, (table,), out_capacity is None,
-                    conserve="shuffle")
+                    conserve="shuffle", op="shuffle",
+                    tight=tight is not None,
+                    recv_cap=lambda: _out_cap_local(
+                        env, table, tight_rows=tight))
     _note_exchange(env, "shuffle", (table,), bucket_cap,
-                   synced=out_capacity is None)
+                   synced=out_capacity is None, mid_cap=mid_cap)
     return out
 
 
@@ -582,8 +748,12 @@ def repartition(env: CylonEnv, table: Table,
     ax = env.world_axes
     cap_l = dtable.local_capacity(table)
 
+    tight = _tight_rows_local(env, (table,),
+                              enabled=out_capacity is None)
+
     def build():
-        out_l = _out_cap_local(env, table, out_capacity=out_capacity)
+        out_l = _out_cap_local(env, table, out_capacity=out_capacity,
+                               tight_rows=tight)
 
         def body(t):
             lt, inof = _checked_local(t)
@@ -600,7 +770,10 @@ def repartition(env: CylonEnv, table: Table,
         return _smap(env, body, 1)
 
     out = _adaptive(build, (table,), out_capacity is None,
-                    conserve="repartition")
+                    conserve="repartition", op="repartition",
+                    tight=tight is not None,
+                    recv_cap=lambda: _out_cap_local(
+                        env, table, tight_rows=tight))
     _note_exchange(env, "repartition", (table,),
                    synced=out_capacity is None)
     return out
@@ -663,9 +836,16 @@ def dist_join(env: CylonEnv, left: Table, right: Table, *,
     w = env.world_size
     ax = env.world_axes
 
+    adaptive = out_capacity is None and shuffle_capacity is None
+    tight_l = _tight_rows_local(env, (left,), enabled=adaptive)
+    tight_r = _tight_rows_local(env, (right,), enabled=adaptive)
+
     def build():
-        shuf_l = _out_cap_local(env, left, out_capacity=shuffle_capacity)
-        shuf_r = _out_cap_local(env, right, out_capacity=shuffle_capacity)
+        shuf_l = _out_cap_local(env, left, out_capacity=shuffle_capacity,
+                                tight_rows=tight_l)
+        shuf_r = _out_cap_local(env, right,
+                                out_capacity=shuffle_capacity,
+                                tight_rows=tight_r)
         if out_capacity is None:
             join_l = shuf_l + shuf_r
         else:
@@ -689,11 +869,13 @@ def dist_join(env: CylonEnv, left: Table, right: Table, *,
 
         return _smap(env, body, 2)
 
-    out = _adaptive(build, (left, right),
-                    out_capacity is None and shuffle_capacity is None)
-    _note_exchange(env, "dist_join", (left, right),
-                   synced=out_capacity is None
-                   and shuffle_capacity is None)
+    out = _adaptive(build, (left, right), adaptive, op="dist_join",
+                    tight=tight_l is not None or tight_r is not None,
+                    recv_cap=lambda: (
+                        _out_cap_local(env, left, tight_rows=tight_l)
+                        + _out_cap_local(env, right,
+                                         tight_rows=tight_r)))
+    _note_exchange(env, "dist_join", (left, right), synced=adaptive)
     return out
 
 
@@ -726,11 +908,17 @@ def dist_groupby(env: CylonEnv, table: Table, by: Sequence[str],
     # row per sender per group), never with the caller's group-count bound
     out_l = None if out_capacity is None else -(-out_capacity // w)
     adaptive = shuffle_capacity is None and out_capacity is None
+    # tight receive bound from the input's true counts: an upper bound
+    # for BOTH paths (the decomposable shuffle ships pre-combined
+    # partials — at most one row per group per sender, never more than
+    # the raw rows priced here)
+    tight = _tight_rows_local(env, (table,), enabled=adaptive)
 
     if not decomposable:
         def build():
             shuf_l = _out_cap_local(env, table,
-                                    out_capacity=shuffle_capacity)
+                                    out_capacity=shuffle_capacity,
+                                    tight_rows=tight)
 
             def body(t):
                 lt, inof = _checked_local(t)
@@ -745,7 +933,10 @@ def dist_groupby(env: CylonEnv, table: Table, by: Sequence[str],
 
             return _smap(env, body, 1)
 
-        out = _adaptive(build, (table,), adaptive)
+        out = _adaptive(build, (table,), adaptive, op="dist_groupby",
+                        tight=tight is not None,
+                        recv_cap=lambda: _out_cap_local(
+                            env, table, tight_rows=tight))
         _note_exchange(env, "dist_groupby", (table,),
                        synced=adaptive)
         return out
@@ -754,7 +945,8 @@ def dist_groupby(env: CylonEnv, table: Table, by: Sequence[str],
     pre, final, post = _combine_plan(aggs)
 
     def build():
-        shuf_l = _out_cap_local(env, table, out_capacity=shuffle_capacity)
+        shuf_l = _out_cap_local(env, table, out_capacity=shuffle_capacity,
+                                tight_rows=tight)
 
         def body(t):
             lt, inof = _checked_local(t)
@@ -778,7 +970,10 @@ def dist_groupby(env: CylonEnv, table: Table, by: Sequence[str],
 
         return _smap(env, body, 1)
 
-    out = _adaptive(build, (table,), adaptive)
+    out = _adaptive(build, (table,), adaptive, op="dist_groupby",
+                    tight=tight is not None,
+                    recv_cap=lambda: _out_cap_local(
+                        env, table, tight_rows=tight))
     _note_exchange(env, "dist_groupby", (table,), synced=adaptive)
     return out
 
@@ -878,12 +1073,19 @@ def dist_sort(env: CylonEnv, table: Table, by: Sequence[str] | str,
     table = _prep(env, table)
     w = env.world_size
 
+    tight = _tight_rows_local(env, (table,),
+                              enabled=out_capacity is None)
+
     def build():
-        out_l = _out_cap_local(env, table, out_capacity=out_capacity)
+        out_l = _out_cap_local(env, table, out_capacity=out_capacity,
+                               tight_rows=tight)
         return _smap(env, _sort_body(env, table, by, asc0, asc, nsamp,
                                      nbins, out_l, w), 1)
 
-    out = _adaptive(build, (table,), out_capacity is None)
+    out = _adaptive(build, (table,), out_capacity is None,
+                    op="dist_sort", tight=tight is not None,
+                    recv_cap=lambda: _out_cap_local(
+                        env, table, tight_rows=tight))
     _note_exchange(env, "dist_sort", (table,),
                    synced=out_capacity is None)
     return out
@@ -1025,10 +1227,14 @@ def _dist_setop(env, a, b, local_op, out_capacity,
     w = env.world_size
     ax = env.world_axes
     out_l = None if out_capacity is None else -(-out_capacity // w)
+    tight_a = _tight_rows_local(env, (a,), enabled=out_capacity is None)
+    tight_b = _tight_rows_local(env, (b,), enabled=out_capacity is None)
 
     def build():
-        shuf_a = _out_cap_local(env, a, out_capacity=None)
-        shuf_b = _out_cap_local(env, b, out_capacity=None)
+        shuf_a = _out_cap_local(env, a, out_capacity=None,
+                                tight_rows=tight_a)
+        shuf_b = _out_cap_local(env, b, out_capacity=None,
+                                tight_rows=tight_b)
 
         def body(ta, tb):
             la, ina = _checked_local(ta)
@@ -1046,7 +1252,11 @@ def _dist_setop(env, a, b, local_op, out_capacity,
 
         return _smap(env, body, 2)
 
-    out = _adaptive(build, (a, b), out_capacity is None)
+    out = _adaptive(build, (a, b), out_capacity is None, op=opname,
+                    tight=tight_a is not None or tight_b is not None,
+                    recv_cap=lambda: (
+                        _out_cap_local(env, a, tight_rows=tight_a)
+                        + _out_cap_local(env, b, tight_rows=tight_b)))
     _note_exchange(env, opname, (a, b), synced=out_capacity is None)
     return out
 
@@ -1090,8 +1300,12 @@ def dist_unique(env: CylonEnv, table: Table,
     w = env.world_size
     ax = env.world_axes
 
+    tight = _tight_rows_local(env, (table,),
+                              enabled=out_capacity is None)
+
     def build():
-        shuf_l = _out_cap_local(env, table, out_capacity=out_capacity)
+        shuf_l = _out_cap_local(env, table, out_capacity=out_capacity,
+                                tight_rows=tight)
 
         def body(t):
             lt, inof = _checked_local(t)
@@ -1104,7 +1318,10 @@ def dist_unique(env: CylonEnv, table: Table,
 
         return _smap(env, body, 1)
 
-    out = _adaptive(build, (table,), out_capacity is None)
+    out = _adaptive(build, (table,), out_capacity is None,
+                    op="dist_unique", tight=tight is not None,
+                    recv_cap=lambda: _out_cap_local(
+                        env, table, tight_rows=tight))
     _note_exchange(env, "dist_unique", (table,),
                    synced=out_capacity is None)
     return out
@@ -1128,13 +1345,20 @@ def colocated_join(env: CylonEnv, left: Table, right: Table, *,
     left = _prep(env, left)
     right = _prep(env, right)
     w = env.world_size
+    # per_shard: there is NO exchange here — the bound must cover the
+    # hottest shard's actual placement, not the fleet mean (a skewed
+    # upstream shuffle would otherwise force pointless global regrows)
+    tight = _tight_rows_local(env, (left, right),
+                              enabled=out_capacity is None,
+                              per_shard=True)
 
     def build():
         if out_capacity is None:
-            from cylon_tpu import plan
-
-            join_l = (dtable.local_capacity(left)
-                      + dtable.local_capacity(right)) * plan.current_scale()
+            # sum-of-inputs bound (skew=1: co-located inputs were
+            # already sized by their shuffle), tightened to the true
+            # per-shard row maximum when counts are known
+            join_l = _out_cap_local(env, left, right, skew=1,
+                                    tight_rows=tight)
         else:
             join_l = -(-out_capacity // w)
 

@@ -365,6 +365,15 @@ def _exchange_hier(arrays, pid, n_local, out_cap: int,
     exchange: stage 1 groups by in-slice sender and the stable
     destination sort of stage 2 keeps that order within each
     destination-slice block.
+
+    Sizing note: stage 2 re-ships the STAGE-1 RECEIVE buffer across
+    slices, so its wire volume and compute follow ``m_cap`` — pass a
+    probed/count-driven ``mid_cap`` (``dist_ops._probe_hier_mid`` for
+    shuffles, the tight final bound for everything else, which this
+    default inherits via ``out_cap``) so both stages are sized from
+    stage-1 TRUE outputs rather than the input capacity. Before tight
+    sizing, ``out_cap``'s 2x-skew default inflated the DCN leg by the
+    full post-shuffle headroom (the 2x4 mesh's 36%-efficiency tax).
     """
     slice_ax, worker_ax = axes
     nl = jax.lax.axis_size(worker_ax)

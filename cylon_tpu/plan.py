@@ -41,7 +41,8 @@ from cylon_tpu import telemetry
 from cylon_tpu.errors import OutOfCapacity
 
 __all__ = ["capacity_scale", "current_scale", "compile_query",
-           "CompiledQuery", "MAX_SCALE", "note_overflow"]
+           "CompiledQuery", "MAX_SCALE", "note_overflow",
+           "tight_enabled", "current_row_hint", "row_hint"]
 
 #: regrow ceiling: 2^10 = 1024x the default budget. Buffers grow only as
 #: far as the retry that fits (geometric, ~10 re-dispatches worst case);
@@ -113,6 +114,46 @@ def adaptive_enabled() -> bool:
         "0", "off", "false")
 
 
+def tight_enabled() -> bool:
+    """The ONE parse of ``CYLON_TPU_TIGHT`` (default on): count-driven
+    tight-capacity sizing of defaulted exchange bounds
+    (``dist_ops._tight_rows_local``). ``CYLON_TPU_TIGHT=0`` restores
+    the unconditional ``DEFAULT_SKEW``×capacity headroom everywhere."""
+    import os
+
+    return os.environ.get("CYLON_TPU_TIGHT", "1") not in (
+        "0", "off", "false")
+
+
+#: ambient trace-time hint: a power-of-2 bucket of the compiled
+#: query's concrete TOTAL input rows. Inside the trace every row count
+#: is a tracer, so exchanges cannot size from true counts the way
+#: eager dispatches do — instead :class:`CompiledQuery` records this
+#: bucket (static, so the program retraces only when the bucket
+#: changes) and ``dist_ops._tight_rows_local`` derives a
+#: skew-buffered per-shard bound from it. Inexact for intermediates
+#: (a join can outgrow its inputs) — overflow falls back to this
+#: class's whole-program regrow ladder, exactly like any other
+#: defaulted bound.
+_ROW_HINT: contextvars.ContextVar = contextvars.ContextVar(
+    "cylon_row_hint", default=None)
+
+
+def current_row_hint() -> "int | None":
+    return _ROW_HINT.get()
+
+
+@contextlib.contextmanager
+def row_hint(rows: "int | None"):
+    """Ambient input-row bucket for defaulted exchange bounds chosen
+    while tracing (see :data:`_ROW_HINT`)."""
+    tok = _ROW_HINT.set(rows)
+    try:
+        yield
+    finally:
+        _ROW_HINT.reset(tok)
+
+
 def _result_tables(out):
     """Tables reachable in a query result (pytree of Tables/DataFrames)."""
     from cylon_tpu.table import Table
@@ -175,6 +216,26 @@ def _result_scalars(out):
 #: overflow check's batched transfer too — a later ``to_pandas`` then
 #: reads host caches instead of paying its own tunnel round trip
 _PREFETCH_TABLE_BYTES = 4 << 20
+
+
+def _input_row_bucket(dyn_pos, dyn_kw) -> "int | None":
+    """Power-of-2 bucket of the largest concrete input table's TRUE
+    total rows — the per-call static row hint tight exchange sizing
+    reads under the trace (see :data:`_ROW_HINT`). The count memo
+    plumbing (batched fill, poison rules) is
+    ``dist_ops.batched_true_rows`` — ONE home for the convention.
+    Returns None — default sizing — when there are no input tables,
+    any input is poisoned, or a count is not host-reachable."""
+    from cylon_tpu.parallel.dist_ops import batched_true_rows
+    from cylon_tpu.utils import pow2_bucket
+
+    tables = _result_tables((list(dyn_pos), dyn_kw))
+    if not tables:
+        return None
+    rows = batched_true_rows(tables)
+    if rows is None:
+        return None
+    return pow2_bucket(max(rows))
 
 
 def _check_overflow(out, bad=None) -> None:
@@ -336,7 +397,8 @@ class CompiledQuery:
         #: host caches: one tunnel round trip per call instead of three
         self._size_memo: dict = {}
 
-        def traced(scale, static_pos, static_kw, dyn_pos, **dyn_kw):
+        def traced(scale, hint, static_pos, static_kw, dyn_pos,
+                   **dyn_kw):
             import jax.numpy as jnp
 
             n = len(static_pos) + len(dyn_pos)
@@ -344,14 +406,15 @@ class CompiledQuery:
             dyn_idx = (i for i in range(n) if i not in slots)
             slots.update(zip(dyn_idx, dyn_pos))
             flags: list = []
-            with capacity_scale(scale), _collect_flags(flags):
+            with capacity_scale(scale), row_hint(hint), \
+                    _collect_flags(flags):
                 out = fn(*(slots[i] for i in range(n)),
                          **dict(static_kw), **dyn_kw)
             bad = functools.reduce(jax.numpy.logical_or, flags,
                                    jnp.zeros((), bool))
             return out, bad
 
-        self._jitted = jax.jit(traced, static_argnums=(0, 1, 2))
+        self._jitted = jax.jit(traced, static_argnums=(0, 1, 2, 3))
         # the bucket slice is a SEPARATE tiny program composed after
         # the main one (an extra async dispatch, ~free): folding it
         # into `traced` would recompile the whole query — minutes of
@@ -371,15 +434,25 @@ class CompiledQuery:
         key = (static_pos, static_kw)
         scale = self._scale_memo.get(key, 1)
         buckets = self._size_memo.get(key) if self._check else None
+        # the count-driven row bucket rides the compile key: pow2
+        # bucketing means it changes (and retraces) only when the
+        # input's true row count crosses a power of two, exactly like
+        # the capacity-scale ladder bounds its shape space. check=False
+        # queries skip the probe entirely: they promise no host sync,
+        # and with no overflow check there is no regrow ladder to
+        # repair a hint-shrunk bound that real data outgrows
+        hint = (_input_row_bucket(dyn_pos, dyn_kw)
+                if self._check and tight_enabled()
+                and adaptive_enabled() else None)
         shape_sig = tuple(
             (getattr(x, "shape", None), str(getattr(x, "dtype", "")))
             for x in jax.tree_util.tree_leaves((tuple(dyn_pos),
                                                 dyn_kw)))
         while True:
-            if (key, scale, shape_sig) not in self._compiled:
-                self._compiled.add((key, scale, shape_sig))
+            if (key, scale, hint, shape_sig) not in self._compiled:
+                self._compiled.add((key, scale, hint, shape_sig))
                 telemetry.counter("plan.compile_count").inc()
-            raw, bad = self._jitted(scale, static_pos, static_kw,
+            raw, bad = self._jitted(scale, hint, static_pos, static_kw,
                                     tuple(dyn_pos), **dyn_kw)
             if not self._check:
                 return raw

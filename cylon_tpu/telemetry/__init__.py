@@ -24,8 +24,11 @@ See ``docs/observability.md``.
 """
 
 from cylon_tpu.telemetry.aggregate import gather_metrics, merge_snapshots
-from cylon_tpu.telemetry.export import (REQUIRED_BENCH_KEYS,
-                                        bench_metrics, json_safe,
+from cylon_tpu.telemetry.export import (HBM_PEAK_BYTES_PER_SEC,
+                                        ICI_LINK_BYTES_PER_SEC,
+                                        REQUIRED_BENCH_KEYS,
+                                        bench_metrics, fraction_of_peak,
+                                        json_safe,
                                         metrics_dir, snapshot_to_json,
                                         to_prometheus, write_snapshot)
 from cylon_tpu.telemetry.registry import (BUCKET_BOUNDS, Counter, Gauge,
@@ -43,5 +46,6 @@ __all__ = [
     "total", "add_record", "get_records", "merge_snapshots",
     "gather_metrics", "json_safe", "snapshot_to_json", "to_prometheus",
     "metrics_dir", "write_snapshot", "bench_metrics",
-    "REQUIRED_BENCH_KEYS",
+    "REQUIRED_BENCH_KEYS", "HBM_PEAK_BYTES_PER_SEC",
+    "ICI_LINK_BYTES_PER_SEC", "fraction_of_peak",
 ]

@@ -23,8 +23,29 @@ import time
 __all__ = [
     "json_safe", "snapshot_to_json", "to_prometheus", "metrics_dir",
     "write_snapshot", "arm_exporters", "bench_metrics",
-    "REQUIRED_BENCH_KEYS",
+    "REQUIRED_BENCH_KEYS", "HBM_PEAK_BYTES_PER_SEC",
+    "ICI_LINK_BYTES_PER_SEC", "fraction_of_peak",
 ]
+
+# ---------------------------------------------------------------- roofline
+#: v5e per-chip HBM bandwidth (bytes/s) — the roofline every exchange
+#: bytes/s number is reported against (a shuffle that moves device rows
+#: through sort + DMA is HBM-bound before it is ICI-bound at W=1).
+HBM_PEAK_BYTES_PER_SEC = 819e9
+
+#: v5e ICI, per link, bytes/s (400 Gb/s x 4 links per chip): the peak
+#: for the per-peer streams of a multi-chip all-to-all.
+ICI_LINK_BYTES_PER_SEC = 50e9
+
+
+def fraction_of_peak(bytes_per_sec: float,
+                     peak: float = HBM_PEAK_BYTES_PER_SEC) -> float:
+    """Measured exchange bandwidth as a fraction of a hardware peak —
+    the roofline position of a bench number. Callers label which peak
+    they divided by (HBM for single-chip/self-DMA paths, ICI per-link
+    for cross-chip streams); the division itself is kept here so every
+    bench reports it the same way."""
+    return bytes_per_sec / peak if peak > 0 else 0.0
 
 
 def json_safe(x):
@@ -239,6 +260,8 @@ REQUIRED_BENCH_KEYS = (
     "exchange.bytes_true",
     "exchange.bytes_padded",
     "exchange.rows",
+    "exchange.tight_dispatches",
+    "exchange.fallback_regrows",
     "plan.overflow_events",
     "plan.capacity_rescales",
     "plan.compile_count",
@@ -254,21 +277,22 @@ def bench_metrics() -> dict:
     """Compact registry view for embedding in bench JSON records:
     every :data:`REQUIRED_BENCH_KEYS` counter summed across its label
     series (0 if never fired), the WORST (max) ``exchange.pad_ratio``
-    across its series, and per-section timer totals.
-    Strict-JSON-safe by construction."""
+    and ``exchange.headroom_ratio`` across their series, and
+    per-section timer totals. Strict-JSON-safe by construction."""
     from cylon_tpu.telemetry import registry as _r
 
     out = {k: _r.total(k) for k in REQUIRED_BENCH_KEYS}
-    ratios = []
-    for _, _, inst in _r.instruments("exchange.pad_ratio"):
-        try:  # per-value coercion: one bad gauge must not cost the
-            v = json_safe(float(inst.value))  # whole metrics block
-        except (TypeError, ValueError):
-            continue
-        if v is not None:
-            ratios.append(v)
-    if ratios:
-        out["exchange.pad_ratio"] = max(ratios)
+    for gname in ("exchange.pad_ratio", "exchange.headroom_ratio"):
+        ratios = []
+        for _, _, inst in _r.instruments(gname):
+            try:  # per-value coercion: one bad gauge must not cost
+                v = json_safe(float(inst.value))  # the whole block
+            except (TypeError, ValueError):
+                continue
+            if v is not None:
+                ratios.append(v)
+        if ratios:
+            out[gname] = max(ratios)
     sections = {}
     for _, labels, inst in _r.instruments("watchdog.section_seconds"):
         sec = labels.get("section", "?")

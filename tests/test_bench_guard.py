@@ -199,6 +199,41 @@ def test_bench_headline_record_carries_metrics(capsys):
     assert "metrics" in rec and isinstance(rec["metrics"], dict)
 
 
+def test_required_bench_keys_pin_tight_capacity_counters():
+    """ISSUE 4 satellite: the tight-exchange counters are part of the
+    pinned schema — a future PR cannot drop them from the trajectory."""
+    from cylon_tpu.telemetry import REQUIRED_BENCH_KEYS
+
+    assert {"exchange.tight_dispatches",
+            "exchange.fallback_regrows"} <= set(REQUIRED_BENCH_KEYS)
+
+
+def test_headline_schema_pins_roofline_fields():
+    """bench.py's headline record must keep the bytes/s +
+    fraction-of-peak roofline columns (main() asserts the set before
+    emitting, so this pin is enforced at bench runtime too)."""
+    import bench
+
+    assert {"exchange_bytes_per_sec",
+            "fraction_of_hbm_peak",
+            "exchange_note"} <= bench.REQUIRED_HEADLINE_FIELDS
+
+
+def test_bench_metrics_carries_headroom_gauge():
+    """The worst exchange.headroom_ratio across series rides the
+    metrics block exactly like pad_ratio (and non-finite values are
+    dropped, never exported)."""
+    from cylon_tpu import telemetry
+    from cylon_tpu.telemetry import bench_metrics
+
+    telemetry.reset("exchange.headroom_ratio")
+    assert "exchange.headroom_ratio" not in bench_metrics()
+    telemetry.gauge("exchange.headroom_ratio", op="a").set(1.25)
+    telemetry.gauge("exchange.headroom_ratio", op="b").set(float("nan"))
+    assert bench_metrics()["exchange.headroom_ratio"] == 1.25
+    telemetry.reset("exchange.headroom_ratio")
+
+
 def test_checker_accepts_closures_and_comprehensions(tmp_path):
     p = tmp_path / "ok.py"
     p.write_text(

@@ -188,6 +188,7 @@ def test_dist_aggregates(env8, rng):
     assert int(dist_aggregate(env8, dt, "v", "nunique")) == df["v"].nunique()
 
 
+@pytest.mark.slow  # 10M-row sketch: the small/edge variant pins tier-1
 def test_sketch_quantile_error_bounded_10m(env8):
     """exact=False median/quantile: fixed-size mergeable sketch instead
     of the full-column all_gather (VERDICT r2 weak #3). Error bound is

@@ -11,10 +11,17 @@ _EX = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "examples")
 
 
-@pytest.mark.parametrize("script", ["dataframe_ops.py", "catalog_ffi.py",
-                                    "op_graph.py", "distributed_join.py",
-                                    "tpch_demo.py", "whole_query.py",
-                                    "scale_out.py"])
+#: the heavyweight integration examples (full TPC-H demo, 8-way mesh
+#: pipelines) are `slow`: each is a fresh-interpreter subprocess worth
+#: 20-50 s of wall, and tier-1 keeps the fast smoke examples plus the
+#: same code paths via the in-process distributed tests
+@pytest.mark.parametrize("script", [
+    "dataframe_ops.py", "catalog_ffi.py", "whole_query.py",
+    pytest.param("op_graph.py", marks=pytest.mark.slow),
+    pytest.param("distributed_join.py", marks=pytest.mark.slow),
+    pytest.param("tpch_demo.py", marks=pytest.mark.slow),
+    pytest.param("scale_out.py", marks=pytest.mark.slow),
+])
 def test_example_runs(script):
     env = dict(os.environ)
     env.pop("CYLON_EXAMPLES_TPU", None)

@@ -55,8 +55,11 @@ def test_fuzz_join_groupby_sort(env8, henv, seed):
         columns={"f": "g", "i": "j", "s": "t"})
 
     for env in (env8, henv):
-        lt = Table.from_pandas(lp)
-        rt = Table.from_pandas(rp)
+        # fixed pow2 capacity: every seed shares one buffer shape, so
+        # the dist programs compile once per (env, op, how) instead of
+        # once per random row count — same coverage, ~half the wall
+        lt = Table.from_pandas(lp).with_capacity(1024)
+        rt = Table.from_pandas(rp).with_capacity(1024)
 
         how = ["inner", "left", "outer"][seed % 3]
         got = dist_to_pandas(env, dist_join(env, lt, rt, on="k", how=how))

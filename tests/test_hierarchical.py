@@ -181,6 +181,7 @@ def test_collectives_default_spans_hierarchical_world(henv, env8):
         assert np.asarray(bors).tolist() == [255] * env.world_size
 
 
+@pytest.mark.slow  # ~20 s: hier staging is pinned by the join/shuffle parity tests
 def test_hier_streaming_graph(henv, rng):
     """The streaming op-graph's per-chunk mesh exchange rides the
     two-stage hierarchical shuffle transparently."""
@@ -221,19 +222,13 @@ def test_hier_compiled_query(henv, rng):
     np.testing.assert_allclose(got, want)
 
 
-def test_hier_gateway_concentration_no_regrow(henv, rng):
-    """Gateway concentration: slice 0's traffic leans on local worker
-    index 2 (dests {2, 6}) while final per-destination loads still fit
-    the scale-1 output buffer. Stage 1 funnels 900 rows through gateway
-    (slice 0, worker 2) — 1.5x the 600-row output capacity — so r3
-    (stage-1 buffer = out_cap) poisoned and regrew EVERY buffer 2x;
-    the eager stage-1 probe (``dist_ops._probe_hier_mid``) must size
-    the gateway buffer alone and complete at capacity scale 1 (VERDICT
-    r3 weak #5)."""
+def _gateway_concentration_keys(henv, rng):
+    """Keys whose slice-0 traffic leans on local worker index 2 (dests
+    {2, 6}) while the final per-destination loads still fit a 600-row
+    scale-1 legacy buffer; returns (keys, n, out_l)."""
     import jax.numpy as jnp
 
     from cylon_tpu.ops.hash import partition_ids
-    from cylon_tpu.parallel import dtable
     from cylon_tpu.parallel.dist_ops import DEFAULT_SKEW
 
     cand = np.arange(200_000, dtype=np.int64)
@@ -254,7 +249,22 @@ def test_hier_gateway_concentration_no_regrow(henv, rng):
     gw02 = ((np.asarray(partition_ids([jnp.asarray(keys[:1200])], 8))
              % 4) == 2).sum()
     assert gw02 > out_l, gw02
+    return keys, n, out_l
 
+
+def test_hier_gateway_concentration_no_regrow(henv, rng, monkeypatch):
+    """Gateway concentration: stage 1 funnels 900 rows through gateway
+    (slice 0, worker 2) — 1.5x the 600-row output capacity — so r3
+    (stage-1 buffer = out_cap) poisoned and regrew EVERY buffer 2x;
+    the eager stage-1 probe (``dist_ops._probe_hier_mid``) must size
+    the gateway buffer alone and complete at capacity scale 1 (VERDICT
+    r3 weak #5). Pinned to the legacy skew sizing: the probe contract
+    is orthogonal to ISSUE 4's count-driven buckets, and the final
+    capacity this test asserts is the skew formula's."""
+    from cylon_tpu.parallel import dtable
+
+    monkeypatch.setenv("CYLON_TPU_TIGHT", "0")
+    keys, n, out_l = _gateway_concentration_keys(henv, rng)
     t = Table.from_pydict({"k": keys, "v": np.arange(n, dtype=np.int64)})
     res = shuffle(henv, t, ["k"])
     assert dist_num_rows(res) == n
@@ -264,3 +274,27 @@ def test_hier_gateway_concentration_no_regrow(henv, rng):
     # (stage-1's probed gateway buffer is allowed to be larger)
     assert dtable.local_capacity(res) == out_l, (
         dtable.local_capacity(res), out_l)
+
+
+def test_hier_gateway_concentration_tight_default(henv, rng):
+    """The SAME shape under the default count-driven sizing: the
+    600-row final load overshoots the balanced bucket
+    (pow2(300+margin)=512), so the documented fallback fires — at most
+    ONE doubling, buffers bounded by 2x the bucket — and the result
+    stays exact. This pins the worst-case cost of tight sizing on
+    moderately skewed loads (docs/capacity.md: one re-dispatch, never
+    silent loss), alongside the legacy-path guarantee above."""
+    from cylon_tpu import telemetry
+    from cylon_tpu.parallel import dtable
+
+    keys, n, out_l = _gateway_concentration_keys(henv, rng)
+    before = telemetry.total("exchange.fallback_regrows")
+    t = Table.from_pydict({"k": keys, "v": np.arange(n, dtype=np.int64)})
+    res = shuffle(henv, t, ["k"])
+    assert dist_num_rows(res) == n
+    got = dist_to_pandas(henv, res).sort_values(["k", "v"])
+    assert (got["k"].to_numpy() == np.sort(keys)).all()
+    regrows = telemetry.total("exchange.fallback_regrows") - before
+    assert regrows <= 1, regrows
+    assert dtable.local_capacity(res) <= 2 * 512, \
+        dtable.local_capacity(res)

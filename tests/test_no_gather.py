@@ -47,6 +47,7 @@ def test_q3_zero_input_gathers(data, env8):
     assert len(got) <= 10
 
 
+@pytest.mark.slow  # ~30 s: the 5-way dist join; q3 pins the contract in tier-1
 def test_q5_zero_input_gathers(data, env8):
     with gather_log() as log:
         out = q5(data, env=env8)

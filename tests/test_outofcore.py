@@ -77,6 +77,7 @@ def test_csv_chunks_ragged_tail(csv_files, tmp_path):
     assert all(c.capacity == 4 for c in chunks)
 
 
+@pytest.mark.slow  # ~20 s: per-chunk dist shuffle; the parquet/groupby variants stay tier-1
 def test_streaming_dist_join_from_files(csv_files, env8):
     """File → chunk → per-chunk mesh shuffle → shard-local join: the
     dataset (N rows) never exists as one local buffer — the largest

@@ -437,7 +437,10 @@ def test_snapshot_after_dist_join_reports_exchange_and_sections(env8, rng):
         {"k": rng.integers(0, 64, n), "a": rng.normal(size=n)}))
     rt = scatter_table(env8, Table.from_pydict(
         {"k": rng.integers(0, 64, n), "b": rng.normal(size=n)}))
-    dist_join(env8, lt, rt, on="k", how="inner", out_capacity=16 * n)
+    # defaulted capacities: the adaptive (synced) dispatch is the one
+    # that prices bytes_true — an explicit out_capacity is the
+    # documented no-sync escape hatch and stays at bytes_true == 0
+    dist_join(env8, lt, rt, on="k", how="inner")
     snap = telemetry.snapshot()
     assert telemetry.total("exchange.bytes_true") > 0
     assert telemetry.total("exchange.bytes_padded") > 0

@@ -1,0 +1,317 @@
+"""Tight-capacity exchange path (ISSUE 4): count-driven receive bounds.
+
+The reference's async all-to-all receives exactly the bytes each peer
+sends (``net/ops/all_to_all.hpp``); the static-shape port used to
+allocate every post-shuffle buffer at ``DEFAULT_SKEW=2`` headroom
+instead, so every local kernel after an exchange ran on ~2x the real
+rows. These tests pin the replacement contract:
+
+- balanced data dispatches at the count-driven power-of-2 bucket and
+  the ``exchange.headroom_ratio`` gauge lands below 2.0;
+- skew beyond the bucket trips overflow -> the existing regrow ladder
+  (``exchange.fallback_regrows``), with results byte-identical to the
+  pre-tight sizing and to the pandas oracle — no silent row loss;
+- an explicit ``out_capacity`` bypasses the count probe entirely (the
+  documented no-sync latency escape hatch);
+- row-accounting invariants (``CYLON_TPU_ROW_ACCOUNTING``) hold on the
+  tight path;
+- the hierarchical (slice x worker) mesh gets tight sizing at both
+  stages;
+- compiled queries key their programs on the pow2 input-row bucket
+  (``plan._input_row_bucket``) and retrace only when it changes.
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import cylon_tpu as ct
+from cylon_tpu import Table, telemetry
+from cylon_tpu.parallel import (dist_join, dist_to_pandas, dtable,
+                                repartition, scatter_table, shuffle)
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+def _sorted(df, by):
+    return df.sort_values(by).reset_index(drop=True)
+
+
+# -------------------------------------------------- balanced: tight wins
+def test_balanced_shuffle_headroom_below_two(env8, rng):
+    """Uniform keys: the count-driven bucket replaces the 2x skew
+    default, the dispatch sticks (no fallback), and the post-shuffle
+    headroom — allocated/true rows, what every downstream kernel
+    pays — is demonstrably below 2.0 (ISSUE 4 acceptance)."""
+    n = 60_000
+    t = Table.from_pydict({"k": rng.integers(0, n, n).astype(np.int64),
+                           "v": rng.normal(size=n)})
+    s = shuffle(env8, t, ["k"])
+    assert dtable.dist_num_rows(s) == n
+    assert telemetry.total("exchange.tight_dispatches") >= 1
+    assert telemetry.total("exchange.fallback_regrows") == 0
+    hr = telemetry.metric("exchange.headroom_ratio", op="shuffle")
+    assert hr is not None and float(hr.value) < 2.0
+    # the receive buffer itself is tighter than the old 2x default
+    assert dtable.local_capacity(s) < 2 * dtable.local_capacity(
+        scatter_table(env8, t))
+
+
+def test_balanced_dist_join_headroom(env8, rng):
+    n = 40_000
+    k1 = rng.integers(0, n, n).astype(np.int64)
+    k2 = rng.integers(0, n, n).astype(np.int64)
+    a = rng.normal(size=n)
+    b = rng.normal(size=n)
+    j = dist_join(env8, Table.from_pydict({"k": k1, "a": a}),
+                  Table.from_pydict({"k": k2, "b": b}),
+                  on="k", how="inner")
+    got = dist_to_pandas(env8, j)
+    exp = pd.DataFrame({"k": k1, "a": a}).merge(
+        pd.DataFrame({"k": k2, "b": b}), on="k")
+    pd.testing.assert_frame_equal(_sorted(got, ["k", "a", "b"]),
+                                  _sorted(exp, ["k", "a", "b"]))
+    assert telemetry.total("exchange.tight_dispatches") >= 1
+
+
+# ----------------------------------------------- skew: regrow fallback
+def test_skew_beyond_bucket_regrows_and_conserves_rows(env8, rng):
+    """~70% of rows share one key: the hot shard's true receive far
+    exceeds the balanced bucket — the dispatch must overflow into the
+    regrow ladder (counted as ``exchange.fallback_regrows``) and land
+    on exactly the input rows (row accounting is on by default, so a
+    silent drop would raise DataLossError before the assert)."""
+    n = 20_000
+    k = np.where(rng.random(n) < 0.7, 7,
+                 rng.integers(0, 1_000_000, n)).astype(np.int64)
+    v = rng.normal(size=n)
+    t = Table.from_pydict({"k": k, "v": v})
+    s = shuffle(env8, t, ["k"])
+    assert dtable.dist_num_rows(s) == n
+    assert telemetry.total("exchange.fallback_regrows") >= 1
+    got = dist_to_pandas(env8, s)
+    exp = pd.DataFrame({"k": k, "v": v})
+    pd.testing.assert_frame_equal(_sorted(got, ["k", "v"]),
+                                  _sorted(exp, ["k", "v"]))
+
+
+def test_tight_vs_legacy_results_identical(env8, rng, monkeypatch):
+    """CYLON_TPU_TIGHT=0 restores the unconditional 2x sizing; the
+    shuffled content must be identical either way (sizing is an
+    allocation policy, never a semantics change)."""
+    n = 8_192
+    k = np.where(rng.random(n) < 0.5, 3,
+                 rng.integers(0, 10_000, n)).astype(np.int64)
+    v = rng.normal(size=n)
+    t1 = Table.from_pydict({"k": k, "v": v})
+    t2 = Table.from_pydict({"k": k, "v": v})
+    tight = dist_to_pandas(env8, shuffle(env8, t1, ["k"]))
+    monkeypatch.setenv("CYLON_TPU_TIGHT", "0")
+    legacy = dist_to_pandas(env8, shuffle(env8, t2, ["k"]))
+    pd.testing.assert_frame_equal(tight, legacy)
+
+
+def test_explicit_capacity_overflow_still_raises(env8, rng):
+    """The raise-on-overflow contract of explicit capacities is
+    untouched by tight sizing (tight only ever applies to ADAPTIVE
+    dispatches)."""
+    from cylon_tpu.errors import OutOfCapacity
+
+    n = 4_096
+    t = Table.from_pydict({"k": np.zeros(n, np.int64),
+                           "v": rng.normal(size=n)})
+    s = shuffle(env8, t, ["k"], out_capacity=n // 2)
+    with pytest.raises(OutOfCapacity):
+        dtable.dist_num_rows(s)
+
+
+# ------------------------------------------- explicit capacity: no probe
+def test_explicit_capacity_bypasses_count_probe(env8, rng):
+    """An explicit out_capacity is the documented no-sync escape hatch:
+    no per-shard count fetch happens (no memo appears on the input)
+    and no tight dispatch is recorded."""
+    n = 4_096
+    t = scatter_table(env8, Table.from_pydict(
+        {"k": rng.integers(0, n, n).astype(np.int64),
+         "v": rng.normal(size=n)}))
+    s = shuffle(env8, t, ["k"], out_capacity=4 * n)
+    assert "_host_counts_memo" not in t.__dict__
+    assert telemetry.total("exchange.tight_dispatches") == 0
+    assert dtable.dist_num_rows(s) == n  # the result is still exact
+
+
+def test_tight_knob_off_disables_count_sizing(env8, rng, monkeypatch):
+    monkeypatch.setenv("CYLON_TPU_TIGHT", "0")
+    n = 4_096
+    t = Table.from_pydict({"k": rng.integers(0, n, n).astype(np.int64),
+                           "v": rng.normal(size=n)})
+    s = shuffle(env8, t, ["k"])
+    assert dtable.dist_num_rows(s) == n
+    assert telemetry.total("exchange.tight_dispatches") == 0
+    # legacy sizing: the full DEFAULT_SKEW x capacity receive buffer
+    assert dtable.local_capacity(s) == 2 * dtable.local_capacity(
+        scatter_table(env8, t))
+
+
+# ------------------------------------------------------- row accounting
+def test_row_accounting_holds_on_tight_path(env8, rng, monkeypatch):
+    """CYLON_TPU_ROW_ACCOUNTING=1 must pass its rows-in == rows-out
+    invariant through tight-capacity shuffles AND repartitions (a
+    sizing bug that dropped rows would raise DataLossError here)."""
+    monkeypatch.setenv("CYLON_TPU_ROW_ACCOUNTING", "1")
+    n = 30_000
+    t = Table.from_pydict({"k": rng.integers(0, 500, n).astype(np.int64),
+                           "v": rng.normal(size=n)})
+    s = shuffle(env8, t, ["k"])
+    assert dtable.dist_num_rows(s) == n
+    r = repartition(env8, s)
+    assert dtable.dist_num_rows(r) == n
+    counts = dtable.host_counts(r)
+    assert counts.max() - counts.min() <= 1  # round-robin rebalanced
+
+
+# ------------------------------------------------- hierarchical stages
+def test_hier_mesh_tight_both_stages(rng):
+    """2x4 (slice x worker) mesh: the stage-1 gateway buffer rides the
+    probed mid capacity and the stage-2/final receive rides the
+    count-driven bucket — results exact, headroom below 2.0 at the
+    final stage (the 36%-efficiency mesh's fix, ISSUE 4 satellite)."""
+    env = ct.CylonEnv(ct.TPUConfig(devices_per_slice=4))
+    assert env.is_hierarchical
+    n = 40_000
+    k = rng.integers(0, n, n).astype(np.int64)
+    v = rng.normal(size=n)
+    t = Table.from_pydict({"k": k, "v": v})
+    s = shuffle(env, t, ["k"])
+    assert dtable.dist_num_rows(s) == n
+    hr = telemetry.metric("exchange.headroom_ratio", op="shuffle")
+    assert hr is not None and float(hr.value) < 2.0
+    got = dist_to_pandas(env, s)
+    pd.testing.assert_frame_equal(
+        _sorted(got, ["k", "v"]),
+        _sorted(pd.DataFrame({"k": k, "v": v}), ["k", "v"]))
+
+
+def test_hier_mesh_skew_regrows(rng):
+    env = ct.CylonEnv(ct.TPUConfig(devices_per_slice=4))
+    n = 10_000
+    k = np.where(rng.random(n) < 0.6, 11,
+                 rng.integers(0, 1_000_000, n)).astype(np.int64)
+    t = Table.from_pydict({"k": k, "v": rng.normal(size=n)})
+    s = shuffle(env, t, ["k"])
+    assert dtable.dist_num_rows(s) == n
+
+
+def test_colocated_join_skewed_placement_first_dispatch(env8, rng):
+    """colocated_join has NO exchange: its tight bound must cover the
+    hottest shard's ACTUAL placement (per-shard max, not the fleet
+    mean), so a skewed upstream shuffle joins on the first dispatch —
+    no regrow — and stays exact."""
+    from cylon_tpu.parallel import colocated_join
+
+    n = 20_000
+    # placement skew WITHOUT join blowup: ~60% of left rows share one
+    # key (one shard holds far more than total/W rows after the
+    # shuffle), while the right side is unique-keyed so the join
+    # output stays ~linear
+    k = np.where(rng.random(n) < 0.6, 7,
+                 rng.integers(8, 1_000_000, n)).astype(np.int64)
+    rk = np.arange(n, dtype=np.int64)
+    lt = shuffle(env8, Table.from_pydict(
+        {"k": k, "a": rng.normal(size=n)}), ["k"])
+    rt = shuffle(env8, Table.from_pydict(
+        {"k": rk, "b": rng.normal(size=n)}), ["k"])
+    before = telemetry.total("plan.overflow_events")
+    j = colocated_join(env8, lt, rt, on="k", how="inner")
+    got = dtable.dist_num_rows(j)
+    assert got == int(np.isin(k, rk).sum())
+    assert telemetry.total("plan.overflow_events") == before
+
+
+def test_check_false_compiled_query_skips_count_probe(rng):
+    """compile_query(check=False) promises no host sync and has no
+    regrow ladder — the row-hint probe must not run (no count memo
+    appears on the inputs, and sizing stays at the legacy default)."""
+    from cylon_tpu.ops.selection import sort_table
+    from cylon_tpu.plan import compile_query
+
+    @compile_query(check=False)
+    def q(t):
+        return sort_table(t, ["k"])
+
+    t = Table.from_pydict({"k": rng.integers(0, 100, 512).astype(np.int64)})
+    out = q(t)
+    assert "_host_counts_memo" not in t.__dict__
+    assert out.num_rows == 512
+
+
+# ------------------------------------------------- compiled-query hint
+def test_input_row_bucket_reads_memoized_counts(env8, rng):
+    from cylon_tpu import plan
+
+    t = Table.from_pydict({"k": np.arange(1000, dtype=np.int64)})
+    assert plan._input_row_bucket((t,), {}) == 1024
+    dt = scatter_table(env8, Table.from_pydict(
+        {"k": np.arange(600, dtype=np.int64)}))
+    assert plan._input_row_bucket((dt,), {}) == 1024
+    assert plan._input_row_bucket((t, dt), {}) == 1024  # max, not sum
+    assert plan._input_row_bucket((), {}) is None
+    # poisoned input (nrows beyond capacity): sizing from it would lie
+    bad = t.with_nrows(t.capacity + 1)
+    assert plan._input_row_bucket((bad,), {}) is None
+
+
+def test_compiled_query_retraces_only_on_bucket_change(rng):
+    """Same static shapes, true rows moving WITHIN one pow2 bucket must
+    reuse the compiled program; crossing the bucket boundary retraces
+    once (the 'retrace only on bucket change' contract)."""
+    from cylon_tpu.ops.selection import sort_table
+    from cylon_tpu.plan import compile_query
+
+    @compile_query
+    def q(t):
+        return sort_table(t, ["k"])
+
+    def make(nrows):
+        k = rng.integers(0, 1000, nrows).astype(np.int64)
+        return Table.from_pydict({"k": k}, capacity=4096)
+
+    before = telemetry.total("plan.compile_count")
+    q(make(1000))
+    first = telemetry.total("plan.compile_count") - before
+    assert first >= 1
+    q(make(900))       # same 1024 bucket: no new program
+    assert telemetry.total("plan.compile_count") - before == first
+    q(make(2000))      # 2048 bucket: exactly one retrace
+    assert telemetry.total("plan.compile_count") - before == first + 1
+    q(make(1500))      # back inside 2048: cached
+    assert telemetry.total("plan.compile_count") - before == first + 1
+
+
+def test_compiled_query_with_dist_ops_uses_hint(env8, rng):
+    """Whole-query compilation over distributed ops: counts are tracers
+    inside the trace, so exchange sizing rides the recorded input-row
+    bucket; results stay exact."""
+    from cylon_tpu.plan import compile_query
+
+    @compile_query
+    def q(l, r):
+        return dist_join(env8, l, r, on="k", how="inner")
+
+    n = 4_000
+    k1 = rng.integers(0, n, n).astype(np.int64)
+    k2 = rng.integers(0, n, n).astype(np.int64)
+    a = rng.normal(size=n)
+    b = rng.normal(size=n)
+    out = q(Table.from_pydict({"k": k1, "a": a}),
+            Table.from_pydict({"k": k2, "b": b}))
+    got = dist_to_pandas(env8, out)
+    exp = pd.DataFrame({"k": k1, "a": a}).merge(
+        pd.DataFrame({"k": k2, "b": b}), on="k")
+    pd.testing.assert_frame_equal(_sorted(got, ["k", "a", "b"]),
+                                  _sorted(exp, ["k", "a", "b"]))

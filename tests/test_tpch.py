@@ -504,6 +504,7 @@ def q11_pandas(pdfs, nation="GERMANY", fraction=0.0001):
 from cylon_tpu.tpch.queries import q7, q8, q9, q11  # noqa: E402
 
 
+@pytest.mark.slow  # heaviest oracle walls; full runs still cover every query
 def test_q7(data, pdfs, env4):
     want = q7_pandas(pdfs)
     assert len(want) > 0
@@ -511,6 +512,7 @@ def test_q7(data, pdfs, env4):
     _frame_close(q7(data, env=env4).to_pandas(), want, {"revenue"})
 
 
+@pytest.mark.slow  # heaviest oracle walls; full runs still cover every query
 def test_q8(data, pdfs, env4):
     # tiny sf: the spec's single part type may select zero parts; use
     # the most frequent generated type so the share is well-defined
@@ -522,6 +524,7 @@ def test_q8(data, pdfs, env4):
                  {"mkt_share"})
 
 
+@pytest.mark.slow  # heaviest oracle walls; full runs still cover every query
 def test_q9(data, pdfs, env4):
     want = q9_pandas(pdfs)
     assert len(want) > 0
@@ -675,6 +678,7 @@ from cylon_tpu.tpch.queries import (  # noqa: E402
     q2, q13, q15, q16, q17, q20, q21, q22)
 
 
+@pytest.mark.slow  # heaviest oracle walls; full runs still cover every query
 def test_q2(data, pdfs, env4):
     # tiny sf: widen the size/type filter so rows survive
     want = q2_pandas(pdfs, size=int(pdfs["part"].p_size.iloc[0]),
@@ -735,6 +739,7 @@ def test_q17(data, pdfs, env4):
         rtol=1e-9)
 
 
+@pytest.mark.slow  # heaviest oracle walls; full runs still cover every query
 def test_q20(data, pdfs, env4):
     # tiny sf: any color prefix keeps rows; use the generated mode
     color = pdfs["part"].p_name.str.split().str[0].mode()[0]
@@ -744,6 +749,7 @@ def test_q20(data, pdfs, env4):
                  set())
 
 
+@pytest.mark.slow  # heaviest oracle walls; full runs still cover every query
 def test_q21(data, pdfs, env4):
     # tiny sf: pick the modal supplier nation so the filter keeps rows
     nk = pdfs["supplier"].s_nationkey.mode()[0]
