@@ -32,10 +32,22 @@ pipeline (the previous headline) is reported alongside as
 ``local_path_rows_per_sec``.
 
 Emits ONE json line: {"metric", "value", "unit", "vs_baseline", ...}.
+
+``--trace`` additionally runs the headline join ONCE through the
+instrumented eager ``dist_join`` path with the flight recorder armed
+(``CYLON_TPU_TRACE`` — the pipelined headline hand-rolls its shard_map
+and bypasses the recorder by construction), writes the Chrome Trace
+Event artifact next to the bench record
+(``CYLON_BENCH_TRACE_PATH``, default ``bench.trace.json`` — open in
+Perfetto / ``chrome://tracing``) and pins its path + event count +
+rank-track count + per-stage wall coverage into the JSON record
+(:data:`REQUIRED_TRACE_FIELDS`, schema enforced by
+``tests/test_bench_guard.py``).
 """
 
 import json
 import os
+import sys
 import time
 
 import numpy as np
@@ -194,6 +206,71 @@ REQUIRED_HEADLINE_FIELDS = frozenset({
     "exchange_bytes_per_sec", "fraction_of_hbm_peak", "exchange_note",
 })
 
+#: fields a ``--trace`` run must pin into the headline record — the
+#: artifact is only auditable if the record says where it is and how
+#: much it holds (``tests/test_bench_guard.py`` pins this set).
+REQUIRED_TRACE_FIELDS = frozenset({
+    "trace_path", "trace_events", "trace_rank_tracks",
+    "trace_stage_coverage",
+})
+
+
+def _traced_headline_join(n: int, rng) -> dict:
+    """One eager ``dist_join`` over every visible device with the
+    flight recorder armed; writes the Chrome-trace artifact and returns
+    the :data:`REQUIRED_TRACE_FIELDS` block for the headline record.
+
+    Runs the INSTRUMENTED ``parallel.dist_ops`` path (stage spans,
+    exchange instants with true/padded bytes, per-shard row counter
+    tracks), unlike the pipelined headline which fuses its own
+    shard_map. ``CYLON_TPU_FORCE_DIST`` keeps the exchange path live on
+    a W=1 mesh (the real-chip default), so the artifact always carries
+    exchange slices."""
+    # force-arm: an inherited CYLON_TPU_TRACE=0/off must not make the
+    # explicit --trace flag silently record nothing
+    if os.environ.get("CYLON_TPU_TRACE", "") in ("", "0", "off"):
+        os.environ["CYLON_TPU_TRACE"] = "1"
+
+    import cylon_tpu as ct
+    from cylon_tpu import Table, telemetry
+    from cylon_tpu.parallel import dist_join, scatter_table
+    from cylon_tpu.telemetry import trace
+
+    env = ct.CylonEnv(ct.TPUConfig())
+    lt = scatter_table(env, Table.from_pydict({
+        "k": rng.integers(0, n, n).astype(np.int64),
+        "a": rng.normal(size=n)}))
+    rt = scatter_table(env, Table.from_pydict({
+        "k": rng.integers(0, n, n).astype(np.int64),
+        "b": rng.normal(size=n)}))
+    trace.clear()
+    # FORCE_DIST only for THIS join (restored after): the exchange path
+    # must run even on a W=1 real chip, but later suite legs must keep
+    # their configured world==1 short-circuit semantics
+    prev_force = os.environ.get("CYLON_TPU_FORCE_DIST")
+    os.environ["CYLON_TPU_FORCE_DIST"] = "1"
+    try:
+        dist_join(env, lt, rt, on="k", how="inner")
+    finally:
+        if prev_force is None:
+            os.environ.pop("CYLON_TPU_FORCE_DIST", None)
+        else:
+            os.environ["CYLON_TPU_FORCE_DIST"] = prev_force
+    evts = trace.events()
+    coverage = trace.stage_coverage(evts, "dist_join")
+    path = os.environ.get("CYLON_BENCH_TRACE_PATH", "bench.trace.json")
+    doc = telemetry.to_chrome_trace(trace.rank_buffers(env),
+                                    world=env.world_size)
+    telemetry.write_chrome_trace(path, doc)
+    pids = {e.get("pid") for e in doc["traceEvents"]}
+    return {
+        "trace_path": os.path.abspath(path),
+        "trace_events": len(evts),
+        "trace_rank_tracks": len(pids),
+        "trace_stage_coverage": (round(coverage, 4)
+                                 if coverage is not None else None),
+    }
+
 
 def _emit_record(line: dict):
     """Single stdout sink for the headline JSON record: attaches the
@@ -212,6 +289,8 @@ def _emit_record(line: dict):
 
 
 def main():
+    do_trace = "--trace" in sys.argv[1:] or os.environ.get(
+        "CYLON_BENCH_TRACE", "") not in ("", "0", "off")
     n = int(os.environ.get("CYLON_BENCH_ROWS", 1_000_000))
     reps = int(os.environ.get("CYLON_BENCH_REPS", 5))
     depth = int(os.environ.get("CYLON_BENCH_PIPELINE", 12))
@@ -248,6 +327,10 @@ def main():
                           "bytes/s is against the HBM roofline "
                           "(819 GB/s/chip), not ICI"),
     }
+    if do_trace:
+        record.update(_traced_headline_join(n, rng))
+        missing_t = REQUIRED_TRACE_FIELDS - record.keys()
+        assert not missing_t, f"trace record dropped fields {missing_t}"
     missing = REQUIRED_HEADLINE_FIELDS - record.keys()
     assert not missing, f"headline record dropped fields {missing}"
     _emit_record(record)

@@ -12,6 +12,9 @@ suite covers all five configs for broader tracking:
 Scale knobs: CYLON_BENCH_ROWS (default 1M), CYLON_BENCH_TPCH_SF
 (default 0.1), CYLON_BENCH_REPS (default 3). Distributed configs run
 over every visible device (1 real chip under axon; N with a mesh).
+``--trace`` arms the flight recorder (``CYLON_TPU_TRACE``, inherited
+by spawned children) and appends a ``trace_artifact`` record pointing
+at the Chrome Trace JSON written next to the records.
 
 The EXCHANGE section (``--exchange``, also spawned automatically at the
 end of a full run) times the multi-device shuffle/dist_join paths on an
@@ -212,9 +215,27 @@ def main():
     child_env = dict(os.environ)
     child_env["XLA_FLAGS"] = (child_env.get("XLA_FLAGS", "")
                               + " --xla_force_host_platform_device_count=8")
+    # tracing parent: the child does the actual exchange dispatches, so
+    # it gets --trace and its OWN artifact path (the epilogue runs in
+    # the child); without the flag the inherited armed recorder would
+    # buffer events nobody exports
+    from cylon_tpu.telemetry import trace as _tr
+
+    tracing_child = _tr.enabled()
+    if tracing_child:
+        # a DISTINCT path: sharing the parent's would let the parent's
+        # end-of-suite artifact overwrite the child's
+        base = os.environ.get("CYLON_BENCH_TRACE_PATH",
+                              "bench_suite.trace.json")
+        root = base[:-5] if base.endswith(".json") else base
+        child_env["CYLON_BENCH_TRACE_PATH"] = root + ".exchange.json"
+    else:
+        child_env.pop("CYLON_TPU_TRACE", None)
     try:
         subprocess.run([sys.executable, os.path.abspath(__file__),
-                        "--exchange"], env=child_env, check=False,
+                        "--exchange"]
+                       + (["--trace"] if tracing_child else []),
+                       env=child_env, check=False,
                        timeout=_subproc_timeout())
     except subprocess.TimeoutExpired:
         # recorded DNF for the leg; the rest of the suite already ran
@@ -473,6 +494,11 @@ def _spawn_sentinel(flag, extra_env=None):
     child_env = dict(os.environ)
     child_env.update(extra_env or {})
     child_env["CYLON_SCALE_SENTINEL"] = sentinel
+    # sentinel children have no trace exporter wired (their argv has no
+    # --trace, so no artifact epilogue runs): an inherited armed
+    # recorder would buffer 64k events for nothing — strip it; per-leg
+    # tracing is a direct `bench_suite.py --tpch --trace`-style run
+    child_env.pop("CYLON_TPU_TRACE", None)
     timed_out = False
     try:
         rc = subprocess.run(
@@ -1018,7 +1044,35 @@ def exchange_main():
         _emit(f"tpch_{qname}_dist_w{w}_sf0.01_wall", t * 1e3, "ms")
 
 
+def _trace_artifact_record():
+    """--trace epilogue: flush the armed flight recorder into a Chrome
+    Trace artifact next to the records and pin its path + event count
+    in one JSON record (the suite analog of ``bench.py --trace``).
+    This artifact is the PARENT process's timeline; the exchange leg
+    and the weak-scaling respawn get ``--trace`` forwarded and write
+    their own artifacts (distinct paths), while the TPC-H sentinel
+    children run with the recorder stripped — recording without an
+    exporter would be pure overhead."""
+    from cylon_tpu import telemetry
+    from cylon_tpu.telemetry import trace
+
+    evts = trace.events()
+    path = os.environ.get("CYLON_BENCH_TRACE_PATH",
+                          "bench_suite.trace.json")
+    telemetry.write_chrome_trace(path, trace.rank_buffers())
+    _emit_record({"metric": "trace_artifact", "value": len(evts),
+                  "unit": "events",
+                  "trace_path": os.path.abspath(path),
+                  "trace_events": len(evts),
+                  "trace_dropped": trace.dropped()})
+
+
 if __name__ == "__main__":
+    _tracing = "--trace" in sys.argv
+    if _tracing and os.environ.get("CYLON_TPU_TRACE", "") in (
+            "", "0", "off"):
+        # force-arm: an inherited =0/off must not defeat the flag
+        os.environ["CYLON_TPU_TRACE"] = "1"
     if "--exchange" in sys.argv:
         exchange_main()
     elif any(a.startswith("--scale-incore=") for a in sys.argv):
@@ -1040,9 +1094,14 @@ if __name__ == "__main__":
                 child_env.get("XLA_FLAGS", "")
                 + " --xla_force_host_platform_device_count=8")
             try:
+                # forward --trace so the child (which does the actual
+                # work and then runs the artifact epilogue itself)
+                # records; the parent exits via sys.exit right here
                 sys.exit(subprocess.run(
                     [sys.executable, os.path.abspath(__file__),
-                     "--weak-scaling"], env=child_env,
+                     "--weak-scaling"]
+                    + (["--trace"] if _tracing else []),
+                    env=child_env,
                     timeout=_subproc_timeout()).returncode)
             except subprocess.TimeoutExpired:
                 _emit("weak_scaling_timeout", 1,
@@ -1051,3 +1110,5 @@ if __name__ == "__main__":
         weak_scaling_main()
     else:
         main()
+    if _tracing:
+        _trace_artifact_record()

@@ -201,6 +201,13 @@ class CylonEnv:
             self._mesh = Mesh(np.array(devices), (WORKER_AXIS,))
         self._finalized = False
         self._kv: dict[str, str] = {}
+        self._clock_offset: "float | None" = None
+        # rank/world log prefix: once an env is live, every log record
+        # says which process emitted it (satellite of the flight
+        # recorder — 64 interleaved stdouts are unreadable without it)
+        from cylon_tpu.utils.logging import set_world
+
+        set_world(jax.process_index(), jax.process_count())
 
     @staticmethod
     def _slice_split(config, devices, distributed) -> int:
@@ -352,6 +359,37 @@ class CylonEnv:
         with telemetry.timer("barrier.wait_seconds").time():
             watchdog.bounded(_drain, "barrier", timeout=timeout,
                              detail=f"world={self.world_size}")
+
+    def clock_offset(self) -> float:
+        """Barrier-anchored estimate of this process's wall-clock offset
+        from process 0, in seconds — the alignment term the trace merge
+        subtracts so per-rank timelines line up across hosts
+        (:func:`cylon_tpu.telemetry.trace.merge_timelines`).
+
+        Estimate: every process drains the mesh through one
+        :meth:`barrier` and reads ``time.time()`` immediately on exit;
+        the readings are allgathered and the offset is ``own - rank0``.
+        All processes leave the barrier within the collective's
+        completion jitter (microseconds on ICI, sub-millisecond over
+        DCN), so the estimate's error is that jitter — far below the
+        NTP-class skew (milliseconds+) it corrects. Cached on the env;
+        exactly 0 on a single-controller mesh (one process = one
+        clock). Caveat: offsets drift — re-estimate (construct a fresh
+        env, or clear ``_clock_offset``) for multi-hour traces."""
+        if self._clock_offset is None:
+            import time as _time
+
+            if jax.process_count() <= 1:
+                self._clock_offset = 0.0
+            else:
+                from jax.experimental import multihost_utils
+
+                self.barrier()
+                t = _time.time()
+                ts = np.asarray(multihost_utils.process_allgather(
+                    np.asarray([t], np.float64))).reshape(-1)
+                self._clock_offset = float(t - ts[0])
+        return self._clock_offset
 
     def finalize(self):
         self._finalized = True

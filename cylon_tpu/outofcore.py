@@ -32,6 +32,7 @@ import numpy as np
 
 from cylon_tpu import resilience, telemetry, watchdog
 from cylon_tpu.errors import DataLossError, InvalidArgument
+from cylon_tpu.utils.tracing import span as _span
 
 __all__ = ["host_partition_chunks", "ooc_join", "ooc_groupby", "ooc_sort"]
 
@@ -156,44 +157,53 @@ def ooc_join(left, right, on, how: str = "inner",
             if how == "inner":
                 continue
             # outer semantics with an empty side still need the pass
-        # power-of-2 capacities bound the compiled-shape count to
-        # O(log(rows)) across partitions
-        lt = Table.from_pydict(lp, capacity=pow2_bucket(max(ln, 1)))
-        rt = Table.from_pydict(rp, capacity=pow2_bucket(max(rn, 1)))
-        # ~1 output row per probe row is the expected shape of an
-        # equi-join on hash-partitioned keys; pow2 rounding plus the
-        # doubling ladder below absorbs fan-out, and starting tight
-        # matters — at 12.5M-row partitions a 4x(ln+rn) start is a
-        # multi-GB output buffer that can itself OOM the pass
         from cylon_tpu.errors import OutOfCapacity
 
-        # ladder depth 12: the tight start shifts the ceiling down 4x
-        # vs the old 4x(ln+rn) start, and hot-key fan-out inside ONE
-        # partition cannot be relieved by more partitions — keep the
-        # reachable maximum at least where it was (a device OOM during
-        # a deep regrow raises through, which is the honest limit)
-        cap = pow2_bucket(2 * max(ln, rn, 1))
-        for _ in range(12):
-            try:
-                res = dev_join(lt, rt, on=keys if len(keys) > 1
-                               else keys[0], how=how, suffixes=suffixes,
-                               out_capacity=cap, ordered=False)
-                nrows = int(res.nrows)
-            except OutOfCapacity:
-                nrows = cap + 1
-            if nrows <= cap:
-                break
-            cap *= 2
-        else:
-            raise OutOfCapacity(
-                f"ooc_join partition {p}: output exceeds {cap} rows — "
-                "raise n_partitions")
-        total += nrows
-        telemetry.counter("ooc.rows_out", op="join").inc(nrows)
-        if sink is not None:
-            sink(res.to_pandas())
-        del res, lt, rt
-        lparts[p] = rparts[p] = None  # free the spill as we go
+        # one trace slice per device pass: on the merged timeline the
+        # OOC join reads as n_partitions back-to-back bucket slices,
+        # so a slow bucket (skewed partition, deep regrow ladder) is
+        # visible by eye instead of buried in the pass total
+        with _span("ooc_join.partition", cat="stage", partition=p,
+                   rows_left=ln, rows_right=rn):
+            # power-of-2 capacities bound the compiled-shape count to
+            # O(log(rows)) across partitions
+            lt = Table.from_pydict(lp, capacity=pow2_bucket(max(ln, 1)))
+            rt = Table.from_pydict(rp, capacity=pow2_bucket(max(rn, 1)))
+            # ~1 output row per probe row is the expected shape of an
+            # equi-join on hash-partitioned keys; pow2 rounding plus
+            # the doubling ladder below absorbs fan-out, and starting
+            # tight matters — at 12.5M-row partitions a 4x(ln+rn)
+            # start is a multi-GB output buffer that can itself OOM
+            # the pass.
+            # ladder depth 12: the tight start shifts the ceiling down
+            # 4x vs the old 4x(ln+rn) start, and hot-key fan-out
+            # inside ONE partition cannot be relieved by more
+            # partitions — keep the reachable maximum at least where
+            # it was (a device OOM during a deep regrow raises
+            # through, which is the honest limit)
+            cap = pow2_bucket(2 * max(ln, rn, 1))
+            for _ in range(12):
+                try:
+                    res = dev_join(lt, rt, on=keys if len(keys) > 1
+                                   else keys[0], how=how,
+                                   suffixes=suffixes,
+                                   out_capacity=cap, ordered=False)
+                    nrows = int(res.nrows)
+                except OutOfCapacity:
+                    nrows = cap + 1
+                if nrows <= cap:
+                    break
+                cap *= 2
+            else:
+                raise OutOfCapacity(
+                    f"ooc_join partition {p}: output exceeds {cap} "
+                    "rows — raise n_partitions")
+            total += nrows
+            telemetry.counter("ooc.rows_out", op="join").inc(nrows)
+            if sink is not None:
+                sink(res.to_pandas())
+            del res, lt, rt
+            lparts[p] = rparts[p] = None  # free the spill as we go
     return total
 
 
@@ -229,16 +239,17 @@ def ooc_groupby(src, by: Sequence[str], aggs,
             f"non-decomposable ops {bad}; decompose (mean = sum+count) "
             "or use the in-core path")
     partials: list = []
-    for chunk in _as_chunks(src, chunk_rows):
-        t = (Table.from_pydict(chunk) if transform is None
-             else transform(chunk))
-        part = groupby_aggregate(t, list(by),
-                                 [(s, op, o) for s, op, o in aggs])
-        # partials hop through pandas: tiny (one row per group), and
-        # dictionary key columns decode to values (codes are
-        # chunk-local)
-        partials.append(part.to_pandas())
-        del t, part
+    for i, chunk in enumerate(_as_chunks(src, chunk_rows)):
+        with _span("ooc_groupby.chunk", cat="stage", chunk=i):
+            t = (Table.from_pydict(chunk) if transform is None
+                 else transform(chunk))
+            part = groupby_aggregate(t, list(by),
+                                     [(s, op, o) for s, op, o in aggs])
+            # partials hop through pandas: tiny (one row per group),
+            # and dictionary key columns decode to values (codes are
+            # chunk-local)
+            partials.append(part.to_pandas())
+            del t, part
     if not partials:
         raise InvalidArgument("ooc_groupby: empty input")
     import pandas as pd
@@ -471,17 +482,18 @@ def ooc_sort(src, by, n_partitions: int = 8, chunk_rows: int = 1 << 22,
             if store is not None:
                 store.write_bucket(p, {}, 0)
             continue
-        t = Table.from_pydict(full, capacity=pow2_bucket(n))
-        res = sort_table(t, keys)
-        pdf = res.to_pandas()
-        if store is not None:
-            store.write_bucket(
-                p, {c: pdf[c].to_numpy() for c in pdf.columns}, n)
-        total += n
-        telemetry.counter("ooc.rows_out", op="sort").inc(n)
-        if sink is not None:
-            sink(pdf)
-        del res, t, full, pdf
-        parts[p] = None  # free the spill as we go
+        with _span("ooc_sort.bucket", cat="stage", bucket=p, rows=n):
+            t = Table.from_pydict(full, capacity=pow2_bucket(n))
+            res = sort_table(t, keys)
+            pdf = res.to_pandas()
+            if store is not None:
+                store.write_bucket(
+                    p, {c: pdf[c].to_numpy() for c in pdf.columns}, n)
+            total += n
+            telemetry.counter("ooc.rows_out", op="sort").inc(n)
+            if sink is not None:
+                sink(pdf)
+            del res, t, full, pdf
+            parts[p] = None  # free the spill as we go
     resilience.check_conservation("ooc_sort", rows_pass2, total)
     return total

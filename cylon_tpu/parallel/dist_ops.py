@@ -14,6 +14,7 @@ setup, no edge/sequence ids, no progress threads (contrast
 ``ops/dis_join_op.cpp:21-72``).
 """
 
+import contextlib
 import functools
 import os
 from typing import Sequence
@@ -40,12 +41,24 @@ from cylon_tpu.parallel.shuffle import (checked_recv, poison,
                                         shuffle_local, transport_words,
                                         wire_rows_per_shard)
 from cylon_tpu.table import Table
-from cylon_tpu.utils.tracing import traced
+from cylon_tpu.telemetry import trace as _trace
+from cylon_tpu.utils.tracing import span as _span, traced
 
 #: default headroom factor for post-shuffle local buffers (hash
 #: partitioning of uniform keys is balanced; skew beyond 2x should pass
 #: an explicit out_capacity)
 DEFAULT_SKEW = 2
+
+
+def _stage(op: "str | None", stage: str, **targs):
+    """Span for one host-side stage of a named eager dispatch —
+    ``<op>.<stage>`` with ``cat="stage"`` so the flight recorder's
+    :func:`~cylon_tpu.telemetry.trace.critical_path` attributes wall
+    time to it. Unnamed internal dispatches (colocated finalizers,
+    world==1 short-circuits) stay span-free."""
+    if op is None:
+        return contextlib.nullcontext()
+    return _span(f"{op}.{stage}", cat="stage", **targs)
 
 
 def _local_view(t: Table) -> Table:
@@ -350,10 +363,18 @@ def _adaptive(build, args, adaptive: bool, conserve: str | None = None,
     scale = plan.current_scale()
     while True:
         with plan.capacity_scale(scale):
-            out = build()(*args)
+            # the dispatch stage covers trace+compile+enqueue (the
+            # partition -> count-exchange -> payload-exchange -> local
+            # kernel program is ONE fused dispatch); the sync stage is
+            # the host wait on the result counts — together they are
+            # the op's wall, and the flight recorder slices them per
+            # dispatch for the per-rank timelines
+            with _stage(op, "dispatch", scale=scale):
+                out = build()(*args)
         if not adaptive or isinstance(out.nrows, jax.core.Tracer):
             return out
-        counts = _counts_memo(out)               # host sync, memoized
+        with _stage(op, "sync"):
+            counts = _counts_memo(out)           # host sync, memoized
         cap_l = _shard_cap(out)
         if (counts <= cap_l).all():
             if conserve is not None and resilience.accounting_enabled():
@@ -388,6 +409,10 @@ def _adaptive(build, args, adaptive: bool, conserve: str | None = None,
                     f"capacity — an upstream op overflowed an explicit "
                     f"out_capacity")
         telemetry.counter("plan.overflow_events", site="dist").inc()
+        _trace.instant("capacity.overflow", cat="capacity",
+                       op=op or "?", scale=scale,
+                       max_count=int(np.asarray(counts).max()),
+                       cap_local=int(cap_l))
         if tight and op is not None:
             telemetry.counter("exchange.fallback_regrows", op=op).inc()
         if scale >= plan.MAX_SCALE:
@@ -397,6 +422,8 @@ def _adaptive(build, args, adaptive: bool, conserve: str | None = None,
                 f"an explicit out_capacity")
         scale *= 2
         telemetry.counter("plan.capacity_rescales", site="dist").inc()
+        _trace.instant("capacity.regrow", cat="capacity",
+                       op=op or "?", scale=scale)
 
 
 def _normalize_join_keys(on, left_on, right_on):
@@ -437,7 +464,10 @@ def _probe_memo(table: Table, kind: str, key_cols, partitioning: str,
         from cylon_tpu import telemetry
 
         telemetry.counter("exchange.probes", kind=kind).inc()
-        memo[key] = compute()
+        with _span(f"probe.{kind}", cat="stage"):
+            memo[key] = compute()
+        _trace.instant("exchange.probe", cat="exchange", kind=kind,
+                       result=int(memo[key]))
     return memo[key]
 
 
@@ -557,12 +587,16 @@ def _note_exchange(env: CylonEnv, op: str, tables,
     padded = _padded_exchange(env)
     path = ("hier" if env.is_hierarchical
             else "padded" if padded else "ragged")
-    if resilience.accounting_enabled() and synced:
-        # ONE batched device_get fills every missing memo: the pricing
-        # fetch costs one RPC per dispatch at most, not one per table,
-        # and repeat exchanges of the same table instances cost nothing
-        _fill_count_memos(tables)
+    with _stage(op, "price"):
+        if resilience.accounting_enabled() and synced:
+            # ONE batched device_get fills every missing memo: the
+            # pricing fetch costs one RPC per dispatch at most, not one
+            # per table, and repeat exchanges of the same table
+            # instances cost nothing
+            _fill_count_memos(tables)
     rows = true_b = pad_b = 0
+    shard_rows = np.zeros(w, np.int64)
+    shards_known = True
     for t in tables:
         words = transport_words(t)
         cap_l = _shard_cap(t)
@@ -571,8 +605,18 @@ def _note_exchange(env: CylonEnv, op: str, tables,
             memo = t.__dict__.get("_host_counts_memo")
             if memo is not None:
                 r = int(np.minimum(memo, cap_l).sum())
+                per = np.atleast_1d(np.minimum(memo, cap_l))
+                if per.size == w:
+                    shard_rows = shard_rows + per.astype(np.int64)
+                else:
+                    shards_known = False
             elif synced:
                 r = int(np.minimum(_counts_memo(t), cap_l).sum())
+                shards_known = False
+            else:
+                shards_known = False
+        else:
+            shards_known = False
         rows += r
         true_b += r * words * 4
         if padded:
@@ -599,6 +643,20 @@ def _note_exchange(env: CylonEnv, op: str, tables,
     if true_b:
         telemetry.gauge("exchange.pad_ratio",
                         op=op).set(pad_b / true_b)
+    if _trace.enabled():
+        # one instant per dispatch with the full pricing; the per-shard
+        # receive rows (from the same memos — no extra sync ever) give
+        # the Chrome exporter one counter track per device shard
+        _trace.instant(
+            "exchange.dispatch", cat="exchange", op=op, path=path,
+            rows=rows, bytes_true=true_b, bytes_padded=pad_b,
+            rows_shards=([int(x) for x in shard_rows]
+                         if shards_known and rows else None),
+            counter="exchange.rows")
+        _trace.counter("exchange.bytes_true",
+                       telemetry.total("exchange.bytes_true"), op=op)
+        _trace.counter("exchange.bytes_padded",
+                       telemetry.total("exchange.bytes_padded"), op=op)
 
 
 def _padded_exchange(env: CylonEnv) -> bool:
@@ -658,8 +716,9 @@ def shuffle(env: CylonEnv, table: Table, key_cols: Sequence[str],
             lambda: _probe_hier_mid(env, table, key_cols, partitioning,
                                     vh))
 
-    tight = _tight_rows_local(env, (table,),
-                              enabled=out_capacity is None)
+    with _stage("shuffle", "count_probe"):
+        tight = _tight_rows_local(env, (table,),
+                                  enabled=out_capacity is None)
 
     def build():
         out_l = _out_cap_local(env, table, out_capacity=out_capacity,
@@ -717,6 +776,7 @@ def dist_filter(env: CylonEnv, table: Table, mask) -> Table:
     return _smap(env, body, 2)(table, mask)
 
 
+@traced("dist_head")
 def dist_head(table: Table, n: int) -> Table:
     """First ``n`` rows in shard order (the order ``gather_table``
     materialises) without moving any data: only the [W] per-shard count
@@ -811,34 +871,37 @@ def dist_join(env: CylonEnv, left: Table, right: Table, *,
         return _adaptive(build1, (lt, rt), out_capacity is None)
 
     resilience.inject("exchange", "dist_join", env=env)
-    left = _prep(env, left)
-    right = _prep(env, right)
-    # align key dictionaries once, host-side, so the per-shard join's
-    # unification is a no-op
-    for ln, rn in zip(left_on, right_on):
-        lc, rc = left.column(ln), right.column(rn)
-        if lc.dtype.is_bytes or rc.dtype.is_bytes:
-            # device-bytes keys need no dictionary unification — hashing
-            # is by content — only a shared word width for the exchange
-            from cylon_tpu.ops.bytescol import align_storages
+    with _stage("dist_join", "prepare"):
+        left = _prep(env, left)
+        right = _prep(env, right)
+        # align key dictionaries once, host-side, so the per-shard
+        # join's unification is a no-op
+        for ln, rn in zip(left_on, right_on):
+            lc, rc = left.column(ln), right.column(rn)
+            if lc.dtype.is_bytes or rc.dtype.is_bytes:
+                # device-bytes keys need no dictionary unification —
+                # hashing is by content — only a shared word width for
+                # the exchange
+                from cylon_tpu.ops.bytescol import align_storages
 
-            lc2, rc2 = align_storages([lc, rc])
-            left = left.add_column(ln, lc2)
-            right = right.add_column(rn, rc2)
-        elif lc.dtype.is_dictionary and rc.dtype.is_dictionary \
-                and lc.dictionary is not rc.dictionary:
-            from cylon_tpu.ops.dictenc import unify_dictionaries
+                lc2, rc2 = align_storages([lc, rc])
+                left = left.add_column(ln, lc2)
+                right = right.add_column(rn, rc2)
+            elif lc.dtype.is_dictionary and rc.dtype.is_dictionary \
+                    and lc.dictionary is not rc.dictionary:
+                from cylon_tpu.ops.dictenc import unify_dictionaries
 
-            lc2, rc2 = unify_dictionaries([lc, rc])
-            left = left.add_column(ln, lc2)
-            right = right.add_column(rn, rc2)
+                lc2, rc2 = unify_dictionaries([lc, rc])
+                left = left.add_column(ln, lc2)
+                right = right.add_column(rn, rc2)
 
     w = env.world_size
     ax = env.world_axes
 
     adaptive = out_capacity is None and shuffle_capacity is None
-    tight_l = _tight_rows_local(env, (left,), enabled=adaptive)
-    tight_r = _tight_rows_local(env, (right,), enabled=adaptive)
+    with _stage("dist_join", "count_probe"):
+        tight_l = _tight_rows_local(env, (left,), enabled=adaptive)
+        tight_r = _tight_rows_local(env, (right,), enabled=adaptive)
 
     def build():
         shuf_l = _out_cap_local(env, left, out_capacity=shuffle_capacity,

@@ -48,10 +48,19 @@ def _use_ragged() -> bool:
 
     mode = os.environ.get("CYLON_TPU_SHUFFLE", "auto")
     if mode == "ragged":
-        return True
-    if mode == "padded":
-        return False
-    return current_platform() not in ("cpu",)
+        ragged = True
+    elif mode == "padded":
+        ragged = False
+    else:
+        ragged = current_platform() not in ("cpu",)
+    # this runs at TRACE time (host code inside the program build), so
+    # the flight recorder sees one instant per compiled exchange — the
+    # path choice is a compile-time property, invisible at dispatch
+    from cylon_tpu.telemetry import trace
+
+    trace.instant("shuffle.path", cat="exchange",
+                  path="ragged" if ragged else "padded", mode=mode)
+    return ragged
 
 
 def exchange_arrays(arrays, pid, n_local, out_cap: int,

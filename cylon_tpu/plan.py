@@ -39,6 +39,7 @@ import jax
 
 from cylon_tpu import telemetry
 from cylon_tpu.errors import OutOfCapacity
+from cylon_tpu.telemetry import trace as _trace
 
 __all__ = ["capacity_scale", "current_scale", "compile_query",
            "CompiledQuery", "MAX_SCALE", "note_overflow",
@@ -452,6 +453,9 @@ class CompiledQuery:
             if (key, scale, hint, shape_sig) not in self._compiled:
                 self._compiled.add((key, scale, hint, shape_sig))
                 telemetry.counter("plan.compile_count").inc()
+                _trace.instant("plan.compile", cat="plan", scale=scale,
+                               row_hint=hint,
+                               fn=getattr(self._fn, "__name__", "?"))
             raw, bad = self._jitted(scale, hint, static_pos, static_kw,
                                     tuple(dyn_pos), **dyn_kw)
             if not self._check:
@@ -486,11 +490,15 @@ class CompiledQuery:
                     # genuine op overflow: regrow the capacity budget
                     telemetry.counter("plan.overflow_events",
                                       site="compiled").inc()
+                    _trace.instant("capacity.overflow", cat="capacity",
+                                   site="compiled", scale=scale)
                     if scale >= MAX_SCALE:
                         raise err
                     scale *= 2
                     telemetry.counter("plan.capacity_rescales",
                                       site="compiled").inc()
+                    _trace.instant("capacity.regrow", cat="capacity",
+                                   site="compiled", scale=scale)
                     continue
             self._scale_memo[key] = scale
             observed = tuple(
@@ -583,11 +591,15 @@ def regrow_eager(run, *, bounded: bool):
         except OutOfCapacity:
             telemetry.counter("plan.overflow_events",
                               site="eager").inc()
+            _trace.instant("capacity.overflow", cat="capacity",
+                           site="eager", scale=scale)
             if scale >= MAX_SCALE:
                 raise
             scale *= 2
             telemetry.counter("plan.capacity_rescales",
                               site="eager").inc()
+            _trace.instant("capacity.regrow", cat="capacity",
+                           site="eager", scale=scale)
 
 
 def compile_query(fn=None, *, check: bool = True):

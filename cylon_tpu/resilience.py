@@ -46,6 +46,7 @@ import numpy as np
 
 from cylon_tpu import telemetry
 from cylon_tpu.config import RetryPolicy
+from cylon_tpu.telemetry import trace as _trace
 from cylon_tpu.errors import (Code, CylonError, DataLossError,
                               DeadlineExceeded, InvalidArgument,
                               TransientError)
@@ -177,6 +178,9 @@ class FaultPlan:
             return
         telemetry.counter("resilience.faults_injected",
                           point=point).inc()
+        _trace.instant("resilience.fault", cat="resilience",
+                       point=point, hit=k, detail=detail,
+                       delay=hit.delay)
         if hit.delay > 0:
             # injected hang: sleep OUTSIDE the plan lock so other
             # threads' injection points stay live while this one stalls
@@ -312,6 +316,9 @@ def retrying(fn, policy: "RetryPolicy | None" = None, *,
             code = getattr(getattr(e, "code", None), "name", None) \
                 or type(e).__name__
             telemetry.counter("resilience.retries", code=code).inc()
+            _trace.instant("resilience.retry", cat="resilience",
+                           code=code, attempt=attempt,
+                           label=label or "", backoff_s=d)
             from cylon_tpu.utils.logging import get_logger
 
             get_logger().warning(
@@ -460,13 +467,16 @@ class SpillStore:
         if rows:
             from cylon_tpu import watchdog
 
-            with telemetry.timer("spill.write_seconds").time():
+            with telemetry.timer("spill.write_seconds").time(), \
+                    _trace.span("spill.write", cat="spill", bucket=p):
                 retrying(lambda: watchdog.bounded(
                     _write, "spill_io", detail=f"write bucket {p}"),
                     self._policy, label=f"spill_write[{p}]")
-            telemetry.counter("spill.write_bytes").inc(
-                int(sum(np.asarray(v).nbytes for v in cols.values())))
+            nb = int(sum(np.asarray(v).nbytes for v in cols.values()))
+            telemetry.counter("spill.write_bytes").inc(nb)
             telemetry.counter("spill.write_buckets").inc()
+            _trace.instant("spill.write", cat="spill", bucket=p,
+                           bytes=nb, rows=int(rows))
         self._m["completed"][str(int(p))] = int(rows)
         self._write_manifest(self._m)
 
@@ -481,13 +491,15 @@ class SpillStore:
 
         from cylon_tpu import watchdog
 
-        with telemetry.timer("spill.read_seconds").time():
+        with telemetry.timer("spill.read_seconds").time(), \
+                _trace.span("spill.read", cat="spill", bucket=p):
             out = retrying(lambda: watchdog.bounded(
                 _read, "spill_io", detail=f"read bucket {p}"),
                 self._policy, label=f"spill_read[{p}]")
-        telemetry.counter("spill.read_bytes").inc(
-            int(sum(a.nbytes for a in out.values())))
+        nb = int(sum(a.nbytes for a in out.values()))
+        telemetry.counter("spill.read_bytes").inc(nb)
         telemetry.counter("spill.read_buckets").inc()
+        _trace.instant("spill.read", cat="spill", bucket=p, bytes=nb)
         return out
 
 

@@ -3,9 +3,12 @@
 Parity: ``cpp/src/cylon/row.{hpp,cpp}`` — ``Row`` with per-type getters
 (``row.hpp:23``: GetInt8..GetInt64, GetFloat/GetDouble, GetBool,
 GetString) addressed by column index. Here rows are host-side views
-fetched from the device table (one sync per row — the reference pays
-the same per-cell virtual dispatch; columnar access is the fast path in
-both systems).
+fetched from the device table in ONE batched ``jax.device_get`` per
+row (``Table.row`` slices every column's element on device, transfers
+them together under a ``table.row_fetch`` span, and decodes host-side
+— a per-field fetch would pay the fixed ~100 ms tunnel RPC once per
+column). The getters below are pure host accessors over the already-
+fetched values; columnar access remains the fast path in both systems.
 """
 
 from typing import Any, Iterator

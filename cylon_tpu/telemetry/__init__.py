@@ -20,17 +20,31 @@ configured — ``CYLON_TPU_METRICS_DIR`` unset — instrumentation is dict
 updates only; no thread starts, no file opens. Exporters
 (:mod:`cylon_tpu.telemetry.export`): JSONL snapshot lines + a
 Prometheus text dump per process, armed lazily off the env knob.
-See ``docs/observability.md``.
+
+The event-level half is :mod:`cylon_tpu.telemetry.trace` — the
+``CYLON_TPU_TRACE`` flight recorder: per-rank span/instant/counter
+timelines, Chrome Trace export (:func:`to_chrome_trace` /
+:func:`write_chrome_trace`), clock-aligned cross-rank merge
+(:func:`gather_traces` + ``trace.merge_timelines``) and critical-path
+straggler attribution (``trace.critical_path``). Same
+no-overhead-when-off contract. See ``docs/observability.md``.
 """
 
-from cylon_tpu.telemetry.aggregate import gather_metrics, merge_snapshots
+from cylon_tpu.telemetry import trace
+from cylon_tpu.telemetry.aggregate import (gather_metrics,
+                                           gather_traces,
+                                           merge_snapshots)
 from cylon_tpu.telemetry.export import (HBM_PEAK_BYTES_PER_SEC,
                                         ICI_LINK_BYTES_PER_SEC,
                                         REQUIRED_BENCH_KEYS,
-                                        bench_metrics, fraction_of_peak,
+                                        bench_metrics,
+                                        chrome_trace_json,
+                                        fraction_of_peak,
                                         json_safe,
                                         metrics_dir, snapshot_to_json,
-                                        to_prometheus, write_snapshot)
+                                        to_chrome_trace, to_prometheus,
+                                        write_chrome_trace,
+                                        write_snapshot)
 from cylon_tpu.telemetry.registry import (BUCKET_BOUNDS, Counter, Gauge,
                                           Histogram, MetricRegistry,
                                           Timer, add_record, counter,
@@ -44,8 +58,9 @@ __all__ = [
     "MetricRegistry", "registry", "counter", "gauge", "histogram",
     "timer", "metric", "instruments", "snapshot", "delta", "reset",
     "total", "add_record", "get_records", "merge_snapshots",
-    "gather_metrics", "json_safe", "snapshot_to_json", "to_prometheus",
-    "metrics_dir", "write_snapshot", "bench_metrics",
+    "gather_metrics", "gather_traces", "json_safe", "snapshot_to_json",
+    "to_prometheus", "metrics_dir", "write_snapshot", "bench_metrics",
     "REQUIRED_BENCH_KEYS", "HBM_PEAK_BYTES_PER_SEC",
-    "ICI_LINK_BYTES_PER_SEC", "fraction_of_peak",
+    "ICI_LINK_BYTES_PER_SEC", "fraction_of_peak", "trace",
+    "to_chrome_trace", "chrome_trace_json", "write_chrome_trace",
 ]

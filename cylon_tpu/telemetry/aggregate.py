@@ -21,7 +21,7 @@ Merge semantics per instrument type:
 
 import json
 
-__all__ = ["merge_snapshots", "gather_metrics"]
+__all__ = ["merge_snapshots", "gather_metrics", "gather_traces"]
 
 
 def _merge_entry(a: dict, b: dict) -> dict:
@@ -93,16 +93,25 @@ def gather_metrics(env=None, snap: "dict | None" = None) -> dict:
 
     if jax.process_count() <= 1:
         return snap
+    return merge_snapshots(_allgather_json(snap))
+
+
+def _allgather_json(obj) -> list:
+    """Every process's ``obj`` (any JSON-able value), on every process:
+    one length-allgather + one length-padded uint8 ``process_allgather``
+    round — the standard variable-payload trick, shared by the metric
+    and trace gathers. ``json_safe`` (not ``default=str``): a
+    numpy-scalar value must arrive at the merge as a NUMBER on every
+    rank — stringified values would max()/add lexicographically or
+    crash on mixed types."""
+    import jax
     import numpy as np
     from jax.experimental import multihost_utils
 
     from cylon_tpu.telemetry.export import json_safe
 
-    # json_safe (not default=str): a numpy-scalar gauge must arrive at
-    # the merge as a NUMBER on every rank — stringified values would
-    # max()/add lexicographically or crash on mixed types
     payload = np.frombuffer(
-        json.dumps(json_safe(snap), allow_nan=False).encode(),
+        json.dumps(json_safe(obj), allow_nan=False).encode(),
         dtype=np.uint8)
     n = np.asarray([payload.size], dtype=np.int32)
     sizes = np.asarray(multihost_utils.process_allgather(n)).reshape(-1)
@@ -111,7 +120,32 @@ def gather_metrics(env=None, snap: "dict | None" = None) -> dict:
     buf[:payload.size] = payload
     gathered = np.asarray(multihost_utils.process_allgather(buf))
     gathered = gathered.reshape(jax.process_count(), cap)
-    snaps = []
-    for row, size in zip(gathered, sizes):
-        snaps.append(json.loads(bytes(row[:int(size)]).decode()))
-    return merge_snapshots(snaps)
+    return [json.loads(bytes(row[:int(size)]).decode())
+            for row, size in zip(gathered, sizes)]
+
+
+def gather_traces(env=None, events: "list | None" = None) -> list:
+    """Every rank's flight-recorder buffer, on every host: a list of
+    ``{"rank", "world", "clock_offset", "events"}`` dicts ready for
+    :func:`cylon_tpu.telemetry.trace.merge_timelines` or the Chrome
+    exporter. Single-process: the local buffer alone (no collective).
+    Multi-process: one ``process_allgather`` round of JSON-encoded
+    buffers; ``clock_offset`` is the env's barrier-anchored wall-clock
+    offset (:meth:`cylon_tpu.context.CylonEnv.clock_offset`) so merged
+    timelines line up across hosts — 0 when no env is given (merge
+    then aligns only to within true clock skew)."""
+    import jax
+
+    from cylon_tpu.telemetry import trace
+
+    offset = 0.0
+    if env is not None and hasattr(env, "clock_offset") \
+            and jax.process_count() > 1:
+        offset = float(env.clock_offset())
+    local = {"rank": jax.process_index(),
+             "world": getattr(env, "world_size", jax.process_count()),
+             "clock_offset": offset,
+             "events": trace.events() if events is None else events}
+    if jax.process_count() <= 1:
+        return [local]
+    return _allgather_json(local)

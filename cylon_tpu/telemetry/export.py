@@ -25,6 +25,8 @@ __all__ = [
     "write_snapshot", "arm_exporters", "bench_metrics",
     "REQUIRED_BENCH_KEYS", "HBM_PEAK_BYTES_PER_SEC",
     "ICI_LINK_BYTES_PER_SEC", "fraction_of_peak",
+    "to_chrome_trace", "chrome_trace_json", "write_chrome_trace",
+    "SHARD_PID_BASE",
 ]
 
 # ---------------------------------------------------------------- roofline
@@ -166,6 +168,165 @@ def to_prometheus(snap: "dict | None" = None) -> str:
         blocks.append(f"# TYPE {name} {typed[name]}")
         blocks.extend(lines_by_name[name])
     return "\n".join(blocks) + ("\n" if blocks else "")
+
+
+# ---------------------------------------------------------- chrome trace
+#: pid offset for per-SHARD counter tracks in the Chrome export. On a
+#: single-controller mesh one host process drives W device shards: the
+#: host timeline is one process track (pid = rank), and the per-shard
+#: row counts the exchange instants carry render as W extra counter
+#: tracks at pids SHARD_PID_BASE + shard — so the merged trace shows
+#: >= W rank tracks even before multihost gives genuinely distinct
+#: host timelines.
+SHARD_PID_BASE = 10000
+
+
+def _chrome_sanitize(raw: list) -> list:
+    """Enforce the Trace Event Format invariants the tests pin: events
+    sorted by ``ts``; every ``B`` matched by an ``E`` (the ring buffer
+    may have evicted a begin whose end survived — drop the orphan end;
+    close still-open begins at the last timestamp) — per (pid, tid)."""
+    raw.sort(key=lambda e: e.get("ts", 0.0))
+    last_ts = raw[-1]["ts"] if raw else 0.0
+    out, stacks = [], {}
+    for e in raw:
+        ph = e.get("ph")
+        if ph == "B":
+            stacks.setdefault((e["pid"], e["tid"]), []).append(e)
+            out.append(e)
+        elif ph == "E":
+            st = stacks.get((e["pid"], e["tid"]))
+            if not st:
+                continue  # orphan end: its begin was ring-evicted
+            st.pop()
+            out.append(e)
+        else:
+            out.append(e)
+    closers = []
+    for (pid, tid), st in stacks.items():
+        for b in reversed(st):  # innermost first: E nesting stays valid
+            closers.append({"ph": "E", "pid": pid, "tid": tid,
+                            "ts": max(last_ts, b["ts"]),
+                            "name": b["name"], "cat": b.get("cat",
+                                                            "span")})
+    out.extend(closers)  # already >= every ts in out
+    return out
+
+
+def to_chrome_trace(buffers, world: "int | None" = None) -> dict:
+    """Chrome Trace Event Format document from per-rank event buffers.
+
+    ``buffers``: the :func:`cylon_tpu.telemetry.trace.rank_buffers` /
+    ``gather_traces`` shape — dicts of ``{"rank", "world",
+    "clock_offset", "events"}`` — or a bare list of event dicts
+    (treated as rank 0). One ``pid`` per rank (named ``rank <r>``),
+    one ``tid`` per recording thread; span begin/ends become ``B``/``E``
+    slice pairs, watchdog-section completes become ``X`` slices,
+    instants ``i``, counter samples ``C`` counter tracks. Exchange
+    instants carrying per-shard row counts additionally render one
+    counter track per device shard (pid ``SHARD_PID_BASE + shard``) so
+    a single-controller trace still shows every rank's data volume.
+
+    Timestamps are microseconds on rank 0's clock (each buffer's
+    ``clock_offset`` is subtracted). Everything is strict-JSON
+    (``json_safe``); open in Perfetto / ``chrome://tracing``.
+    """
+    if buffers and isinstance(buffers, (list, tuple)) \
+            and buffers and isinstance(buffers[0], dict) \
+            and "kind" in buffers[0]:
+        buffers = [{"rank": 0, "clock_offset": 0.0, "events": buffers}]
+    raw, meta = [], []
+    t0 = None
+    for buf in buffers:
+        off = float(buf.get("clock_offset", 0.0) or 0.0)
+        for e in buf.get("events", ()):
+            t = e["ts"] - off
+            t0 = t if t0 is None else min(t0, t)
+    t0 = t0 or 0.0
+    shard_tracks = set()
+    for buf in buffers:
+        rank = int(buf.get("rank", 0))
+        off = float(buf.get("clock_offset", 0.0) or 0.0)
+        world = world or buf.get("world")
+        meta.append({"ph": "M", "name": "process_name", "pid": rank,
+                     "tid": 0, "ts": 0.0,
+                     "args": {"name": f"rank {rank}"}})
+        for e in buf.get("events", ()):
+            us = (e["ts"] - off - t0) * 1e6
+            tid = e.get("tid", 0)
+            kind = e["kind"]
+            cat = e.get("cat") or "span"
+            args = dict(e.get("args") or {})
+            if kind == "begin":
+                raw.append({"ph": "B", "pid": rank, "tid": tid,
+                            "ts": us, "name": e["name"], "cat": cat,
+                            "args": args})
+            elif kind == "end":
+                raw.append({"ph": "E", "pid": rank, "tid": tid,
+                            "ts": us, "name": e["name"]})
+            elif kind == "complete":
+                raw.append({"ph": "X", "pid": rank, "tid": tid,
+                            "ts": us, "dur": e.get("dur", 0.0) * 1e6,
+                            "name": e["name"], "cat": cat,
+                            "args": args})
+            elif kind == "counter":
+                raw.append({"ph": "C", "pid": rank, "tid": tid,
+                            "ts": us, "name": e["name"],
+                            "args": {"value": e.get("value", 0)}})
+            elif kind == "instant":
+                raw.append({"ph": "i", "pid": rank, "tid": tid,
+                            "ts": us, "name": e["name"], "cat": cat,
+                            "s": "t", "args": args})
+                shards = args.get("rows_shards")
+                if shards:
+                    for s, v in enumerate(shards):
+                        pid = SHARD_PID_BASE + s
+                        shard_tracks.add(s)
+                        raw.append({"ph": "C", "pid": pid, "tid": 0,
+                                    "ts": us,
+                                    "name": args.get("counter",
+                                                     "exchange.rows"),
+                                    "args": {"value": v}})
+    for s in sorted(shard_tracks):
+        meta.append({"ph": "M", "name": "process_name",
+                     "pid": SHARD_PID_BASE + s, "tid": 0, "ts": 0.0,
+                     "args": {"name": f"shard {s}"}})
+    doc = {"traceEvents": meta + _chrome_sanitize(raw),
+           "displayTimeUnit": "ms"}
+    if world:
+        doc["otherData"] = {"world_size": int(world)}
+    return json_safe(doc)
+
+
+def chrome_trace_json(doc_or_buffers, world: "int | None" = None) -> str:
+    """Strict-JSON text of a Chrome trace document (or of buffers,
+    converted first). Documents from :func:`to_chrome_trace` are
+    already ``json_safe`` — dumping directly avoids a second deep walk
+    of a 64k-event trace; a hand-built document with non-finite values
+    falls back through the coercion instead of raising."""
+    doc = doc_or_buffers
+    if not (isinstance(doc, dict) and "traceEvents" in doc):
+        doc = to_chrome_trace(doc_or_buffers, world=world)
+    try:
+        return json.dumps(doc, allow_nan=False, separators=(",", ":"))
+    except (TypeError, ValueError):
+        return json.dumps(json_safe(doc), allow_nan=False,
+                          separators=(",", ":"))
+
+
+def write_chrome_trace(path: str, doc_or_buffers,
+                       world: "int | None" = None) -> str:
+    """Write a ``.trace.json`` artifact (atomic rename) and return its
+    path — the file Perfetto / ``chrome://tracing`` opens directly."""
+    text = chrome_trace_json(doc_or_buffers, world=world)
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        f.write(text)
+    os.replace(tmp, path)
+    return path
 
 
 def metrics_dir() -> "str | None":

@@ -135,6 +135,30 @@ class Histogram:
                     "sum": self.sum, "min": self.min, "max": self.max,
                     "buckets": bks}
 
+    def quantile(self, q: float) -> "float | None":
+        """Bucket-resolution quantile estimate: the upper bound of the
+        first bucket whose cumulative count reaches ``q * count``,
+        clamped to the observed [min, max] (so p50 of a single
+        observation is that observation, not its pow2 ceiling, and the
+        overflow bucket cannot report +inf). None with no finite
+        observations. Resolution is one pow2 bucket — tail columns in
+        ``tracing.report`` trade exactness for zero per-observation
+        cost, like every other read of this histogram."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile {q} not in [0, 1]")
+        with self._lock:
+            if self.min is None:
+                return None
+            finite = sum(self.buckets[:len(BUCKET_BOUNDS)])
+            target = q * finite
+            cum = 0
+            for i, n in enumerate(self.buckets[:len(BUCKET_BOUNDS)]):
+                cum += n
+                if n and cum >= target:
+                    return min(max(BUCKET_BOUNDS[i], self.min),
+                               self.max)
+            return self.max
+
 
 class Timer(Histogram):
     """A Histogram of seconds with a context-manager clock."""

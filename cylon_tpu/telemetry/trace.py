@@ -1,0 +1,399 @@
+"""Flight recorder: per-rank trace timelines for distributed ops.
+
+The reference's only event-level visibility is glog lines of per-rank
+``j_t``/``w_t`` wall times in the bench binaries
+(``cpp/src/examples/bench/table_join_dist_test.cpp:38-56``) — you can
+see *that* a rank was slow, never *why* or *where in the op*. The
+metrics registry (:mod:`cylon_tpu.telemetry.registry`) deliberately
+drops event structure: spans collapse into histogram buckets with no
+timestamps, no nesting, no rank correlation. This module records the
+missing half — **traces, not metrics**: a bounded, thread-safe buffer
+of structured events (span begin/end with ids and parent nesting,
+instants for exchange dispatches / probes / overflows / retries /
+fault firings / watchdog expiries, counter samples for byte tracks,
+and complete slices for watchdog sections), exportable as Chrome
+Trace Event JSON (:func:`cylon_tpu.telemetry.export.to_chrome_trace`)
+and mergeable across ranks with clock-offset alignment
+(:func:`merge_timelines`; offsets from
+:meth:`cylon_tpu.context.CylonEnv.clock_offset`).
+
+Fast-path contract (the same no-overhead-when-off promise as the
+metric exporters and the watchdog): the recorder is armed ONLY when
+``CYLON_TPU_TRACE`` is set — otherwise every emit function returns
+after one env read, :data:`_RECORDER` stays ``None``, and no
+allocations, threads or file handles exist (pinned by
+``tests/test_trace_timeline.py``).
+
+Event dicts (plain JSON-safe values, so cross-rank gather is one
+``json.dumps`` away):
+
+- ``{"kind": "begin"/"end", "name", "ts", "tid", "id", "parent",
+  "cat", "args"}`` — a span edge; ``parent`` nests via a
+  contextvar stack (worker threads spawned with ``copy_context``
+  inherit their parent span).
+- ``{"kind": "instant", ...}`` — a point event (exchange dispatch
+  with true/padded bytes, probe, overflow, retry, fault, expiry).
+- ``{"kind": "counter", "name", "ts", "tid", "value", "args"}`` — one
+  sample of a cumulative counter track (exchange bytes).
+- ``{"kind": "complete", "name", "ts", "dur", ...}`` — a slice whose
+  start was only known in monotonic time (watchdog sections report
+  elapsed at finish; ``ts = now() - dur``).
+
+Timestamps are seconds on a wall-aligned monotonic clock:
+``perf_counter`` plus a process-constant offset captured when the
+recorder arms, so durations keep ``perf_counter`` resolution while
+cross-process merges can subtract wall-clock offsets.
+"""
+
+import collections
+import contextlib
+import contextvars
+import itertools
+import os
+import threading
+import time
+
+__all__ = [
+    "enabled", "begin", "end", "span", "instant", "counter", "complete",
+    "events", "clear", "dropped", "merge_timelines", "rank_buffers",
+    "critical_path", "stage_coverage", "DEFAULT_CAPACITY",
+]
+
+#: default ring-buffer bound (events); ``CYLON_TPU_TRACE_EVENTS``
+#: overrides. At ~120 bytes/event the default is a few MiB — bounded by
+#: construction, the recorder can stay armed for a whole job.
+DEFAULT_CAPACITY = 65536
+
+
+def enabled() -> bool:
+    """Is the recorder armed? One env read — the entire fast-path cost
+    when tracing is off (``CYLON_TPU_TRACE`` unset/0/off)."""
+    return os.environ.get("CYLON_TPU_TRACE", "") not in ("", "0", "off")
+
+
+class TraceRecorder:
+    """Bounded, thread-safe event buffer (oldest events drop first)."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY):
+        self._buf: collections.deque = collections.deque(maxlen=capacity)
+        self._lock = threading.Lock()
+        self._ids = itertools.count(1)
+        self._appended = 0
+        # wall-aligned monotonic clock: perf_counter resolution for
+        # durations, wall epoch so cross-process offsets subtract
+        self._epoch = time.time() - time.perf_counter()
+
+    def now(self) -> float:
+        return time.perf_counter() + self._epoch
+
+    def next_id(self) -> int:
+        return next(self._ids)
+
+    def append(self, evt: dict) -> None:
+        with self._lock:
+            self._buf.append(evt)
+            self._appended += 1
+
+    def events(self) -> list:
+        with self._lock:
+            return list(self._buf)
+
+    def dropped(self) -> int:
+        """Events evicted by the ring bound (total appended - held)."""
+        with self._lock:
+            return self._appended - len(self._buf)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._buf.clear()
+            self._appended = 0
+
+
+_LOCK = threading.Lock()
+_RECORDER: "TraceRecorder | None" = None
+
+#: innermost live span id for this context (tuple stack — immutable, so
+#: bounded-call worker threads inherit a consistent view via
+#: ``contextvars.copy_context``)
+_STACK: contextvars.ContextVar = contextvars.ContextVar(
+    "cylon_trace_stack", default=())
+
+
+def _rec() -> TraceRecorder:
+    global _RECORDER
+    r = _RECORDER
+    if r is None:
+        with _LOCK:
+            if _RECORDER is None:
+                try:
+                    cap = int(os.environ.get("CYLON_TPU_TRACE_EVENTS",
+                                             str(DEFAULT_CAPACITY)))
+                except ValueError:
+                    cap = DEFAULT_CAPACITY
+                _RECORDER = TraceRecorder(max(cap, 16))
+            r = _RECORDER
+    return r
+
+
+def now() -> "float | None":
+    """Recorder timestamp (None when tracing is off)."""
+    return _rec().now() if enabled() else None
+
+
+# ------------------------------------------------------------- emitters
+def begin(name: str, cat: "str | None" = None, **args):
+    """Open a span; returns an opaque token for :func:`end` (None when
+    tracing is off — :func:`end` accepts it as a no-op)."""
+    if not enabled():
+        return None
+    r = _rec()
+    eid = r.next_id()
+    stack = _STACK.get()
+    tok = _STACK.set(stack + (eid,))
+    r.append({"kind": "begin", "name": name, "ts": r.now(),
+              "tid": threading.get_ident(), "id": eid,
+              "parent": stack[-1] if stack else None,
+              "cat": cat, "args": args or {}})
+    return (eid, name, tok)
+
+
+def end(token) -> None:
+    if token is None:
+        return
+    eid, name, tok = token
+    try:
+        _STACK.reset(tok)
+    except ValueError:
+        pass  # span closed on a different context (worker thread exit)
+    if not enabled():
+        return
+    r = _rec()
+    r.append({"kind": "end", "name": name, "ts": r.now(),
+              "tid": threading.get_ident(), "id": eid})
+
+
+@contextlib.contextmanager
+def span(name: str, cat: "str | None" = None, **args):
+    """Record a span around the enclosed region (no-op when off)."""
+    tok = begin(name, cat=cat, **args)
+    try:
+        yield
+    finally:
+        end(tok)
+
+
+def instant(name: str, cat: "str | None" = None, **args) -> None:
+    """Record a point event (no-op when off)."""
+    if not enabled():
+        return
+    r = _rec()
+    stack = _STACK.get()
+    r.append({"kind": "instant", "name": name, "ts": r.now(),
+              "tid": threading.get_ident(),
+              "parent": stack[-1] if stack else None,
+              "cat": cat, "args": args or {}})
+
+
+def counter(name: str, value, **args) -> None:
+    """Record one sample of a cumulative counter track (no-op when
+    off). ``value`` should be the running total so the exported track
+    is monotone."""
+    if not enabled():
+        return
+    r = _rec()
+    r.append({"kind": "counter", "name": name, "ts": r.now(),
+              "tid": threading.get_ident(), "value": value,
+              "args": args or {}})
+
+
+def complete(name: str, dur: float, cat: "str | None" = None,
+             **args) -> None:
+    """Record an already-elapsed slice ending now (``ts = now - dur``)
+    — for regions whose start was only known in monotonic time, e.g.
+    watchdog section completions."""
+    if not enabled():
+        return
+    r = _rec()
+    t1 = r.now()
+    r.append({"kind": "complete", "name": name,
+              "ts": t1 - max(float(dur), 0.0), "dur": float(dur),
+              "tid": threading.get_ident(), "cat": cat,
+              "args": args or {}})
+
+
+# -------------------------------------------------------------- readers
+def events() -> list:
+    """Snapshot of the local buffer ([] when never armed)."""
+    return _RECORDER.events() if _RECORDER is not None else []
+
+
+def dropped() -> int:
+    return _RECORDER.dropped() if _RECORDER is not None else 0
+
+
+def clear() -> None:
+    if _RECORDER is not None:
+        _RECORDER.clear()
+
+
+def rank_buffers(env=None) -> "list[dict]":
+    """Per-rank event buffers for merge/export: a list of
+    ``{"rank", "world", "clock_offset", "events"}`` dicts.
+
+    Multi-process (a DCN-spanning mesh): one buffer per process via
+    :func:`cylon_tpu.telemetry.aggregate.gather_traces`, clock-aligned
+    by the env's barrier-anchored offset estimate. Single-controller
+    (one process driving W devices — the test topology): the host
+    timeline is ONE buffer at offset 0; the Chrome exporter still
+    renders per-shard counter tracks from the per-shard row counts the
+    exchange instants carry. (Thin alias of ``gather_traces`` — ONE
+    home for the buffer shape.)
+    """
+    from cylon_tpu.telemetry.aggregate import gather_traces
+
+    return gather_traces(env)
+
+
+# ----------------------------------------------------- merge + analysis
+def merge_timelines(buffers) -> list:
+    """One time-sorted event list from per-rank buffers.
+
+    ``buffers``: iterables of ``(rank, events)`` pairs or
+    ``{"rank", "clock_offset", "events"}`` dicts (the
+    :func:`rank_buffers` / ``gather_traces`` shape). Each event gains a
+    ``rank`` key and its ``ts`` is shifted onto rank 0's clock by
+    subtracting the buffer's ``clock_offset`` — after the shift,
+    same-instant events across hosts line up to within the barrier
+    jitter of the offset estimate (see ``CylonEnv.clock_offset``).
+    """
+    merged = []
+    for buf in buffers:
+        if isinstance(buf, dict):
+            rank = buf.get("rank", 0)
+            off = float(buf.get("clock_offset", 0.0) or 0.0)
+            evts = buf.get("events", [])
+        else:
+            rank, evts = buf
+            off = 0.0
+        for e in evts:
+            e = dict(e)
+            e["rank"] = rank
+            e["ts"] = e["ts"] - off
+            merged.append(e)
+    merged.sort(key=lambda e: e["ts"])
+    return merged
+
+
+def _matched_spans(evts):
+    """(begin event, duration) for every begin/end pair in one rank's
+    event list — the ONE home for the eviction-tolerant matching
+    semantics (unmatched begins and ring-orphaned ends are skipped).
+    Shared by :func:`critical_path` and :func:`stage_coverage`."""
+    open_by_id, out = {}, []
+    for e in evts:
+        if e["kind"] == "begin":
+            open_by_id[e["id"]] = e
+        elif e["kind"] == "end":
+            b = open_by_id.pop(e.get("id"), None)
+            if b is not None:
+                out.append((b, e["ts"] - b["ts"]))
+    return out
+
+
+def critical_path(merged) -> dict:
+    """Walk a merged timeline; attribute wall time to stages per rank
+    and name the straggler.
+
+    Stages are the events instrumented as such: ``complete`` slices
+    with ``cat == "stage"`` (watchdog sections — ``exchange``,
+    ``ooc_pass``, ... — always recorded by ``watched_section``) plus
+    spans carrying ``cat == "stage"`` (the per-op dispatch/sync
+    sub-spans). When a timeline carries no stage events at all (an op
+    with no watched sections traced before this PR's instrumentation),
+    top-level spans stand in.
+
+    Returns::
+
+        {"straggler_rank": r, "dominant_stage": s,
+         "excess_seconds": float,      # straggler's stage time over the
+                                       # median of the other ranks
+         "rank_walls": {rank: wall},   # first-event -> last-event span
+         "stage_seconds": {rank: {stage: seconds}},
+         "op_seconds": {rank: {op: seconds}}}   # top-level spans
+
+    The straggler is the rank with the longest wall; its dominant
+    stage is the stage with the largest excess over the median of the
+    same stage on the other ranks (ties break by stage name, so the
+    verdict is deterministic).
+    """
+    by_rank: "dict[int, list]" = {}
+    for e in merged:
+        by_rank.setdefault(e.get("rank", 0), []).append(e)
+
+    rank_walls: "dict[int, float]" = {}
+    stage_seconds: "dict[int, dict]" = {}
+    op_seconds: "dict[int, dict]" = {}
+    for rank, evts in by_rank.items():
+        ts = [e["ts"] for e in evts]
+        ends = [e["ts"] + e.get("dur", 0.0) for e in evts]
+        rank_walls[rank] = (max(ends) - min(ts)) if ts else 0.0
+        stages: "dict[str, float]" = {}
+        ops: "dict[str, float]" = {}
+        for e in evts:
+            if e["kind"] == "complete" and e.get("cat") == "stage":
+                stages[e["name"]] = stages.get(e["name"], 0.0) \
+                    + e.get("dur", 0.0)
+        for b, dur in _matched_spans(evts):
+            if b.get("cat") == "stage":
+                stages[b["name"]] = stages.get(b["name"], 0.0) + dur
+            if b.get("parent") is None:
+                ops[b["name"]] = ops.get(b["name"], 0.0) + dur
+        stage_seconds[rank] = stages
+        op_seconds[rank] = ops
+
+    if not rank_walls:
+        return {"straggler_rank": None, "dominant_stage": None,
+                "excess_seconds": 0.0, "rank_walls": {},
+                "stage_seconds": {}, "op_seconds": {}}
+
+    straggler = max(sorted(rank_walls), key=lambda r: rank_walls[r])
+    mine = stage_seconds.get(straggler) or op_seconds.get(straggler, {})
+    use_ops = not stage_seconds.get(straggler)
+    others = [r for r in rank_walls if r != straggler]
+
+    def _median(vals):
+        vals = sorted(vals)
+        if not vals:
+            return 0.0
+        m = len(vals) // 2
+        return vals[m] if len(vals) % 2 else (vals[m - 1] + vals[m]) / 2
+
+    best_stage, best_excess = None, float("-inf")
+    for name in sorted(mine):
+        table = op_seconds if use_ops else stage_seconds
+        med = _median([table.get(r, {}).get(name, 0.0) for r in others])
+        excess = mine[name] - med
+        if excess > best_excess:
+            best_stage, best_excess = name, excess
+    return {"straggler_rank": straggler, "dominant_stage": best_stage,
+            "excess_seconds": max(best_excess, 0.0)
+            if best_stage is not None else 0.0,
+            "rank_walls": rank_walls, "stage_seconds": stage_seconds,
+            "op_seconds": op_seconds}
+
+
+def stage_coverage(evts, op: str) -> "float | None":
+    """Fraction of the LAST top-level ``op`` span's wall covered by its
+    direct child spans — the "no dark time inside the op" metric the
+    bench trace artifact reports (acceptance: >= 0.8 for the headline
+    dist_join). None when no completed ``op`` span exists."""
+    matched = _matched_spans(evts)
+    tops = [(b, d) for b, d in matched
+            if b["name"] == op and b.get("parent") is None]
+    if not tops:
+        return None
+    top, top_dur = tops[-1]
+    if top_dur <= 0:
+        return 1.0
+    covered = sum(d for b, d in matched if b.get("parent") == top["id"])
+    return min(covered / top_dur, 1.0)

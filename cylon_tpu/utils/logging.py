@@ -19,6 +19,32 @@ _GLOG_LEVELS = {0: logging.INFO, 1: logging.WARNING,
 
 _initialized = False
 
+#: (rank, world) of the live process, set by ``CylonEnv.__init__`` —
+#: None until an env exists, so library users who never construct one
+#: keep the bare format.
+_WORLD: "tuple[int, int] | None" = None
+
+
+def set_world(rank: int, world: int) -> None:
+    """Record the process's (rank, world); every subsequent log record
+    is prefixed ``rank/world`` — on a multihost fleet the interleaved
+    stderr streams are unreadable without it (the reference's glog
+    lines carry the MPI rank the same way)."""
+    global _WORLD
+    _WORLD = (int(rank), int(world))
+
+
+class _RankFilter(logging.Filter):
+    """Injects ``record.rankprefix`` (``"[r/w] "`` once a CylonEnv is
+    live, ``""`` before) for the handler's format string. A filter
+    (not str concat at call sites) so EVERY record through the handler
+    gets it, including records from third-party code routed here."""
+
+    def filter(self, record):
+        record.rankprefix = (f"[{_WORLD[0]}/{_WORLD[1]}] "
+                             if _WORLD is not None else "")
+        return True
+
 
 def get_logger() -> logging.Logger:
     return logging.getLogger(_LOGGER_NAME)
@@ -36,8 +62,10 @@ def init_logging() -> None:
     if not logger.handlers:
         h = logging.StreamHandler()
         h.setFormatter(logging.Formatter(
-            "%(levelname).1s %(asctime)s %(name)s] %(message)s",
+            "%(levelname).1s %(asctime)s %(name)s] "
+            "%(rankprefix)s%(message)s",
             datefmt="%H:%M:%S"))
+        h.addFilter(_RankFilter())
         logger.addHandler(h)
     logger.propagate = False
     env = os.environ.get("CYLON_LOG_LEVEL")

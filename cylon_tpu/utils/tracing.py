@@ -13,7 +13,13 @@ XLA device traces in xprof/tensorboard (:func:`profile_to`).
 
 :func:`span`/:func:`profile_to`/:func:`timings` are kept as thin
 wrappers over the registry so existing callers (and their tests) are
-untouched; :class:`SpanStat` remains the aggregate view type.
+untouched; :class:`SpanStat` remains the aggregate view type. When the
+flight recorder is armed (``CYLON_TPU_TRACE`` —
+:mod:`cylon_tpu.telemetry.trace`), every span additionally emits
+begin/end events with parent nesting into the trace buffer, so the
+same instrumentation feeds the histogram aggregates AND the
+Chrome-trace timelines; with the recorder off, the only addition over
+the pre-recorder span is one env read.
 
 Caveat that doesn't exist in the reference: JAX dispatch is async, so a
 span around a jitted call measures *host orchestration* unless
@@ -25,6 +31,7 @@ import functools
 from dataclasses import dataclass, field
 
 from cylon_tpu import telemetry
+from cylon_tpu.telemetry import trace as _trace
 from cylon_tpu.utils.logging import get_logger
 
 #: the telemetry series spans record into (label ``name`` = span name)
@@ -55,23 +62,35 @@ class SpanStat:
 
 
 @contextlib.contextmanager
-def span(name: str, sync=None):
+def span(name: str, sync=None, cat: "str | None" = None, **targs):
     """Time a named region; optionally block on ``sync`` (any pytree of
-    jax arrays) so device work is included in the measurement."""
+    jax arrays) so device work is included in the measurement.
+
+    ``cat``/``**targs`` annotate the flight-recorder event when tracing
+    is armed (``cat="stage"`` marks the span as a stage for
+    :func:`cylon_tpu.telemetry.trace.critical_path` attribution);
+    they cost nothing when it is off. The per-span completion line logs
+    at DEBUG — at millions of spans an INFO line per span is pure noise
+    on hot paths; aggregate visibility is :func:`report`'s job."""
     import time
 
     import jax
 
     t0 = time.perf_counter()
-    with jax.profiler.TraceAnnotation(name):
-        try:
-            yield
-        finally:
-            if sync is not None:
-                jax.block_until_ready(sync)
-            dt = time.perf_counter() - t0
-            telemetry.timer(SPAN_METRIC, name=name).observe(dt)
-            get_logger().info("%s: %.3f ms", name, dt * 1e3)
+    tok = _trace.begin(name, cat=cat, **targs) if _trace.enabled() \
+        else None
+    try:
+        with jax.profiler.TraceAnnotation(name):
+            try:
+                yield
+            finally:
+                if sync is not None:
+                    jax.block_until_ready(sync)
+                dt = time.perf_counter() - t0
+                telemetry.timer(SPAN_METRIC, name=name).observe(dt)
+                get_logger().debug("%s: %.3f ms", name, dt * 1e3)
+    finally:
+        _trace.end(tok)
 
 
 def traced(name: str | None = None):
@@ -108,18 +127,30 @@ def reset_timings() -> None:
 
 
 def report() -> str:
-    """Human-readable table of span stats, slowest total first."""
+    """Human-readable table of span stats, slowest total first. The
+    p50/p99 columns come from the shared pow2 histogram buckets
+    (:meth:`cylon_tpu.telemetry.registry.Histogram.quantile`) — mean/
+    min/max alone hide tail latency, and the tail is where stragglers
+    live."""
+    insts = {}
+    for _, labels, inst in telemetry.instruments(SPAN_METRIC):
+        insts[labels.get("name", "?")] = inst
     snap = timings()
     if not snap:
         return "(no spans recorded)"
     rows = sorted(snap.items(), key=lambda kv: -kv[1].total_s)
     w = max(len(k) for k, _ in rows)
     lines = [f"{'span':<{w}}  {'count':>6}  {'total ms':>10}  "
-             f"{'mean ms':>9}  {'min ms':>8}  {'max ms':>8}"]
+             f"{'mean ms':>9}  {'min ms':>8}  {'p50 ms':>8}  "
+             f"{'p99 ms':>8}  {'max ms':>8}"]
     for k, s in rows:
+        inst = insts.get(k)
+        p50 = inst.quantile(0.5) if inst is not None else None
+        p99 = inst.quantile(0.99) if inst is not None else None
         lines.append(
             f"{k:<{w}}  {s.count:>6}  {s.total_s * 1e3:>10.3f}  "
             f"{s.total_s / s.count * 1e3:>9.3f}  {s.min_s * 1e3:>8.3f}  "
+            f"{(p50 or 0.0) * 1e3:>8.3f}  {(p99 or 0.0) * 1e3:>8.3f}  "
             f"{s.max_s * 1e3:>8.3f}")
     return "\n".join(lines)
 

@@ -253,12 +253,24 @@ def clear_timings() -> None:
     telemetry.reset("watchdog.")
 
 
-def straggler_report() -> "dict[str, dict]":
+def straggler_report(timeline: "list | None" = None) -> dict:
     """Per-section aggregate: count, mean/max elapsed, and how many
     expired — the quickest way to see which blocking layer is the
     straggler. A pure view over the telemetry registry (the
     :data:`SECTION_TIMER` histograms and :data:`SECTION_EXPIRED`
-    counters), not a second accumulation."""
+    counters), not a second accumulation.
+
+    **Fleet-aware form**: pass ``timeline`` — a merged multi-rank event
+    list from :func:`cylon_tpu.telemetry.trace.merge_timelines` (per-
+    rank buffers via ``trace.rank_buffers`` / ``gather_traces``) — and
+    the report instead walks the timeline and NAMES the straggler:
+    ``{"straggler_rank", "dominant_stage", "excess_seconds",
+    "rank_walls", "stage_seconds", ...}``
+    (:func:`cylon_tpu.telemetry.trace.critical_path`). The local form
+    can only say which *section* is slow on this host; the fleet form
+    says which *rank* is slow and in which stage."""
+    if timeline is not None:
+        return telemetry.trace.critical_path(timeline)
     agg: dict[str, dict] = {}
     for _, labels, inst in telemetry.instruments(SECTION_TIMER):
         sec = labels.get("section", "?")
@@ -301,6 +313,12 @@ def _finish(rec: _Section, expired: bool) -> None:
     telemetry.add_record(SECTION_RECORDS, SectionTiming(
         rec.section, rec.detail, elapsed, rec.budget, expired,
         rec.dump_after))
+    # flight recorder: one complete slice per section, cat="stage" — the
+    # unit trace.critical_path attributes straggler wall time to (the
+    # section start exists only in monotonic time, so the recorder
+    # back-dates it from the elapsed duration)
+    telemetry.trace.complete(rec.section, elapsed, cat="stage",
+                             detail=rec.detail, expired=expired)
 
 
 # ------------------------------------------------------------- the monitor
@@ -388,6 +406,10 @@ class _Monitor:
     def _fire(self, rec: _Section) -> None:
         now = time.monotonic()
         rec.dump_after = now - rec.started
+        telemetry.trace.instant("watchdog.expired", cat="watchdog",
+                                section=rec.section, detail=rec.detail,
+                                elapsed=rec.dump_after,
+                                budget=rec.budget)
         pol = default_deadline_policy()
         header = (
             f"cylon_tpu watchdog: section {rec.section!r}"

@@ -1,0 +1,395 @@
+"""Flight recorder: trace timelines, Chrome export, straggler naming.
+
+Pins the ISSUE 5 contracts: the recorder allocates NOTHING while
+``CYLON_TPU_TRACE`` is unset (the telemetry/watchdog fast-path
+contract), spans nest with parent ids, the buffer is bounded, merged
+multi-rank timelines align by clock offset, the Chrome Trace exporter
+emits strict JSON with monotone timestamps and matched B/E pairs, and
+— the acceptance scenario — a ``FaultRule(delay=)`` on one rank's
+exchange point makes ``critical_path`` / ``straggler_report`` name
+that rank and the exchange stage deterministically.
+"""
+
+import json
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from cylon_tpu import telemetry
+from cylon_tpu.telemetry import trace
+
+requires_shard_map = pytest.mark.skipif(
+    not hasattr(jax, "shard_map"),
+    reason="jax.shard_map unavailable (the jax-0.4.37 seed gap): the "
+           "distributed dispatch cannot run on this jax")
+
+
+@pytest.fixture
+def armed(monkeypatch):
+    """Arm the recorder with a FRESH buffer; disarm + drop it after."""
+    monkeypatch.setattr(trace, "_RECORDER", None)
+    monkeypatch.setenv("CYLON_TPU_TRACE", "1")
+    yield
+    monkeypatch.setattr(trace, "_RECORDER", None)
+
+
+# ------------------------------------------------------------- fast path
+def test_no_recorder_allocations_threads_or_handles_when_off(
+        monkeypatch):
+    """The acceptance fast-path pin: with CYLON_TPU_TRACE unset, span/
+    instant/counter emission allocates no recorder, starts no thread
+    and opens no file — the module global stays None."""
+    monkeypatch.delenv("CYLON_TPU_TRACE", raising=False)
+    monkeypatch.setattr(trace, "_RECORDER", None)
+    before = set(threading.enumerate())
+    from cylon_tpu.utils import tracing
+
+    assert not trace.enabled()
+    with tracing.span("off_span"):
+        trace.instant("off_instant", x=1)
+        trace.counter("off_counter", 1)
+        trace.complete("off_complete", 0.1)
+        with trace.span("off_inner"):
+            pass
+    assert trace._RECORDER is None          # zero allocations
+    assert trace.events() == []
+    assert trace.dropped() == 0
+    assert set(threading.enumerate()) == before
+    # ...and the span still fed the metric registry as before
+    assert telemetry.metric("tracing.span_seconds",
+                            name="off_span") is not None
+
+
+# ------------------------------------------------------------- recorder
+def test_span_nesting_records_parent_ids(armed):
+    with trace.span("outer"):
+        with trace.span("inner", cat="stage", k=1):
+            trace.instant("tick")
+    evts = trace.events()
+    kinds = [e["kind"] for e in evts]
+    assert kinds == ["begin", "begin", "instant", "end", "end"]
+    outer_b, inner_b, tick, inner_e, outer_e = evts
+    assert outer_b["parent"] is None
+    assert inner_b["parent"] == outer_b["id"]
+    assert tick["parent"] == inner_b["id"]
+    assert inner_b["cat"] == "stage" and inner_b["args"] == {"k": 1}
+    assert inner_e["id"] == inner_b["id"]
+    assert outer_e["ts"] >= outer_b["ts"]
+
+
+def test_buffer_is_bounded_and_counts_drops(armed, monkeypatch):
+    monkeypatch.setenv("CYLON_TPU_TRACE_EVENTS", "16")
+    monkeypatch.setattr(trace, "_RECORDER", None)
+    for i in range(50):
+        trace.instant("e", i=i)
+    evts = trace.events()
+    assert len(evts) == 16
+    assert trace.dropped() == 34
+    # oldest dropped first: the survivors are the newest 16
+    assert [e["args"]["i"] for e in evts] == list(range(34, 50))
+
+
+def test_clear_resets_buffer(armed):
+    trace.instant("x")
+    assert trace.events()
+    trace.clear()
+    assert trace.events() == [] and trace.dropped() == 0
+
+
+def test_end_without_arming_is_noop(monkeypatch):
+    monkeypatch.delenv("CYLON_TPU_TRACE", raising=False)
+    trace.end(None)  # the token emitted while off
+
+
+# ------------------------------------------------------ merge + analysis
+def _stage_evt(name, ts, dur, **extra):
+    return dict({"kind": "complete", "name": name, "ts": ts,
+                 "dur": dur, "tid": 1, "cat": "stage", "args": {}},
+                **extra)
+
+
+def test_merge_timelines_subtracts_clock_offsets():
+    bufs = [
+        {"rank": 0, "clock_offset": 0.0,
+         "events": [_stage_evt("exchange", 10.0, 0.01)]},
+        {"rank": 1, "clock_offset": 5.0,     # rank1's clock runs 5s fast
+         "events": [_stage_evt("exchange", 15.0, 0.01)]},
+    ]
+    merged = trace.merge_timelines(bufs)
+    assert [e["rank"] for e in merged] == [0, 1]
+    # after alignment the two exchanges are simultaneous on rank0's clock
+    assert merged[0]["ts"] == merged[1]["ts"] == 10.0
+    assert sorted(e["ts"] for e in merged) == [e["ts"] for e in merged]
+
+
+def test_critical_path_names_straggler_rank_and_stage():
+    bufs = []
+    for r in range(4):
+        dur = 0.5 if r == 2 else 0.05
+        bufs.append({"rank": r, "clock_offset": 0.0, "events": [
+            _stage_evt("exchange", 1.0, dur),
+            _stage_evt("spill_io", 1.0 + dur, 0.02),
+        ]})
+    rep = trace.critical_path(trace.merge_timelines(bufs))
+    assert rep["straggler_rank"] == 2
+    assert rep["dominant_stage"] == "exchange"
+    assert rep["excess_seconds"] == pytest.approx(0.45, abs=1e-6)
+    assert rep["stage_seconds"][2]["exchange"] == pytest.approx(0.5)
+    assert set(rep["rank_walls"]) == {0, 1, 2, 3}
+
+
+def test_critical_path_falls_back_to_top_level_spans():
+    def span_pair(rank, name, t0, dur):
+        return [{"kind": "begin", "name": name, "ts": t0, "tid": 1,
+                 "id": 1, "parent": None, "cat": None, "args": {}},
+                {"kind": "end", "name": name, "ts": t0 + dur, "tid": 1,
+                 "id": 1}]
+
+    bufs = [{"rank": r, "clock_offset": 0.0,
+             "events": span_pair(r, "dist_sort", 0.0,
+                                 0.4 if r == 1 else 0.1)}
+            for r in range(3)]
+    rep = trace.critical_path(trace.merge_timelines(bufs))
+    assert rep["straggler_rank"] == 1
+    assert rep["dominant_stage"] == "dist_sort"
+
+
+def test_critical_path_empty_timeline():
+    rep = trace.critical_path([])
+    assert rep["straggler_rank"] is None
+    assert rep["dominant_stage"] is None
+
+
+def test_rank_buffers_single_process_wraps_local_events(armed):
+    trace.instant("x")
+    bufs = trace.rank_buffers()
+    assert len(bufs) == 1
+    assert bufs[0]["rank"] == 0 and bufs[0]["clock_offset"] == 0.0
+    assert [e["name"] for e in bufs[0]["events"]] == ["x"]
+
+
+def test_clock_offset_zero_on_single_controller(env1):
+    assert env1.clock_offset() == 0.0
+
+
+# --------------------------------------------------------- chrome export
+def _no_const(_):
+    raise AssertionError("non-finite constant leaked into the export")
+
+
+def test_chrome_export_strict_json_monotone_and_matched(armed):
+    with trace.span("op"):
+        with trace.span("op.dispatch", cat="stage"):
+            trace.instant("exchange.dispatch", op="op", bytes_true=128,
+                          bytes_padded=256, rows_shards=[3, 5],
+                          counter="exchange.rows")
+        trace.counter("exchange.bytes_true", 128, op="op")
+    trace.complete("exchange", 0.02, cat="stage",
+                   nan_arg=float("nan"), inf_arg=float("inf"))
+    text = telemetry.chrome_trace_json(trace.rank_buffers(), world=2)
+    # strict JSON: a NaN/Infinity constant anywhere fails the parse
+    doc = json.loads(text, parse_constant=_no_const)
+    evts = doc["traceEvents"]
+    body = [e for e in evts if e["ph"] != "M"]
+    ts = [e["ts"] for e in body]
+    assert ts == sorted(ts), "Chrome trace requires monotone ts"
+    # matched B/E pairs per (pid, tid)
+    stacks = {}
+    for e in body:
+        if e["ph"] == "B":
+            stacks.setdefault((e["pid"], e["tid"]), []).append(e["name"])
+        elif e["ph"] == "E":
+            st = stacks.get((e["pid"], e["tid"]))
+            assert st, f"E without B: {e}"
+            st.pop()
+    assert all(not st for st in stacks.values()), stacks
+    # per-shard counter tracks + process metadata
+    pids = {e["pid"] for e in evts}
+    names = {e.get("name") for e in evts}
+    assert {10000, 10001} <= pids          # SHARD_PID_BASE + shard
+    assert "exchange.rows" in names and "process_name" in names
+    assert any(e["ph"] == "C" for e in body)
+    assert any(e["ph"] == "X" for e in body)
+    # the NaN/inf args came through as null, never as Infinity text
+    assert "Infinity" not in text and "NaN" not in text
+
+
+def test_chrome_export_closes_ring_orphaned_spans(armed, monkeypatch):
+    """A begin whose end was ring-evicted must not unbalance the
+    export: orphan E events drop, still-open B events are closed."""
+    monkeypatch.setenv("CYLON_TPU_TRACE_EVENTS", "16")
+    monkeypatch.setattr(trace, "_RECORDER", None)
+    toks = [trace.begin(f"s{i}") for i in range(3)]
+    for i in range(20):
+        trace.instant("flood", i=i)  # evicts the begins
+    for t in reversed(toks):
+        trace.end(t)
+    doc = json.loads(telemetry.chrome_trace_json(trace.rank_buffers()))
+    body = [e for e in doc["traceEvents"] if e["ph"] != "M"]
+    depth = 0
+    for e in body:
+        depth += {"B": 1, "E": -1}.get(e["ph"], 0)
+        assert depth >= 0
+    assert depth == 0
+
+
+def test_write_chrome_trace_artifact(armed, tmp_path):
+    trace.instant("x")
+    path = str(tmp_path / "t.trace.json")
+    out = telemetry.write_chrome_trace(path, trace.rank_buffers())
+    assert out == path
+    doc = json.loads(open(path).read(), parse_constant=_no_const)
+    assert "traceEvents" in doc
+
+
+# ------------------------------------------------- engine instrumentation
+def test_watchdog_sections_emit_stage_completes(armed):
+    from cylon_tpu import watchdog
+
+    with watchdog.watched_section("ooc_pass", detail="unit"):
+        pass
+    stages = [e for e in trace.events()
+              if e["kind"] == "complete" and e.get("cat") == "stage"]
+    assert stages and stages[-1]["name"] == "ooc_pass"
+    assert stages[-1]["args"]["detail"] == "unit"
+    assert stages[-1]["args"]["expired"] is False
+
+
+def test_fault_and_retry_emit_instants(armed):
+    from cylon_tpu import resilience
+    from cylon_tpu.config import RetryPolicy
+    from cylon_tpu.errors import TransientError
+
+    plan = resilience.FaultPlan([resilience.FaultRule("io_read")])
+    with resilience.active(plan):
+        with pytest.raises(TransientError):
+            resilience.inject("io_read")
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 2:
+            raise TransientError("flake")
+        return "ok"
+
+    resilience.retrying(flaky, RetryPolicy(max_attempts=3,
+                                           base_delay=0.0),
+                        sleep_fn=lambda _: None)
+    names = [e["name"] for e in trace.events()]
+    assert "resilience.fault" in names
+    assert "resilience.retry" in names
+
+
+def test_spill_store_emits_slices_and_instants(armed, tmp_path):
+    from cylon_tpu import resilience
+
+    store = resilience.SpillStore(str(tmp_path), fingerprint="fp")
+    store.write_bucket(0, {"a": np.arange(16)}, 16)
+    store.read_bucket(0)
+    evts = trace.events()
+    names = [e["name"] for e in evts]
+    assert "spill.write" in names and "spill.read" in names
+    wr = [e for e in evts if e["name"] == "spill.write"
+          and e["kind"] == "instant"]
+    assert wr and wr[0]["args"]["bytes"] == 16 * 8
+
+
+def test_tracing_span_feeds_recorder_and_registry(armed):
+    from cylon_tpu.utils import tracing
+
+    with tracing.span("both_worlds"):
+        pass
+    assert any(e["name"] == "both_worlds" for e in trace.events())
+    assert tracing.timings()["both_worlds"].count >= 1
+    tracing.reset_timings()
+
+
+# --------------------------------------- acceptance: fault-delay straggler
+def _shuffle_once(env, table):
+    from cylon_tpu.parallel import dist_ops
+
+    return dist_ops.shuffle(env, table, ["k"])
+
+
+@requires_shard_map
+def test_fault_delay_names_straggler_rank_and_exchange_stage(
+        env8, rng, armed):
+    """ISSUE 5 acceptance: FaultRule(delay=0.2) on ONE rank's exchange
+    point -> the merged timeline's straggler report names that rank and
+    the exchange stage. One recorder run per simulated rank plays the
+    role of the per-process buffers gather_traces returns on a real
+    multihost fleet."""
+    from cylon_tpu import resilience, watchdog
+    from cylon_tpu.parallel import scatter_table
+    from cylon_tpu.table import Table
+
+    n = 256
+    t = scatter_table(env8, Table.from_pydict(
+        {"k": rng.integers(0, 64, n), "v": rng.normal(size=n)}))
+    _shuffle_once(env8, t)  # warm-up: XLA compile + probe/count memos
+
+    def _wall(evts):
+        ts = [e["ts"] for e in evts]
+        return (max(e["ts"] + e.get("dur", 0.0) for e in evts)
+                - min(ts)) if ts else 0.0
+
+    bufs = []
+    try:
+        for r in range(4):
+            # times=0: the delay fires on EVERY exchange hit, so every
+            # rep of the faulted rank stalls; keeping each rank's
+            # min-wall rep filters one-off host noise (a GC pause, an
+            # XLA retrace spike) that the 0.2 s signal must beat
+            env8.set_fault_plan(resilience.FaultPlan(
+                [resilience.FaultRule("exchange", delay=0.2, times=0)])
+                if r == 1 else None)
+            reps = []
+            for _ in range(3):
+                trace.clear()
+                _shuffle_once(env8, t)
+                reps.append(trace.events())
+            best = min(reps, key=_wall)
+            bufs.append({"rank": r, "clock_offset": 0.0,
+                         "events": best})
+    finally:
+        env8.set_fault_plan(None)
+    merged = trace.merge_timelines(bufs)
+    rep = trace.critical_path(merged)
+    assert rep["straggler_rank"] == 1
+    assert rep["dominant_stage"] == "exchange"
+    # the 0.2 s injected delay minus the other ranks' median jitter:
+    # well clear of noise, but not the full 0.2 (host scheduling eats
+    # a slice of any sleep-based signal)
+    assert rep["excess_seconds"] >= 0.1
+    # the fleet-aware watchdog report is the same verdict
+    rep2 = watchdog.straggler_report(timeline=merged)
+    assert rep2["straggler_rank"] == 1
+    assert rep2["dominant_stage"] == "exchange"
+
+
+@requires_shard_map
+def test_dist_join_stage_coverage_at_least_80pct(env8, rng, armed):
+    """The bench-artifact acceptance, pinned at tier-1: the per-stage
+    slices under an eager dist_join span account for >= 80% of the
+    op's measured wall (no dark time the timeline cannot explain)."""
+    from cylon_tpu.parallel import dist_join, scatter_table
+    from cylon_tpu.table import Table
+
+    n = 256
+    lt = scatter_table(env8, Table.from_pydict(
+        {"k": rng.integers(0, 64, n), "a": rng.normal(size=n)}))
+    rt = scatter_table(env8, Table.from_pydict(
+        {"k": rng.integers(0, 64, n), "b": rng.normal(size=n)}))
+    trace.clear()
+    dist_join(env8, lt, rt, on="k", how="inner")
+    cov = trace.stage_coverage(trace.events(), "dist_join")
+    assert cov is not None and cov >= 0.8, cov
+    # and the exchange instant priced the dispatch with byte fields
+    xs = [e for e in trace.events() if e["name"] == "exchange.dispatch"]
+    assert xs and xs[-1]["args"]["bytes_true"] > 0
+    assert xs[-1]["args"]["bytes_padded"] >= xs[-1]["args"]["bytes_true"]
+    shards = xs[-1]["args"]["rows_shards"]
+    assert shards is not None and len(shards) == env8.world_size
+    assert sum(shards) == 2 * n

@@ -70,8 +70,26 @@ def test_report_renders():
         pass
     out = tracing.report()
     assert "span" in out and "a" in out and "count" in out
+    # tail-latency columns derived from the shared histogram buckets
+    assert "p50 ms" in out and "p99 ms" in out
     tracing.reset_timings()
     assert "no spans" in tracing.report()
+
+
+def test_report_percentiles_track_the_tail():
+    from cylon_tpu import telemetry
+
+    t = telemetry.timer(tracing.SPAN_METRIC, name="tailspan")
+    for _ in range(90):
+        t.observe(0.001)
+    for _ in range(10):
+        t.observe(8.0)  # the straggler tail
+    p50, p99 = t.quantile(0.5), t.quantile(0.99)
+    # p50 stays near the body, p99 reaches into the tail bucket
+    assert p50 is not None and p50 <= 0.01
+    assert p99 is not None and p99 >= 1.0
+    out = tracing.report()
+    assert "tailspan" in out
 
 
 def test_log_levels():
@@ -88,15 +106,43 @@ def test_log_levels():
     assert logger.level == logging.WARNING
 
 
-def test_span_logs_at_info(caplog):
-    log_level(0)
+def test_span_logs_at_debug_not_info(caplog):
+    """The per-span completion line is DEBUG (ISSUE 5 satellite): at
+    millions of spans an INFO line per span is pure noise on hot
+    paths — INFO must stay quiet, DEBUG must still carry the line."""
     logger = get_logger()
     logger.propagate = True
     try:
         with caplog.at_level(logging.INFO, logger="cylon_tpu"):
+            with tracing.span("quiet"):
+                pass
+        assert not any("quiet" in r.message for r in caplog.records)
+        caplog.clear()
+        with caplog.at_level(logging.DEBUG, logger="cylon_tpu"):
             with tracing.span("logged"):
                 pass
-        assert any("logged" in r.message for r in caplog.records)
+        recs = [r for r in caplog.records if "logged" in r.message]
+        assert recs and recs[0].levelno == logging.DEBUG
     finally:
         logger.propagate = False
         log_level(1)
+
+
+def test_rank_world_prefix_once_env_is_live():
+    """utils.logging satellite: with a CylonEnv live, the handler's
+    filter stamps every record with the process's rank/world."""
+    from cylon_tpu.utils import logging as clog
+
+    f = clog._RankFilter()
+    rec = logging.LogRecord("cylon_tpu", logging.INFO, __file__, 1,
+                            "msg", (), None)
+    old = clog._WORLD
+    try:
+        clog._WORLD = None
+        f.filter(rec)
+        assert rec.rankprefix == ""
+        clog.set_world(3, 8)
+        f.filter(rec)
+        assert rec.rankprefix == "[3/8] "
+    finally:
+        clog._WORLD = old
