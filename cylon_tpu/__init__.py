@@ -135,11 +135,13 @@ from cylon_tpu.errors import (
     Code,
     DataLossError,
     DeadlineExceeded,
+    FailedPrecondition,
     IndexError_,
     InvalidArgument,
     KeyError_,
     NotImplemented_,
     OutOfCapacity,
+    ResourceExhausted,
     TransientError,
     TypeError_,
 )
@@ -167,8 +169,10 @@ __all__ = [
     "DataLossError",
     "DeadlineExceeded",
     "DeadlinePolicy",
+    "FailedPrecondition",
     "FaultPlan",
     "FaultRule",
+    "ResourceExhausted",
     "RetryPolicy",
     "deadline",
     "TransientError",

@@ -5,40 +5,128 @@ registry mapping string ids to tables (``PutTable/GetTable/RemoveTable``,
 ``table_api.hpp:38-90``) with every relational op mirrored on ids
 (``JoinTables(ctx, "left", "right", ...)``). In the reference this layer
 exists to give the Java JNI binding a stable C surface; here it is the
-FFI/embedding surface for non-Python hosts of the TPU runtime.
+FFI/embedding surface for non-Python hosts of the TPU runtime — and,
+since the serving layer (:mod:`cylon_tpu.serve`), the **resident-table
+store** of the always-on engine: tables register once, concurrent
+queries :func:`pin` them for their lifetime (refcounted per holder),
+:func:`drop` refuses pinned tables with a
+:class:`~cylon_tpu.errors.FailedPrecondition` naming the holders, and
+:func:`stats` reports per-table rows/bytes/pins.
 """
 
+import collections
+import contextlib
 import threading
 from typing import Mapping, Sequence
 
 from cylon_tpu.config import JoinConfig
-from cylon_tpu.errors import InvalidArgument, KeyError_
+from cylon_tpu.errors import FailedPrecondition, InvalidArgument, KeyError_
 from cylon_tpu.table import Table
 
 _lock = threading.Lock()
 _catalog: dict[str, Table] = {}
+#: table id -> Counter of holder labels (pin refcounts). A pinned table
+#: cannot be dropped: the serving layer pins every resident table a
+#: request reads for the request's lifetime, so a concurrent ``drop``
+#: fails loudly at the drop site (naming the holders) instead of as a
+#: confusing late KeyError inside whichever query lost the race.
+_pins: "dict[str, collections.Counter]" = {}
 
 
 def put_table(table_id: str, table: Table) -> None:
-    """Parity: ``PutTable`` (table_api.hpp:38)."""
+    """Parity: ``PutTable`` (table_api.hpp:38). Re-registering an id is
+    an overwrite — but not while the old table is pinned (an in-flight
+    reader must never see its input swapped underneath it)."""
     if not isinstance(table, Table):
         raise InvalidArgument(f"not a Table: {type(table)}")
     with _lock:
+        _require_unpinned(table_id, "overwrite")
         _catalog[table_id] = table
 
 
-def get_table(table_id: str) -> Table:
-    """Parity: ``GetTable``."""
+def get_table(table_id: str, pin_for: "str | None" = None) -> Table:
+    """Parity: ``GetTable``. ``pin_for=holder`` additionally pins the
+    table under ``holder`` in the same lock hold — the atomic
+    lookup-and-pin a concurrent reader needs (a separate get + pin
+    could lose a drop race in between)."""
     with _lock:
         if table_id not in _catalog:
             raise KeyError_(f"no table registered under {table_id!r}")
+        if pin_for is not None:
+            _pins.setdefault(table_id,
+                             collections.Counter())[str(pin_for)] += 1
         return _catalog[table_id]
 
 
-def remove_table(table_id: str) -> None:
-    """Parity: ``RemoveTable``."""
+def _require_unpinned(table_id: str, verb: str) -> None:
+    holders = _pins.get(table_id)
+    if holders:
+        names = sorted(holders)
+        raise FailedPrecondition(
+            f"cannot {verb} table {table_id!r}: pinned by "
+            f"{sum(holders.values())} holder(s) {names}; drop waits "
+            "until every holder unpins")
+
+
+def pin(table_id: str, holder: str = "anonymous") -> None:
+    """Refcount ``table_id`` under ``holder`` so :func:`drop` refuses
+    it. Pins nest (one count per call); unpin with the same holder."""
     with _lock:
-        _catalog.pop(table_id, None)
+        if table_id not in _catalog:
+            raise KeyError_(f"no table registered under {table_id!r}")
+        _pins.setdefault(table_id, collections.Counter())[str(holder)] += 1
+
+
+def unpin(table_id: str, holder: str = "anonymous") -> None:
+    """Release one pin held by ``holder`` (unknown pins raise — an
+    unbalanced unpin is a refcount bug, not a no-op)."""
+    with _lock:
+        holders = _pins.get(table_id)
+        if not holders or holders[str(holder)] <= 0:
+            raise InvalidArgument(
+                f"table {table_id!r} holds no pin for {holder!r}")
+        holders[str(holder)] -= 1
+        if holders[str(holder)] <= 0:
+            del holders[str(holder)]
+        if not holders:
+            _pins.pop(table_id, None)
+
+
+@contextlib.contextmanager
+def pinned(table_id: str, holder: str = "anonymous"):
+    """``with catalog.pinned("lineitem", holder=req_id) as t:`` — the
+    table, pinned for the scope (the per-request discipline
+    :mod:`cylon_tpu.serve` applies around every query)."""
+    t = get_table(table_id, pin_for=holder)
+    try:
+        yield t
+    finally:
+        unpin(table_id, holder)
+
+
+def pins(table_id: str) -> "dict[str, int]":
+    """Live pin counts per holder (empty when unpinned/unknown)."""
+    with _lock:
+        return dict(_pins.get(table_id, ()))
+
+
+def drop(table_id: str, *, if_exists: bool = True) -> None:
+    """Remove ``table_id`` — unless pinned, in which case a
+    :class:`~cylon_tpu.errors.FailedPrecondition` NAMES the holders
+    (the serve-layer contract: a resident table a query is reading
+    cannot vanish mid-flight)."""
+    with _lock:
+        if table_id not in _catalog:
+            if if_exists:
+                return
+            raise KeyError_(f"no table registered under {table_id!r}")
+        _require_unpinned(table_id, "drop")
+        del _catalog[table_id]
+
+
+def remove_table(table_id: str) -> None:
+    """Parity: ``RemoveTable`` — now pin-respecting (see :func:`drop`)."""
+    drop(table_id, if_exists=True)
 
 
 def list_tables() -> list[str]:
@@ -46,9 +134,56 @@ def list_tables() -> list[str]:
         return sorted(_catalog)
 
 
+def table_nbytes(table: Table) -> int:
+    """Device bytes held by ``table``'s buffers (data + validity),
+    summed over columns — no host sync (buffer shapes are static)."""
+    total = 0
+    for c in table.columns.values():
+        total += c.data.size * c.data.dtype.itemsize
+        if c.validity is not None:
+            total += c.validity.size * c.validity.dtype.itemsize
+    return total
+
+
+def stats() -> "dict[str, dict]":
+    """Per-table catalog statistics: ``{id: {rows, bytes, capacity,
+    columns, distributed, pins, holders}}`` — the resident-table
+    inventory ``cylon_tpu.serve`` reports. ``rows`` is the true row
+    count (summed across shards for distributed tables; one small host
+    fetch per table); tables whose count is not host-reachable (e.g.
+    under trace) report ``rows=None``."""
+    import numpy as np
+
+    from cylon_tpu.parallel import dtable
+
+    with _lock:
+        items = list(_catalog.items())
+        pin_view = {k: dict(v) for k, v in _pins.items()}
+    out = {}
+    for tid, t in items:
+        try:
+            rows = int(np.asarray(t.nrows).sum())
+        except Exception:
+            rows = None
+        holders = pin_view.get(tid, {})
+        out[tid] = {
+            "rows": rows,
+            "bytes": table_nbytes(t),
+            "capacity": int(t.capacity),
+            "columns": t.num_columns,
+            "distributed": bool(dtable.is_distributed(t)),
+            "pins": sum(holders.values()),
+            "holders": sorted(holders),
+        }
+    return out
+
+
 def clear() -> None:
+    """Drop everything, pins included (test/teardown hatch — the
+    pin-respecting path is :func:`drop`)."""
     with _lock:
         _catalog.clear()
+        _pins.clear()
 
 
 # ---------------------------------------------------------------- id ops

@@ -112,6 +112,7 @@ DEADLINE_SECTIONS: "dict[str, float | None]" = {
     "spill_io": None,        # SpillStore bucket write/read
     "ooc_pass": None,        # out-of-core join/groupby/sort passes
     "exchange": None,        # shuffle/repartition/dist_join dispatch
+    "serve_request": None,   # one serve-layer query step (cylon_tpu.serve)
 }
 
 

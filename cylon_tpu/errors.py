@@ -36,6 +36,13 @@ class Code(enum.IntEnum):
     Unavailable = 14
     DataLoss = 15
     DeadlineExceeded = 16
+    # serving-layer extensions (cylon_tpu.serve): 8 takes gRPC's
+    # RESOURCE_EXHAUSTED number (free in the reference enum); gRPC's
+    # FAILED_PRECONDITION number (9) is already the reference's
+    # UnknownError, so it takes the next free slot after the deadline
+    # code instead.
+    ResourceExhausted = 8
+    FailedPrecondition = 17
     CodeGenError = 40
     ExpressionValidationError = 41
     ExecutionError = 42
@@ -120,6 +127,30 @@ class DeadlineExceeded(CylonError):
         self.section = section
         self.elapsed = elapsed
         self.retryable = bool(retryable)
+
+
+class FailedPrecondition(CylonError):
+    """The operation is valid in general but not against the current
+    state of the system: dropping a catalog table that an in-flight
+    query still pins (:func:`cylon_tpu.catalog.drop` names the
+    holders), closing a session with live requests. Not retryable as-is
+    — the caller must change the state (unpin, drain) first. Without
+    this the failure surfaced as a confusing late ``KeyError`` deep in
+    whichever query lost the race."""
+
+    code = Code.FailedPrecondition
+
+
+class ResourceExhausted(CylonError):
+    """A bounded serving resource is at capacity — the admission queue
+    of :class:`cylon_tpu.serve.ServeEngine` is full. Raised FAST at
+    submit time (the serving layer's load-shedding contract: reject in
+    microseconds instead of piling requests onto a saturated mesh).
+    Retryable from the *client's* side after backoff, but never
+    auto-retried by the engine — re-queueing internally would just
+    rebuild the pile-up the cap exists to prevent."""
+
+    code = Code.ResourceExhausted
 
 
 class OutOfCapacity(CylonError):

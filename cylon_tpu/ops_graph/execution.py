@@ -15,7 +15,14 @@ from cylon_tpu.ops_graph.op import Op
 
 
 class Execution:
-    """Parity: ``Execution`` (execution.hpp:28-37)."""
+    """Parity: ``Execution`` (execution.hpp:28-37).
+
+    The reference constructs one Execution per query graph and drops it
+    at completion. The serving layer (:mod:`cylon_tpu.serve`) instead
+    keeps ONE long-lived Execution whose op set churns as requests are
+    admitted and retired — hence :meth:`add_op` / :meth:`remove_op` on
+    the mutable schedules (RoundRobin/Priority), which the reference
+    never needed."""
 
     def progress(self) -> bool:  # pragma: no cover - abstract
         raise NotImplementedError
@@ -26,7 +33,9 @@ class Execution:
 
 
 class RoundRobinExecution(Execution):
-    """Each op progresses once per sweep (execution.hpp:43-55)."""
+    """Each op progresses once per sweep (execution.hpp:43-55) — the
+    serve layer's fair-share default: every live query advances one
+    step per sweep regardless of how many steps it still holds."""
 
     def __init__(self, ops: Sequence[Op] = ()):
         self._ops = list(ops)
@@ -34,9 +43,22 @@ class RoundRobinExecution(Execution):
     def add_op(self, op: Op) -> None:
         self._ops.append(op)
 
+    def remove_op(self, op: Op) -> None:
+        """Retire a completed op from the schedule (no-op if absent) —
+        the long-lived serving loop retires finished queries instead of
+        rebuilding the execution each sweep."""
+        try:
+            self._ops.remove(op)
+        except ValueError:
+            pass
+
+    @property
+    def ops(self) -> list[Op]:
+        return list(self._ops)
+
     def progress(self) -> bool:
         did = False
-        for op in self._ops:
+        for op in list(self._ops):
             did |= op.progress()
         return did
 
@@ -48,17 +70,34 @@ class RoundRobinExecution(Execution):
 class PriorityExecution(Execution):
     """Ops progress proportionally to integer priorities
     (execution.hpp:57-81 — the reference expands priorities into a
-    round-robin multiset)."""
+    round-robin multiset). The serve layer maps tenant weight onto the
+    priority: a weight-3 tenant's query takes three steps per sweep to
+    a weight-1 tenant's one."""
 
-    def __init__(self, ops_with_priority: Sequence[tuple[Op, int]]):
-        self._ops = [op for op, _ in ops_with_priority]
+    def __init__(self, ops_with_priority: Sequence[tuple[Op, int]] = ()):
+        self._ops: list[Op] = []
         self._schedule: list[Op] = []
         for op, prio in ops_with_priority:
-            self._schedule.extend([op] * max(int(prio), 1))
+            self.add_op(op, prio)
+
+    def add_op(self, op: Op, priority: int = 1) -> None:
+        self._ops.append(op)
+        self._schedule.extend([op] * max(int(priority), 1))
+
+    def remove_op(self, op: Op) -> None:
+        try:
+            self._ops.remove(op)
+        except ValueError:
+            return
+        self._schedule = [o for o in self._schedule if o is not op]
+
+    @property
+    def ops(self) -> list[Op]:
+        return list(self._ops)
 
     def progress(self) -> bool:
         did = False
-        for op in self._schedule:
+        for op in list(self._schedule):
             did |= op.progress()
         return did
 

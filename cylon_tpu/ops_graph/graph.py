@@ -35,9 +35,17 @@ from cylon_tpu.table import Table
 def chunk_stream(table: Table, chunk_rows: int) -> Iterable[Table]:
     """Slice a host-backed table into capacity-``chunk_rows`` chunks (the
     ingest side of the streaming pipeline; parity: the reference streams
-    arrow record batches)."""
+    arrow record batches). Each chunk is a cooperative deadline
+    checkpoint: a streamed ingest running inside an ambient
+    :func:`cylon_tpu.watchdog.deadline` scope (a serve request's SLO,
+    an OOC pass's budget) raises promptly between chunks instead of
+    streaming past an expired deadline — attributed to the enclosing
+    watched section, whichever layer that is."""
+    from cylon_tpu import watchdog
+
     n = table.num_rows
     for lo in range(0, max(n, 1), chunk_rows):
+        watchdog.check(detail="chunk_stream")
         hi = min(lo + chunk_rows, n)
         idx = jnp.arange(lo, lo + chunk_rows, dtype=jnp.int32)
         from cylon_tpu.ops.selection import take_columns

@@ -11,6 +11,7 @@ sink) collects final tables and drives ``WaitForCompletion``
 import collections
 from typing import Callable, Iterable, Optional
 
+from cylon_tpu import telemetry
 from cylon_tpu.errors import InvalidArgument
 from cylon_tpu.table import Table
 
@@ -48,6 +49,9 @@ class Op:
         self._finalized_parents = 0
         self._did_finalize = False
         self._execute_fn = execute
+        #: chunks this op has processed (progress-loop visibility; the
+        #: per-op twin of the ``ops_graph.chunks`` counter)
+        self.processed = 0
 
     # -- graph wiring ----------------------------------------------------
     def add_child(self, child: "Op") -> "Op":
@@ -86,12 +90,19 @@ class Op:
     # -- progress loop ---------------------------------------------------
     def progress(self) -> bool:
         """Process at most one queued chunk (parity: ``Op::Progress``,
-        parallel_op.hpp:128-144). Returns True if work was done."""
+        parallel_op.hpp:128-144). Returns True if work was done.
+        Each processed chunk counts into ``ops_graph.chunks{op=}``
+        (tenant-labeled under an ambient
+        :func:`cylon_tpu.telemetry.tenant_scope`), so a mixed serving
+        workload's streaming progress is attributable per tenant."""
         if not self._queue:
             return False
         chunk = self._queue.popleft()
         for out in self.execute(chunk.tag, chunk.table):
             self._emit(out)
+        self.processed += 1
+        telemetry.counter("ops_graph.chunks", op=self.name,
+                          **telemetry.tenant_labels()).inc()
         return True
 
     def _emit(self, chunk: TableChunk) -> None:

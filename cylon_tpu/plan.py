@@ -31,9 +31,11 @@ Two pieces:
   directly via :class:`CompiledQuery`'s scale memo.
 """
 
+import collections
 import contextlib
 import contextvars
 import functools
+import threading
 
 import jax
 
@@ -43,7 +45,8 @@ from cylon_tpu.telemetry import trace as _trace
 
 __all__ = ["capacity_scale", "current_scale", "compile_query",
            "CompiledQuery", "MAX_SCALE", "note_overflow",
-           "tight_enabled", "current_row_hint", "row_hint"]
+           "tight_enabled", "current_row_hint", "row_hint",
+           "shared_compiled", "plan_cache_stats"]
 
 #: regrow ceiling: 2^10 = 1024x the default budget. Buffers grow only as
 #: far as the retry that fits (geometric, ~10 re-dispatches worst case);
@@ -379,17 +382,34 @@ class CompiledQuery:
     def __init__(self, fn, *, check=True):
         self._fn = fn
         self._check = check
+        #: ONE lock for the three memo structures below. A CompiledQuery
+        #: is shared across serving threads (``shared_compiled``) — the
+        #: memos must obey a lock discipline: every read-modify-write
+        #: (LRU reorder, widen-only merge, first-sight counting) holds
+        #: ``_mu``; the expensive part (the jitted dispatch itself)
+        #: never does. jax.jit's own executable cache is thread-safe,
+        #: so concurrent first calls at worst trace twice — the memo
+        #: bookkeeping here must never corrupt, double-count, or lose
+        #: a widen under that race.
+        self._mu = threading.Lock()
         self._scale_memo: dict = {}  # static key -> known-good scale
-        #: (static key, scale, dyn-arg shape signature) triples already
-        #: dispatched — first sight of a triple is (at most) one fresh
-        #: XLA program build, counted as ``plan.compile_count`` (the
-        #: persistent on-disk cache may make some of these cheap; the
-        #: counter tracks program-shape churn, which is what the
-        #: capacity ladder is sized to bound). The shape signature
-        #: matters: the same static key re-traces when a dynamic
-        #: argument's buffer shapes change (pow2 capacities of bigger
-        #: inputs), and those recompiles are exactly the churn.
-        self._compiled: set = set()
+        #: (static key, scale, row hint, dyn-arg shape signature)
+        #: 4-tuples already dispatched, LRU-ordered — first sight of a
+        #: tuple is (at most) one fresh XLA program build, counted as
+        #: ``plan.compile_count`` (the persistent on-disk cache may make
+        #: some of these cheap; the counter tracks program-shape churn,
+        #: which is what the capacity ladder is sized to bound). The
+        #: shape signature matters: the same static key re-traces when a
+        #: dynamic argument's buffer shapes change (pow2 capacities of
+        #: bigger inputs), and those recompiles are exactly the churn.
+        #: Re-sight of a tuple is a ``plan.cache_hits``; first sight a
+        #: ``plan.cache_misses``; the store is bounded
+        #: (``CYLON_TPU_PLAN_CACHE_ENTRIES``) with oldest-first
+        #: eviction counted as ``plan.cache_evictions`` — eviction
+        #: forgets only the seen-shape bookkeeping (a later identical
+        #: call re-counts a miss; jax's executable cache still holds
+        #: the program).
+        self._compiled: collections.OrderedDict = collections.OrderedDict()
         #: static key -> per-result-table pow2 capacity buckets. After
         #: the first call observes the result sizes, later calls
         #: compile a variant that emits bucket-sized output buffers —
@@ -433,8 +453,9 @@ class CompiledQuery:
 
         dyn_pos, static_pos, static_kw, dyn_kw = _split_args(args, kwargs)
         key = (static_pos, static_kw)
-        scale = self._scale_memo.get(key, 1)
-        buckets = self._size_memo.get(key) if self._check else None
+        with self._mu:
+            scale = self._scale_memo.get(key, 1)
+            buckets = self._size_memo.get(key) if self._check else None
         # the count-driven row bucket rides the compile key: pow2
         # bucketing means it changes (and retraces) only when the
         # input's true row count crosses a power of two, exactly like
@@ -450,8 +471,22 @@ class CompiledQuery:
             for x in jax.tree_util.tree_leaves((tuple(dyn_pos),
                                                 dyn_kw)))
         while True:
-            if (key, scale, hint, shape_sig) not in self._compiled:
-                self._compiled.add((key, scale, hint, shape_sig))
+            entry = (key, scale, hint, shape_sig)
+            with self._mu:
+                hit = entry in self._compiled
+                if hit:
+                    self._compiled.move_to_end(entry)
+                else:
+                    self._compiled[entry] = True
+                    evicted = 0
+                    while len(self._compiled) > _cache_entries():
+                        self._compiled.popitem(last=False)
+                        evicted += 1
+            telemetry.counter("plan.cache_hits" if hit
+                              else "plan.cache_misses").inc()
+            if not hit:
+                if evicted:
+                    telemetry.counter("plan.cache_evictions").inc(evicted)
                 telemetry.counter("plan.compile_count").inc()
                 _trace.instant("plan.compile", cat="plan", scale=scale,
                                row_hint=hint,
@@ -500,26 +535,91 @@ class CompiledQuery:
                     _trace.instant("capacity.regrow", cat="capacity",
                                    site="compiled", scale=scale)
                     continue
-            self._scale_memo[key] = scale
             observed = tuple(
                 None if dtable.is_distributed(t)
                 else pow2_bucket(int(np.asarray(t.nrows)))
                 for t in _result_tables(out))
-            old = self._size_memo.get(key)
-            if old is not None:
-                # widen-only: shrinking the memo would make every
-                # later larger-result call pay a wasted bucketed
-                # dispatch + overflow round trip before widening back
-                observed = tuple(
-                    None if n is None
-                    else (n if o is None else max(o, n))
-                    for o, n in zip(old, observed))
-            if observed != old and any(b is not None for b in observed):
-                # all-None/empty buckets (scalar-only or distributed
-                # results) would recompile an identical program for a
-                # no-op _apply_buckets — leave the memo unset
-                self._size_memo[key] = observed
+            with self._mu:
+                # scale memo is widen-only too: a concurrent call that
+                # regrew further must not be clobbered back down by a
+                # call that succeeded at a smaller scale
+                if scale > self._scale_memo.get(key, 0):
+                    self._scale_memo[key] = scale
+                old = self._size_memo.get(key)
+                if old is not None:
+                    # widen-only: shrinking the memo would make every
+                    # later larger-result call pay a wasted bucketed
+                    # dispatch + overflow round trip before widening
+                    # back (and, under concurrency, lose a racing
+                    # call's wider observation)
+                    observed = tuple(
+                        None if n is None
+                        else (n if o is None else max(o, n))
+                        for o, n in zip(old, observed))
+                if observed != old and any(b is not None
+                                           for b in observed):
+                    # all-None/empty buckets (scalar-only or
+                    # distributed results) would recompile an identical
+                    # program for a no-op _apply_buckets — leave the
+                    # memo unset
+                    self._size_memo[key] = observed
             return _shrink_results(out)
+
+
+def _cache_entries() -> int:
+    """Bound on the per-query seen-shape LRU (``CYLON_TPU_PLAN_CACHE_ENTRIES``,
+    default 4096 — far above any sane shape churn; the knob exists so a
+    pathological workload can't grow the bookkeeping without bound)."""
+    import os
+
+    try:
+        return max(int(os.environ.get("CYLON_TPU_PLAN_CACHE_ENTRIES",
+                                      "4096")), 1)
+    except ValueError:
+        return 4096
+
+
+#: process-wide compiled-query cache: (fn, check) -> CompiledQuery.
+#: THE cross-request plan cache of the serving layer — N clients
+#: submitting the same query function share ONE CompiledQuery, so the
+#: pow2 input-row bucket + shape signature becomes the cross-request
+#: cache key and the N-1 later clients' calls are ``plan.cache_hits``
+#: (one trace paid for the fleet).
+_SHARED_MU = threading.Lock()
+_SHARED: "dict[tuple, CompiledQuery]" = {}
+
+
+def shared_compiled(fn, *, check: bool = True) -> CompiledQuery:
+    """Get-or-create the process-wide :class:`CompiledQuery` for ``fn``
+    (keyed on the function object + ``check``). Unlike
+    :func:`compile_query` — which builds a fresh program cache per call
+    site — every caller of ``shared_compiled(q3)`` shares one scale/
+    size/shape memo, which is what makes a multi-tenant serving layer
+    pay one trace per query shape instead of one per client."""
+    key = (fn, bool(check))
+    cq = _SHARED.get(key)
+    if cq is None:
+        with _SHARED_MU:
+            cq = _SHARED.get(key)
+            if cq is None:
+                cq = functools.wraps(fn)(CompiledQuery(fn, check=check))
+                _SHARED[key] = cq
+    return cq
+
+
+def plan_cache_stats() -> dict:
+    """Hit/miss/eviction totals of the compiled-plan cache plus the
+    derived hit rate — the block the serve bench record embeds."""
+    hits = telemetry.total("plan.cache_hits")
+    misses = telemetry.total("plan.cache_misses")
+    looked = hits + misses
+    return {
+        "hits": hits,
+        "misses": misses,
+        "evictions": telemetry.total("plan.cache_evictions"),
+        "hit_rate": (hits / looked) if looked else 0.0,
+        "shared_queries": len(_SHARED),
+    }
 
 
 def _is_dynamic(x) -> bool:

@@ -34,6 +34,7 @@ restarting (see ``outofcore.ooc_sort(resume_dir=...)``).
 """
 
 import contextlib
+import contextvars
 import dataclasses
 import hashlib
 import itertools
@@ -53,9 +54,9 @@ from cylon_tpu.errors import (Code, CylonError, DataLossError,
 
 __all__ = [
     "INJECTION_POINTS", "FaultRule", "FaultPlan", "install", "active",
-    "active_plan", "inject", "is_retryable", "default_policy",
-    "backoff_delays", "retrying", "RowAccount", "accounting_enabled",
-    "SpillStore",
+    "scoped", "active_plan", "inject", "is_retryable",
+    "default_policy", "backoff_delays", "retrying", "RowAccount",
+    "accounting_enabled", "SpillStore",
 ]
 
 #: Named places the engine agrees to fail on demand. Each maps to a real
@@ -176,8 +177,11 @@ class FaultPlan:
                     hit = r
         if hit is None:
             return
-        telemetry.counter("resilience.faults_injected",
-                          point=point).inc()
+        # tenant label: under the serve layer's ambient tenant scope
+        # the firing is attributed to the tenant whose query stream hit
+        # it — the "unpolluted metrics" half of fault isolation
+        telemetry.counter("resilience.faults_injected", point=point,
+                          **telemetry.tenant_labels()).inc()
         _trace.instant("resilience.fault", cat="resilience",
                        point=point, hit=k, detail=detail,
                        delay=hit.delay)
@@ -217,7 +221,11 @@ def active_plan() -> "FaultPlan | None":
 
 @contextlib.contextmanager
 def active(plan: FaultPlan):
-    """``with resilience.active(plan): ...`` — scoped installation."""
+    """``with resilience.active(plan): ...`` — scoped installation of
+    the PROCESS-WIDE plan (every thread sees it; the chaos-drill
+    shape). For a plan that must only apply to the current execution
+    context — one serve request among concurrent workloads — use
+    :func:`scoped`."""
     prev = install(plan)
     try:
         yield plan
@@ -225,13 +233,39 @@ def active(plan: FaultPlan):
         install(prev)
 
 
+#: context-local fault-plan overlay: visible only to the installing
+#: context (and workers spawned with ``copy_context`` — the request's
+#: own bounded calls), NEVER to unrelated threads. The serving layer
+#: installs per-request plans here so one tenant's injected faults
+#: cannot leak into another workload running concurrently in the
+#: process.
+_SCOPED_PLAN: contextvars.ContextVar = contextvars.ContextVar(
+    "cylon_fault_plan", default=None)
+
+
+@contextlib.contextmanager
+def scoped(plan: "FaultPlan | None"):
+    """``with resilience.scoped(plan): ...`` — context-local
+    installation (contextvar, not the process global): injection
+    points consult it only from this context, after any env-registered
+    plan and before the process-wide one."""
+    tok = _SCOPED_PLAN.set(plan)
+    try:
+        yield plan
+    finally:
+        _SCOPED_PLAN.reset(tok)
+
+
 def inject(point: str, detail: str = "", env=None) -> None:
-    """Instrumentation hook: a no-op unless a plan is active. ``env``
-    lets mesh ops prefer a plan registered on their CylonEnv over the
-    process-wide one."""
+    """Instrumentation hook: a no-op unless a plan is active.
+    Precedence: a plan registered on the op's CylonEnv, then the
+    context-local :func:`scoped` plan, then the process-wide
+    :func:`install`/:func:`active` plan."""
     if point not in _POINT_SET:
         raise InvalidArgument(f"unknown injection point {point!r}")
     plan = getattr(env, "_fault_plan", None) if env is not None else None
+    if plan is None:
+        plan = _SCOPED_PLAN.get()
     plan = plan if plan is not None else _ACTIVE
     if plan is not None:
         plan.check(point, detail)
@@ -315,7 +349,8 @@ def retrying(fn, policy: "RetryPolicy | None" = None, *,
             d = next(delays)
             code = getattr(getattr(e, "code", None), "name", None) \
                 or type(e).__name__
-            telemetry.counter("resilience.retries", code=code).inc()
+            telemetry.counter("resilience.retries", code=code,
+                              **telemetry.tenant_labels()).inc()
             _trace.instant("resilience.retry", cat="resilience",
                            code=code, attempt=attempt,
                            label=label or "", backoff_s=d)

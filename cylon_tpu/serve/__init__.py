@@ -1,0 +1,21 @@
+"""cylon_tpu.serve — the always-on multi-tenant query service.
+
+One resident mesh, many concurrent queries: a long-lived
+:class:`ServeEngine` admits requests against shared resident tables
+(:mod:`cylon_tpu.catalog` pins), schedules them through the
+:mod:`cylon_tpu.ops_graph` execution strategies (RoundRobin fair-share
+/ Priority tenant weights), bounds each under a per-request SLO
+(:func:`cylon_tpu.watchdog.deadline`), shares one compiled-plan cache
+across clients (:func:`cylon_tpu.plan.shared_compiled`) and meters
+everything per tenant (``serve.*`` + tenant-labeled instruments).
+``python -m cylon_tpu.serve.bench --clients 8`` replays a mixed TPC-H
+workload against it. See ``docs/serving.md``.
+"""
+
+from cylon_tpu.serve.admission import (AdmissionController, ServePolicy,
+                                       default_policy)
+from cylon_tpu.serve.service import QueryTicket, ServeEngine
+from cylon_tpu.serve.session import Session
+
+__all__ = ["ServeEngine", "QueryTicket", "Session", "ServePolicy",
+           "AdmissionController", "default_policy"]

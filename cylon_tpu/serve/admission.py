@@ -1,0 +1,126 @@
+"""Admission control for the always-on engine: load-shed fast, never
+pile up.
+
+The serving contract "millions of users" fails first at the front
+door: an engine that accepts every request under overload turns one
+slow query into unbounded queue growth, memory pressure and a p99 that
+never recovers. The reference has no serving layer at all (one mpirun
+= one query); the closest production analog is gRPC's
+RESOURCE_EXHAUSTED discipline, which this module adopts:
+
+* a **queue-depth cap** (``max_queue``) on live (queued + running)
+  requests — a submit over the cap raises
+  :class:`~cylon_tpu.errors.ResourceExhausted` *immediately* (a dict
+  check under one lock, no device work, no blocking), so the client
+  learns to back off in microseconds instead of timing out minutes
+  later;
+* a **default SLO** (``default_slo``) stamped on every admitted
+  request that doesn't bring its own — the per-request
+  :func:`cylon_tpu.watchdog.deadline` budget the scheduler enforces at
+  every step;
+* the **schedule policy** (``roundrobin`` fair-share default, or
+  ``priority`` weighted by tenant priority) the scheduler drives
+  through the :mod:`cylon_tpu.ops_graph.execution` strategies.
+
+Knobs (all env-overridable — the ``CYLON_TPU_SERVE_*`` family, read at
+engine construction; see ``docs/serving.md``):
+
+=========================== ============================== =========
+env                         meaning                        default
+=========================== ============================== =========
+``CYLON_TPU_SERVE_MAX_QUEUE``  live-request cap            ``64``
+``CYLON_TPU_SERVE_SLO``        default per-request SLO (s; ``0`` =
+                               unbounded)                  ``0``
+``CYLON_TPU_SERVE_SCHEDULE``   ``roundrobin`` | ``priority``
+                                                           roundrobin
+=========================== ============================== =========
+"""
+
+import dataclasses
+import os
+import threading
+
+from cylon_tpu import telemetry
+from cylon_tpu.errors import InvalidArgument, ResourceExhausted
+
+__all__ = ["ServePolicy", "default_policy", "AdmissionController"]
+
+_SCHEDULES = ("roundrobin", "priority")
+
+
+@dataclasses.dataclass(frozen=True)
+class ServePolicy:
+    """Engine-level admission/scheduling knobs (see module docstring)."""
+
+    max_queue: int = 64
+    default_slo: "float | None" = None
+    schedule: str = "roundrobin"
+
+    def __post_init__(self):
+        if self.max_queue < 1:
+            raise InvalidArgument(
+                f"max_queue must be >= 1, got {self.max_queue}")
+        if self.schedule not in _SCHEDULES:
+            raise InvalidArgument(
+                f"unknown schedule {self.schedule!r}; valid: "
+                f"{_SCHEDULES}")
+        if self.default_slo is not None and self.default_slo <= 0:
+            raise InvalidArgument(
+                f"default_slo must be > 0 seconds or None, got "
+                f"{self.default_slo}")
+
+
+def default_policy() -> ServePolicy:
+    """The process :class:`ServePolicy` with ``CYLON_TPU_SERVE_*`` env
+    overrides (read per call so tests can flip them)."""
+    e = os.environ
+    slo = float(e.get("CYLON_TPU_SERVE_SLO", "0"))
+    return ServePolicy(
+        max_queue=int(e.get("CYLON_TPU_SERVE_MAX_QUEUE", "64")),
+        default_slo=slo if slo > 0 else None,
+        schedule=e.get("CYLON_TPU_SERVE_SCHEDULE", "roundrobin"),
+    )
+
+
+class AdmissionController:
+    """The queue-depth gate in front of the scheduler.
+
+    ``admit(tenant)`` either takes one live slot or raises
+    :class:`~cylon_tpu.errors.ResourceExhausted` naming the depth and
+    cap (counted per tenant as ``serve.rejected{tenant=}``); every
+    admit is balanced by exactly one ``release()`` when the request
+    retires (done, failed, or expired). ``serve.queue_depth`` gauges
+    the live count after every transition."""
+
+    def __init__(self, policy: "ServePolicy | None" = None):
+        self.policy = policy or default_policy()
+        self._mu = threading.Lock()
+        self._live = 0
+
+    @property
+    def live(self) -> int:
+        with self._mu:
+            return self._live
+
+    def admit(self, tenant: str) -> None:
+        with self._mu:
+            if self._live >= self.policy.max_queue:
+                depth = self._live
+                admitted = False
+            else:
+                self._live += 1
+                depth = self._live
+                admitted = True
+        telemetry.gauge("serve.queue_depth").set(depth)
+        if not admitted:
+            telemetry.counter("serve.rejected", tenant=tenant).inc()
+            raise ResourceExhausted(
+                f"serve queue full: {depth} live requests >= cap "
+                f"{self.policy.max_queue} (tenant {tenant!r}); "
+                "back off and retry")
+
+    def release(self) -> None:
+        with self._mu:
+            self._live = max(self._live - 1, 0)
+            depth = self._live
+        telemetry.gauge("serve.queue_depth").set(depth)

@@ -1,0 +1,282 @@
+"""serve_bench: N concurrent clients replaying a mixed TPC-H workload
+against one :class:`~cylon_tpu.serve.ServeEngine`.
+
+The serving acceptance harness (ROADMAP item 4): ``--clients N``
+(default 8) client threads, each its own tenant/session, fire a mixed
+TPC-H query stream (default mix q1/q3/q5/q6 — groupby-heavy, 3-way
+join, 6-way join, scalar aggregate) at a shared engine holding the
+TPC-H tables RESIDENT on one mesh. Every result is compared against a
+single-query oracle (the same query run once, alone, before serving
+starts), so the run proves correctness under concurrency, not just
+liveness. One JSON record lands on stdout with the schema pinned by
+:data:`REQUIRED_SERVE_FIELDS` (and ``tests/test_bench_guard.py``):
+p50/p99 request latency from the ``serve.request_seconds`` histogram
+quantiles, throughput (qps), plan-cache hit rate (the shared
+compiled-plan cache means N clients with one query shape pay one
+trace), and rejected/expired/error counts.
+
+Run (CPU-host mesh, the same 8-virtual-device topology tier-1 uses)::
+
+    python -m cylon_tpu.serve.bench --clients 8
+
+Knobs: ``--requests`` per client (default 2), ``--sf`` scale factor
+(default 0.002), ``--schedule roundrobin|priority``, ``--slo`` seconds
+(default unbounded), ``--max-queue``, ``--seed``, plus the
+``CYLON_TPU_SERVE_*`` env family (``docs/serving.md``).
+"""
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+# CPU-host mesh by default (like tests/conftest.py): harmless on a real
+# TPU backend — the flag only shapes the *host* platform's device count
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+
+import numpy as np
+
+#: serve-record fields the serving trajectory depends on — emit asserts
+#: them and ``tests/test_bench_guard.py`` pins the set, so a refactor
+#: cannot silently drop the latency quantiles or the cache-hit column.
+REQUIRED_SERVE_FIELDS = frozenset({
+    "metric", "clients", "requests_total", "tenants", "schedule",
+    "p50_s", "p99_s", "qps", "cache_hit_rate", "rejected", "errors",
+    "expired", "oracle_mismatches",
+})
+
+#: default mixed workload: groupby-heavy scan, 3-way join + top-k,
+#: 6-way join, and a scalar aggregate — four distinct shapes so the
+#: schedule interleaves genuinely different pipelines
+DEFAULT_MIX = ("q1", "q3", "q5", "q6")
+
+
+def _emit_record(line: dict):
+    """The ONE stdout sink for serve bench records: attaches the
+    telemetry ``metrics`` block like every other bench driver (schema
+    lint in tests/test_bench_guard.py). Telemetry must never fail a
+    bench."""
+    line = dict(line)
+    try:
+        from cylon_tpu import telemetry
+
+        line["metrics"] = telemetry.bench_metrics()
+    except Exception as e:  # pragma: no cover - import-time breakage
+        line["metrics"] = {"telemetry_error": f"{type(e).__name__}: {e}"}
+    print(json.dumps(line))
+
+
+def _materialize(out):
+    """Host-side result of a query call: DataFrames/Tables gather to
+    pandas, scalars to float — the client-visible payload."""
+    if hasattr(out, "to_pandas"):
+        return out.to_pandas().reset_index(drop=True)
+    arr = np.asarray(out)
+    if arr.ndim == 0:
+        return float(arr)
+    return arr
+
+
+def _results_match(got, want) -> bool:
+    """Order-insensitive equality between a served result and its
+    single-query oracle (float columns to 1e-9 rtol)."""
+    import pandas as pd
+
+    if isinstance(want, float):
+        return bool(np.isclose(float(got), want, rtol=1e-9))
+    if not isinstance(want, pd.DataFrame):
+        return bool(np.allclose(np.asarray(got), np.asarray(want)))
+    if list(got.columns) != list(want.columns) or len(got) != len(want):
+        return False
+    keys = [c for c in want.columns
+            if not np.issubdtype(want[c].dtype, np.floating)]
+    g = got.sort_values(keys or list(got.columns)).reset_index(drop=True)
+    w = want.sort_values(keys or list(want.columns)).reset_index(drop=True)
+    for c in want.columns:
+        if np.issubdtype(want[c].dtype, np.floating):
+            if not np.allclose(g[c].to_numpy(), w[c].to_numpy(),
+                               rtol=1e-9):
+                return False
+        elif list(g[c]) != list(w[c]):
+            return False
+    return True
+
+
+def _staged_query(cq, resident, env):
+    """A two-step generator query for the scheduler: step 1 runs the
+    compiled program (dispatch + overflow check), step 2 materialises
+    the result to the host — so while one request's result fetch (or
+    XLA in-flight work) drains, the schedule is already dispatching the
+    next tenant's step."""
+
+    def run():
+        out = cq(resident, env=env)
+        yield  # step boundary: result fetch happens on the next sweep
+        return _materialize(out)
+
+    return run
+
+
+def _mk_resident(env, data):
+    """Lay the TPC-H tables out on the mesh ONCE and register them in
+    the catalog (``tpch/<name>``) — the shared resident store every
+    request reads; returns the {name: DataFrame} mapping queries take."""
+    from cylon_tpu import tpch
+    from cylon_tpu.frame import DataFrame
+    from cylon_tpu.parallel import scatter_table
+
+    resident = {}
+    for name, df in tpch.ingest(data).items():
+        if env is not None and env.is_distributed:
+            df = DataFrame._wrap(scatter_table(env, df.table))
+        resident[name] = df
+    return resident
+
+
+def run_bench(clients: int = 8, requests: int = 2, sf: float = 0.002,
+              schedule: str = "roundrobin", slo: "float | None" = None,
+              max_queue: "int | None" = None, seed: int = 0,
+              mix=DEFAULT_MIX) -> dict:
+    import cylon_tpu as ct
+    from cylon_tpu import catalog, telemetry, tpch, watchdog
+    from cylon_tpu.errors import ResourceExhausted
+    from cylon_tpu.serve import ServeEngine, ServePolicy
+    from cylon_tpu.serve.admission import default_policy
+    from cylon_tpu.tpch import dbgen
+
+    env = ct.CylonEnv(ct.TPUConfig())
+    data = dbgen.generate(sf, seed)
+    resident = _mk_resident(env, data)
+    for name, df in resident.items():
+        catalog.put_table(f"tpch/{name}", df.table)
+
+    base = default_policy()
+    policy = ServePolicy(
+        max_queue=max_queue if max_queue is not None else base.max_queue,
+        default_slo=slo if slo and slo > 0 else base.default_slo,
+        schedule=schedule)
+
+    # single-query oracles: each mix query runs ONCE, alone, through
+    # the same shared compiled plan — every concurrent result must
+    # reproduce these exactly (and the serving run then hits the warm
+    # cross-request plan cache, which is the point of sharing it)
+    compiled = {q: tpch.compiled(q) for q in mix}
+    oracles = {q: _materialize(compiled[q](resident, env=env))
+               for q in mix}
+
+    engine = ServeEngine(env, policy)
+    mismatches = []
+    rejected_local = [0]
+    lock = threading.Lock()
+
+    def client(i: int):
+        # under the priority schedule, odd clients are weight-2
+        # tenants — they take two steps per sweep to the others' one
+        prio = 2 if (schedule == "priority" and i % 2) else 1
+        tenant = f"tenant{i}"
+        with engine.session(tenant, priority=prio,
+                            tables=[f"tpch/{n}" for n in resident]) as s:
+            tickets = []
+            for r in range(requests):
+                q = mix[(i + r) % len(mix)]
+                try:
+                    tickets.append(
+                        (q, s.submit(_staged_query(compiled[q],
+                                                   resident, env))))
+                except ResourceExhausted:
+                    with lock:
+                        rejected_local[0] += 1
+            for q, tk in tickets:
+                try:
+                    got = tk.result()
+                except Exception as e:
+                    with lock:
+                        mismatches.append((tenant, q,
+                                           f"{type(e).__name__}: {e}"))
+                    continue
+                if not _results_match(got, oracles[q]):
+                    with lock:
+                        mismatches.append((tenant, q, "result mismatch"))
+
+    # the whole replay runs inside the named serve_request watchdog
+    # section: a hung engine dumps stacks + raises under an ambient
+    # deadline instead of wedging the driver silently
+    t0 = time.perf_counter()
+    with watchdog.watched_section("serve_request",
+                                  detail="serve_bench replay"):
+        threads = [threading.Thread(target=client, args=(i,),
+                                    name=f"serve-client-{i}")
+                   for i in range(clients)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+    wall = time.perf_counter() - t0
+    engine.close(wait=True)
+
+    hist = telemetry.merge_histograms(
+        [inst for _, _, inst in
+         telemetry.instruments("serve.request_seconds")])
+    completed = telemetry.total("serve.completed")
+    cache = engine.plan_cache_stats()
+    record = {
+        "metric": "serve_bench_tpch_mix",
+        "clients": clients,
+        "requests_total": clients * requests,
+        "tenants": len(engine.tenant_stats()),
+        "schedule": schedule,
+        "sf": sf,
+        "wall_s": round(wall, 3),
+        "qps": round(completed / wall, 3) if wall > 0 else None,
+        "p50_s": (round(hist.quantile(0.5), 4)
+                  if hist is not None and hist.count else None),
+        "p99_s": (round(hist.quantile(0.99), 4)
+                  if hist is not None and hist.count else None),
+        "completed": completed,
+        "rejected": telemetry.total("serve.rejected"),
+        "errors": telemetry.total("serve.errors"),
+        "expired": telemetry.total("serve.expired"),
+        "cache_hit_rate": round(cache["hit_rate"], 4),
+        "cache_hits": cache["hits"],
+        "cache_misses": cache["misses"],
+        "oracle_mismatches": len(mismatches),
+        "mismatch_detail": mismatches[:8],
+        "resident_tables": len(resident),
+    }
+    return record
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--clients", type=int, default=8)
+    p.add_argument("--requests", type=int, default=2,
+                   help="queries per client")
+    p.add_argument("--sf", type=float, default=0.002)
+    p.add_argument("--schedule", default="roundrobin",
+                   choices=("roundrobin", "priority"))
+    p.add_argument("--slo", type=float, default=0.0,
+                   help="per-request SLO seconds (0 = unbounded)")
+    p.add_argument("--max-queue", type=int, default=None)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--mix", default=",".join(DEFAULT_MIX),
+                   help="comma-separated TPC-H query names")
+    args = p.parse_args(argv)
+
+    record = run_bench(
+        clients=args.clients, requests=args.requests, sf=args.sf,
+        schedule=args.schedule, slo=args.slo,
+        max_queue=args.max_queue, seed=args.seed,
+        mix=tuple(q.strip() for q in args.mix.split(",") if q.strip()))
+    missing = REQUIRED_SERVE_FIELDS - record.keys()
+    assert not missing, f"serve record dropped fields {missing}"
+    _emit_record(record)
+    # a replay that corrupted results or failed requests is a FAILED
+    # bench, not a slow one
+    return 1 if (record["oracle_mismatches"] or record["errors"]) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,448 @@
+"""The always-on query engine: one resident mesh, many tenants.
+
+Everything below :mod:`cylon_tpu.serve` is one-script-one-query; this
+module is the front door the ROADMAP's "millions of users" item calls
+for — a long-lived :class:`ServeEngine` that admits concurrent queries
+against shared resident tables and drives them to completion over ONE
+resident :class:`~cylon_tpu.context.CylonEnv`.
+
+Design — an assembly of the subsystems the previous PRs built:
+
+* **Admission** (:mod:`cylon_tpu.serve.admission`): a queue-depth cap
+  rejects over-cap submits with a fast
+  :class:`~cylon_tpu.errors.ResourceExhausted`; every admitted request
+  is stamped with an absolute SLO deadline (queue wait counts — the
+  client-visible contract).
+
+* **Scheduling**: each admitted request becomes a :class:`_QueryOp` —
+  an :class:`cylon_tpu.ops_graph.op.Op` whose ``progress()`` advances
+  the query one *step* — and ONE long-lived
+  :class:`~cylon_tpu.ops_graph.execution.RoundRobinExecution` (fair
+  share, the default) or
+  :class:`~cylon_tpu.ops_graph.execution.PriorityExecution` (tenant
+  weights) sweeps the live set exactly the way the reference's
+  parallel-op engine progresses concurrent op streams
+  (``ops/execution/execution.hpp``). Query functions may be plain
+  callables (one step) or **generator functions** (each ``yield`` is a
+  step boundary) — a staged query yields after its dispatch phase, so
+  while its XLA work is in flight on the mesh the scheduler is already
+  driving the next request's host-side phase: host→device transfer and
+  device compute interleave *across requests*.
+
+* **Per-request SLO** (:mod:`cylon_tpu.watchdog`): every step runs
+  under ``watchdog.deadline(remaining)`` inside a named
+  ``serve_request`` :func:`~cylon_tpu.watchdog.watched_section`, so a
+  wedged step dumps stacks and the request fails with
+  :class:`~cylon_tpu.errors.DeadlineExceeded` instead of stalling the
+  schedule; expired requests are refused *before* their next step runs.
+
+* **Shared compiled-plan cache** (:func:`cylon_tpu.plan.shared_compiled`):
+  submit compiled queries (e.g. ``tpch.compiled("q3")``) and N clients
+  with the same query shape pay ONE trace — later calls are
+  ``plan.cache_hits``.
+
+* **Per-tenant observability**: every step executes under
+  :func:`cylon_tpu.telemetry.tenant_scope`, so span timers, watchdog
+  sections, fault/retry counters and flight-recorder events all carry
+  the tenant label; request latency lands in
+  ``serve.request_seconds{tenant=}`` whose
+  :meth:`~cylon_tpu.telemetry.Histogram.quantile` supplies per-tenant
+  p50/p99 (:meth:`ServeEngine.tenant_stats`).
+
+* **Fault isolation**: a per-request
+  :class:`~cylon_tpu.resilience.FaultPlan` is installed only around
+  that request's steps (the scheduler runs steps one at a time, so the
+  scope can never leak into another tenant's step), and resident-table
+  pins (:func:`cylon_tpu.catalog.pin`) keep a concurrent ``drop`` from
+  yanking a table out from under an in-flight query.
+"""
+
+import contextlib
+import itertools
+import threading
+import time
+
+from cylon_tpu import catalog, plan, resilience, telemetry, watchdog
+from cylon_tpu.errors import (DeadlineExceeded, FailedPrecondition,
+                              InvalidArgument)
+from cylon_tpu.ops_graph.execution import (PriorityExecution,
+                                           RoundRobinExecution)
+from cylon_tpu.ops_graph.op import Op
+from cylon_tpu.serve.admission import AdmissionController, ServePolicy
+from cylon_tpu.telemetry import trace as _trace
+from cylon_tpu.utils import tracing
+
+__all__ = ["QueryTicket", "ServeEngine"]
+
+#: request lifecycle states (QueryTicket.state)
+QUEUED, RUNNING, DONE, FAILED = "queued", "running", "done", "failed"
+
+
+class QueryTicket:
+    """Handle for one admitted request (the client's future)."""
+
+    def __init__(self, rid: int, tenant: str, priority: int,
+                 slo: "float | None"):
+        self.rid = rid
+        self.tenant = tenant
+        self.priority = priority
+        self.slo = slo
+        self.submitted = time.monotonic()
+        #: absolute SLO expiry (monotonic); queue wait counts against
+        #: the budget — the latency the CLIENT sees is the contract
+        self.deadline_at = (None if slo is None
+                            else self.submitted + float(slo))
+        self.started: "float | None" = None
+        self.finished: "float | None" = None
+        self.state = QUEUED
+        self.value = None
+        self.error: "BaseException | None" = None
+        self._event = threading.Event()
+
+    def remaining(self) -> "float | None":
+        """Seconds of SLO budget left (None = unbounded)."""
+        if self.deadline_at is None:
+            return None
+        return self.deadline_at - time.monotonic()
+
+    @property
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def wait(self, timeout: "float | None" = None) -> bool:
+        return self._event.wait(timeout)
+
+    def result(self, timeout: "float | None" = None):
+        """Block for the result; re-raise the request's failure."""
+        if not self._event.wait(timeout):
+            raise DeadlineExceeded(
+                f"result({timeout=}) timed out waiting on request "
+                f"{self.rid} (tenant {self.tenant!r}, state "
+                f"{self.state})", section="serve_request")
+        if self.error is not None:
+            raise self.error
+        return self.value
+
+    def __repr__(self):
+        return (f"QueryTicket(rid={self.rid}, tenant={self.tenant!r}, "
+                f"state={self.state})")
+
+
+class _QueryOp(Op):
+    """One admitted request as a schedulable op node.
+
+    ``progress()`` advances the query by one step: for a generator
+    function each ``yield`` delimits a step (``StopIteration.value`` is
+    the result); a plain callable is a single step. Steps run under the
+    request's tenant scope + remaining-SLO deadline + per-request fault
+    plan + the ``serve_request`` watchdog section — all scoped to the
+    step, so nothing leaks into the next op the schedule sweeps."""
+
+    def __init__(self, op_id: int, engine: "ServeEngine",
+                 ticket: QueryTicket, fn, args, kwargs,
+                 fault_plan, pins: "list[str]"):
+        super().__init__(op_id, name=f"QueryOp[{ticket.tenant}]")
+        self._engine = engine
+        self.ticket = ticket
+        self._fn = fn
+        self._args = args
+        self._kwargs = kwargs
+        self._fault_plan = fault_plan
+        self._pins = pins
+        self._gen = None
+        self._step = 0
+
+    def done(self) -> bool:
+        return self.ticket.done
+
+    def progress(self) -> bool:  # one scheduled step
+        t = self.ticket
+        if t.done:
+            return False
+        try:
+            rem = t.remaining()
+            if rem is not None and rem <= 0:
+                telemetry.counter("serve.expired", tenant=t.tenant).inc()
+                raise DeadlineExceeded(
+                    f"request {t.rid} (tenant {t.tenant!r}) missed its "
+                    f"{t.slo:.3f}s SLO after {self._step} step(s)",
+                    section="serve_request",
+                    elapsed=time.monotonic() - t.submitted)
+            self._run_step(rem)
+        except BaseException as e:  # noqa: BLE001 - isolate per request
+            self._engine._retire(self, error=e)
+        return True
+
+    def _run_step(self, rem: "float | None") -> None:
+        t = self.ticket
+        if t.started is None:
+            t.started = time.monotonic()
+            t.state = RUNNING
+            telemetry.timer("serve.queue_wait_seconds",
+                            tenant=t.tenant).observe(
+                                t.started - t.submitted)
+        with contextlib.ExitStack() as stack:
+            # ORDER matters: the tenant scope first (so every nested
+            # metric/trace/section carries the label), then the SLO
+            # budget, then the request's fault plan — scoped to this
+            # step only, which is the whole isolation argument
+            stack.enter_context(telemetry.tenant_scope(t.tenant))
+            if rem is not None:
+                stack.enter_context(watchdog.deadline(
+                    rem, label=f"serve:{t.rid}"))
+            if self._fault_plan is not None:
+                # context-LOCAL install (contextvar, not the process
+                # global): a noisy tenant's plan is invisible to any
+                # other thread reaching an injection point, and it
+                # propagates into watchdog workers via copy_context
+                stack.enter_context(resilience.scoped(self._fault_plan))
+            stack.enter_context(tracing.span(
+                "serve.step", cat="serve", rid=t.rid, step=self._step))
+            stack.enter_context(watchdog.watched_section(
+                "serve_request", detail=f"{t.tenant}/{t.rid}"
+                f"#{self._step}"))
+            self._step += 1
+            if self._gen is None:
+                first = self._fn(*self._args, **self._kwargs)
+                if hasattr(first, "__next__"):  # generator query
+                    self._gen = first
+                else:  # plain callable: one step, done
+                    self._engine._retire(self, value=first)
+                    return
+            try:
+                next(self._gen)
+            except StopIteration as fin:
+                self._engine._retire(self, value=fin.value)
+
+
+class ServeEngine:
+    """The long-lived multi-tenant query service (module docstring).
+
+    One engine per process/mesh is the intended shape; the env is
+    resident for the engine's lifetime. Thread-safe: many client
+    threads submit; ONE scheduler thread executes steps (the same
+    single-threaded progress model the reference's parallel-op engine
+    runs between MPI calls — concurrency comes from interleaving steps
+    and from XLA's async dispatch, not from racing host threads into
+    the mesh)."""
+
+    _ids = itertools.count(1)
+
+    def __init__(self, env=None, policy: "ServePolicy | None" = None):
+        self._env = env
+        self._admission = AdmissionController(policy)
+        self._policy = self._admission.policy
+        if self._policy.schedule == "priority":
+            self._exec = PriorityExecution()
+        else:
+            self._exec = RoundRobinExecution()
+        self._cond = threading.Condition()
+        self._thread: "threading.Thread | None" = None
+        self._closed = False
+        self._op_ids = itertools.count(1)
+
+    # ------------------------------------------------- resident tables
+    @property
+    def env(self):
+        return self._env
+
+    def register_table(self, table_id: str, table) -> None:
+        """Register a resident table (Table or DataFrame) in the
+        process catalog under ``table_id`` — the shared store every
+        request reads through (pin-protected; see
+        :func:`cylon_tpu.catalog.drop`)."""
+        t = getattr(table, "table", table)
+        catalog.put_table(table_id, t)
+
+    def drop_table(self, table_id: str) -> None:
+        """Pin-respecting drop: raises
+        :class:`~cylon_tpu.errors.FailedPrecondition` naming the
+        holders while any session/request still pins the table."""
+        catalog.drop(table_id, if_exists=False)
+
+    def table_stats(self) -> dict:
+        """Per-table rows/bytes/pins of the resident catalog."""
+        return catalog.stats()
+
+    def session(self, tenant: str, priority: int = 1, tables=()):
+        """Open a :class:`cylon_tpu.serve.session.Session` bound to
+        this engine (pins ``tables`` for the session's lifetime)."""
+        from cylon_tpu.serve.session import Session
+
+        return Session(self, tenant, priority=priority, tables=tables)
+
+    # ------------------------------------------------------ submission
+    def submit(self, fn, *args, tenant: str = "default",
+               priority: int = 1, slo: "float | None" = None,
+               tables=(), fault_plan=None, **kwargs) -> QueryTicket:
+        """Admit one query for scheduled execution.
+
+        ``fn(*args, **kwargs)`` runs on the scheduler thread — a plain
+        callable is one step; a generator function advances one step
+        per schedule sweep (its ``return`` value is the result).
+        ``slo=None`` takes the engine default
+        (``CYLON_TPU_SERVE_SLO``); ``slo <= 0`` explicitly unbounds the
+        request. ``tables`` are catalog ids pinned for the request's
+        lifetime. ``fault_plan`` (tests/chaos drills) is installed only
+        around this request's steps. Raises
+        :class:`~cylon_tpu.errors.ResourceExhausted` immediately when
+        the live-request cap is hit."""
+        if self._closed:
+            raise InvalidArgument("engine is closed")
+        if slo is None:
+            slo = self._policy.default_slo
+        elif slo <= 0:
+            slo = None
+        self._admission.admit(tenant)  # may raise ResourceExhausted
+        ticket = QueryTicket(next(self._ids), str(tenant),
+                             int(priority), slo)
+        holder = f"{tenant}/req{ticket.rid}"
+        pinned: list[str] = []
+        try:
+            for tid in tables:
+                catalog.pin(tid, holder=holder)
+                pinned.append(tid)
+        except Exception:
+            for tid in pinned:
+                catalog.unpin(tid, holder=holder)
+            self._admission.release()
+            raise
+        op = _QueryOp(next(self._op_ids), self, ticket, fn, args,
+                      kwargs, fault_plan, pinned)
+        op._holder = holder
+        telemetry.counter("serve.requests", tenant=ticket.tenant).inc()
+        _trace.instant("serve.admit", cat="serve", tenant=ticket.tenant,
+                       rid=ticket.rid, slo=slo)
+        with self._cond:
+            if self._closed:  # lost a race with close(): undo and refuse
+                for tid in pinned:
+                    catalog.unpin(tid, holder=holder)
+                self._admission.release()
+                raise InvalidArgument("engine is closed")
+            if self._policy.schedule == "priority":
+                self._exec.add_op(op, ticket.priority)
+            else:
+                self._exec.add_op(op)
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._loop, name="cylon-serve-scheduler",
+                    daemon=True)
+                self._thread.start()
+            self._cond.notify_all()
+        return ticket
+
+    # ------------------------------------------------- scheduler loop
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._exec.ops and not self._closed:
+                    self._cond.wait()
+                if self._closed and not self._exec.ops:
+                    return
+            # one fair-share / weighted sweep over every live query:
+            # each op advances one step (or `priority` steps), so
+            # requests interleave at step granularity
+            self._exec.progress()
+            with self._cond:
+                for op in [o for o in self._exec.ops if o.done()]:
+                    self._exec.remove_op(op)
+
+    def _retire(self, op: _QueryOp, value=None,
+                error: "BaseException | None" = None) -> None:
+        """Finish one request: record outcome + latency, release pins
+        and the admission slot, wake waiters. Runs on the scheduler
+        thread (once per request — ops retire exactly once)."""
+        t = op.ticket
+        if t.done:  # pragma: no cover - retire races are scheduler bugs
+            return
+        t.finished = time.monotonic()
+        wall = t.finished - t.submitted
+        if error is None:
+            t.state, t.value = DONE, value
+            telemetry.counter("serve.completed", tenant=t.tenant).inc()
+        else:
+            t.state, t.error = FAILED, error
+            telemetry.counter("serve.errors", tenant=t.tenant,
+                              kind=type(error).__name__).inc()
+        telemetry.timer("serve.request_seconds",
+                        tenant=t.tenant).observe(wall)
+        _trace.instant("serve.done" if error is None else "serve.error",
+                       cat="serve", tenant=t.tenant, rid=t.rid,
+                       wall=wall,
+                       error=type(error).__name__ if error else None)
+        holder = getattr(op, "_holder", None)
+        for tid in op._pins:
+            try:
+                catalog.unpin(tid, holder=holder)
+            except Exception:  # pragma: no cover - unpin best-effort
+                pass
+        self._admission.release()
+        t._event.set()
+
+    # ------------------------------------------------------- reporting
+    @property
+    def live(self) -> int:
+        """Live (queued + running) request count."""
+        return self._admission.live
+
+    def tenant_stats(self) -> "dict[str, dict]":
+        """Per-tenant serving report: requests/completed/errors/
+        rejected/expired counts plus p50/p99/max request latency from
+        the ``serve.request_seconds{tenant=}`` histogram quantiles."""
+        out: dict = {}
+
+        def _count(metric_name):
+            for _, labels, inst in telemetry.instruments(metric_name):
+                ten = labels.get("tenant")
+                if ten is None:
+                    continue
+                d = out.setdefault(ten, {})
+                key = metric_name.split(".", 1)[1]
+                d[key] = d.get(key, 0) + inst.value
+
+        for m in ("serve.requests", "serve.completed", "serve.errors",
+                  "serve.rejected", "serve.expired"):
+            _count(m)
+        for _, labels, inst in telemetry.instruments(
+                "serve.request_seconds"):
+            ten = labels.get("tenant")
+            if ten is None or not inst.count:
+                continue
+            d = out.setdefault(ten, {})
+            d.update(p50_s=inst.quantile(0.5),
+                     p99_s=inst.quantile(0.99),
+                     mean_s=inst.sum / inst.count,
+                     max_s=inst.max)
+        return out
+
+    def plan_cache_stats(self) -> dict:
+        """Hit/miss/eviction totals of the shared compiled-plan cache
+        (:func:`cylon_tpu.plan.plan_cache_stats`)."""
+        return plan.plan_cache_stats()
+
+    # -------------------------------------------------------- lifecycle
+    def close(self, wait: bool = True,
+              timeout: "float | None" = None) -> None:
+        """Stop admitting; optionally drain live requests. With
+        ``wait=False`` a close under live requests raises
+        :class:`~cylon_tpu.errors.FailedPrecondition` (the engine never
+        silently abandons admitted work)."""
+        with self._cond:
+            live = len(self._exec.ops)
+            if live and not wait:
+                # decide the refusal BEFORE publishing _closed, so a
+                # concurrent submit never sees a closed engine that
+                # then stays open
+                raise FailedPrecondition(
+                    f"close(wait=False) with {live} live request(s); "
+                    "drain or pass wait=True")
+            self._closed = True
+            self._cond.notify_all()
+        if self._thread is not None and self._thread.is_alive():
+            self._thread.join(timeout)
+
+    def __enter__(self) -> "ServeEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close(wait=True)

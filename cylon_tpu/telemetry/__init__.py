@@ -48,10 +48,12 @@ from cylon_tpu.telemetry.export import (HBM_PEAK_BYTES_PER_SEC,
 from cylon_tpu.telemetry.registry import (BUCKET_BOUNDS, Counter, Gauge,
                                           Histogram, MetricRegistry,
                                           Timer, add_record, counter,
-                                          delta, gauge, get_records,
-                                          histogram, instruments,
+                                          current_tenant, delta, gauge,
+                                          get_records, histogram,
+                                          instruments, merge_histograms,
                                           metric, registry, reset,
-                                          snapshot, timer, total)
+                                          snapshot, tenant_labels,
+                                          tenant_scope, timer, total)
 
 __all__ = [
     "BUCKET_BOUNDS", "Counter", "Gauge", "Histogram", "Timer",
@@ -63,4 +65,6 @@ __all__ = [
     "REQUIRED_BENCH_KEYS", "HBM_PEAK_BYTES_PER_SEC",
     "ICI_LINK_BYTES_PER_SEC", "fraction_of_peak", "trace",
     "to_chrome_trace", "chrome_trace_json", "write_chrome_trace",
+    "tenant_scope", "current_tenant", "tenant_labels",
+    "merge_histograms",
 ]

@@ -457,9 +457,18 @@ def bench_metrics() -> dict:
     sections = {}
     for _, labels, inst in _r.instruments("watchdog.section_seconds"):
         sec = labels.get("section", "?")
-        sections[sec] = {"count": inst.count,
-                         "total_s": json_safe(float(inst.sum)),
-                         "max_s": json_safe(inst.max)}
+        # a section split across tenant-labeled series (the serve
+        # layer) merges per section name — counts/totals add, max is
+        # max — so no series silently vanishes from the block
+        s = sections.setdefault(sec, {"count": 0, "total_s": 0.0,
+                                      "max_s": None})
+        s["count"] += inst.count
+        tot = json_safe(float(inst.sum))
+        if tot is not None:
+            s["total_s"] += tot
+        mx = json_safe(inst.max)
+        if mx is not None:
+            s["max_s"] = mx if s["max_s"] is None else max(s["max_s"], mx)
     if sections:
         out["watchdog.sections"] = sections
     return out

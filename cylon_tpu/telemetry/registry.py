@@ -34,14 +34,74 @@ aggregates and histories in one operation (no second source of truth).
 
 import bisect
 import collections
+import contextlib
+import contextvars
 import threading
 
 __all__ = [
     "BUCKET_BOUNDS", "Counter", "Gauge", "Histogram", "Timer",
     "MetricRegistry", "registry", "counter", "gauge", "histogram",
     "timer", "metric", "instruments", "snapshot", "delta", "reset",
-    "total", "add_record", "get_records",
+    "total", "add_record", "get_records", "tenant_scope",
+    "current_tenant", "tenant_labels", "merge_histograms",
 ]
+
+#: ambient tenant attribution for multi-tenant serving
+#: (:mod:`cylon_tpu.serve`): while a scope is active, the span timers
+#: (``utils.tracing.span``), watchdog section metrics, resilience
+#: fault/retry counters and flight-recorder events all gain a
+#: ``tenant`` label/key, so one mixed-workload registry/recording can
+#: be sliced per tenant after the fact. Contextvar-propagated: worker
+#: threads spawned with ``copy_context`` (watchdog bounded calls)
+#: inherit it; unrelated threads see None — no label, the historical
+#: series keys.
+_TENANT: contextvars.ContextVar = contextvars.ContextVar(
+    "cylon_tenant", default=None)
+
+
+def current_tenant() -> "str | None":
+    return _TENANT.get()
+
+
+@contextlib.contextmanager
+def tenant_scope(tenant: "str | None"):
+    """Attribute every instrumented event in this scope to ``tenant``
+    (None = explicitly clear an inherited attribution)."""
+    tok = _TENANT.set(None if tenant is None else str(tenant))
+    try:
+        yield
+    finally:
+        _TENANT.reset(tok)
+
+
+def tenant_labels() -> dict:
+    """``{"tenant": t}`` when a tenant scope is active, else ``{}`` —
+    splice into instrument label kwargs (one shared spelling, so every
+    layer labels identically and per-tenant filters match)."""
+    t = _TENANT.get()
+    return {} if t is None else {"tenant": t}
+
+
+def merge_histograms(insts) -> "Histogram | None":
+    """One Histogram holding the elementwise bucket/count/sum merge of
+    ``insts`` (associative by the shared-ladder construction) — how a
+    metric split across tenant label series is re-aggregated for
+    whole-process quantiles. None when ``insts`` is empty."""
+    insts = [h for h in insts if isinstance(h, Histogram)]
+    if not insts:
+        return None
+    out = Histogram()
+    for h in insts:
+        with h._lock:
+            out.count += h.count
+            out.sum += h.sum
+            if h.min is not None:
+                out.min = h.min if out.min is None else min(out.min, h.min)
+            if h.max is not None:
+                out.max = h.max if out.max is None else max(out.max, h.max)
+            for i, n in enumerate(h.buckets):
+                out.buckets[i] += n
+    return out
 
 #: Shared histogram bucket upper bounds: powers of two from 2^-20
 #: (~1 µs if the unit is seconds; ~1 B if bytes) to 2^30 (~12 days /

@@ -53,10 +53,13 @@ import os
 import threading
 import time
 
+from cylon_tpu.telemetry.registry import current_tenant as _current_tenant
+
 __all__ = [
     "enabled", "begin", "end", "span", "instant", "counter", "complete",
     "events", "clear", "dropped", "merge_timelines", "rank_buffers",
-    "critical_path", "stage_coverage", "DEFAULT_CAPACITY",
+    "critical_path", "stage_coverage", "filter_tenant",
+    "DEFAULT_CAPACITY",
 ]
 
 #: default ring-buffer bound (events); ``CYLON_TPU_TRACE_EVENTS``
@@ -140,6 +143,18 @@ def now() -> "float | None":
     return _rec().now() if enabled() else None
 
 
+def _stamp_tenant(evt: dict) -> None:
+    """Attach the ambient tenant attribution
+    (:func:`cylon_tpu.telemetry.tenant_scope`) as a top-level
+    ``"tenant"`` key — only when a scope is active, so events outside
+    the serving layer keep their historical shape. Reached only on the
+    armed path (emitters return before it when tracing is off), so the
+    off-path cost stays one env read."""
+    t = _current_tenant()
+    if t is not None:
+        evt["tenant"] = t
+
+
 # ------------------------------------------------------------- emitters
 def begin(name: str, cat: "str | None" = None, **args):
     """Open a span; returns an opaque token for :func:`end` (None when
@@ -150,10 +165,12 @@ def begin(name: str, cat: "str | None" = None, **args):
     eid = r.next_id()
     stack = _STACK.get()
     tok = _STACK.set(stack + (eid,))
-    r.append({"kind": "begin", "name": name, "ts": r.now(),
-              "tid": threading.get_ident(), "id": eid,
-              "parent": stack[-1] if stack else None,
-              "cat": cat, "args": args or {}})
+    evt = {"kind": "begin", "name": name, "ts": r.now(),
+           "tid": threading.get_ident(), "id": eid,
+           "parent": stack[-1] if stack else None,
+           "cat": cat, "args": args or {}}
+    _stamp_tenant(evt)
+    r.append(evt)
     return (eid, name, tok)
 
 
@@ -188,10 +205,12 @@ def instant(name: str, cat: "str | None" = None, **args) -> None:
         return
     r = _rec()
     stack = _STACK.get()
-    r.append({"kind": "instant", "name": name, "ts": r.now(),
-              "tid": threading.get_ident(),
-              "parent": stack[-1] if stack else None,
-              "cat": cat, "args": args or {}})
+    evt = {"kind": "instant", "name": name, "ts": r.now(),
+           "tid": threading.get_ident(),
+           "parent": stack[-1] if stack else None,
+           "cat": cat, "args": args or {}}
+    _stamp_tenant(evt)
+    r.append(evt)
 
 
 def counter(name: str, value, **args) -> None:
@@ -201,9 +220,11 @@ def counter(name: str, value, **args) -> None:
     if not enabled():
         return
     r = _rec()
-    r.append({"kind": "counter", "name": name, "ts": r.now(),
-              "tid": threading.get_ident(), "value": value,
-              "args": args or {}})
+    evt = {"kind": "counter", "name": name, "ts": r.now(),
+           "tid": threading.get_ident(), "value": value,
+           "args": args or {}}
+    _stamp_tenant(evt)
+    r.append(evt)
 
 
 def complete(name: str, dur: float, cat: "str | None" = None,
@@ -215,10 +236,12 @@ def complete(name: str, dur: float, cat: "str | None" = None,
         return
     r = _rec()
     t1 = r.now()
-    r.append({"kind": "complete", "name": name,
-              "ts": t1 - max(float(dur), 0.0), "dur": float(dur),
-              "tid": threading.get_ident(), "cat": cat,
-              "args": args or {}})
+    evt = {"kind": "complete", "name": name,
+           "ts": t1 - max(float(dur), 0.0), "dur": float(dur),
+           "tid": threading.get_ident(), "cat": cat,
+           "args": args or {}}
+    _stamp_tenant(evt)
+    r.append(evt)
 
 
 # -------------------------------------------------------------- readers
@@ -252,6 +275,35 @@ def rank_buffers(env=None) -> "list[dict]":
     from cylon_tpu.telemetry.aggregate import gather_traces
 
     return gather_traces(env)
+
+
+def filter_tenant(evts, tenant: str) -> list:
+    """Events attributed to ``tenant`` — directly (the ``"tenant"``
+    stamp from an ambient :func:`cylon_tpu.telemetry.tenant_scope`) or
+    transitively (a span/instant nested under a stamped span via
+    ``parent``, e.g. the exchange instants a tenant's dist op emits
+    inside its request span). End events follow their begin's verdict.
+    This is how one mixed-workload recording is sliced into per-tenant
+    timelines (``tracing.report(tenant=)`` /
+    ``straggler_report(timeline=, tenant=)``)."""
+    tenant = str(tenant)
+    # span ids are per-rank counters, so on a merged multi-rank
+    # timeline the id must be namespaced by rank — otherwise rank 1's
+    # id=1 (someone else's span) would match rank 0's kept id=1
+    keep_ids: set = set()
+    out = []
+    for e in evts:
+        rank = e.get("rank")
+        mine = e.get("tenant") == tenant
+        if not mine and e.get("kind") == "end":
+            mine = (rank, e.get("id")) in keep_ids
+        if not mine and e.get("parent") is not None:
+            mine = (rank, e["parent"]) in keep_ids
+        if mine:
+            if e.get("kind") == "begin":
+                keep_ids.add((rank, e.get("id")))
+            out.append(e)
+    return out
 
 
 # ----------------------------------------------------- merge + analysis

@@ -87,7 +87,11 @@ def span(name: str, sync=None, cat: "str | None" = None, **targs):
                 if sync is not None:
                     jax.block_until_ready(sync)
                 dt = time.perf_counter() - t0
-                telemetry.timer(SPAN_METRIC, name=name).observe(dt)
+                # the ambient tenant (serve layer) splits the series so
+                # per-tenant latency is reportable; outside a tenant
+                # scope the labels are {} — the historical series key
+                telemetry.timer(SPAN_METRIC, name=name,
+                                **telemetry.tenant_labels()).observe(dt)
                 get_logger().debug("%s: %.3f ms", name, dt * 1e3)
     finally:
         _trace.end(tok)
@@ -109,16 +113,28 @@ def traced(name: str | None = None):
     return deco
 
 
-def timings() -> dict[str, SpanStat]:
+def timings(tenant: "str | None" = None) -> dict[str, SpanStat]:
     """Snapshot of accumulated span statistics — a view over the
-    telemetry registry's :data:`SPAN_METRIC` series."""
+    telemetry registry's :data:`SPAN_METRIC` series. Series that differ
+    only by ``tenant`` label merge per span name; ``tenant=`` restricts
+    the view to one tenant's series (the serve layer's per-tenant
+    latency slice)."""
     out = {}
     for _, labels, inst in telemetry.instruments(SPAN_METRIC):
+        if tenant is not None and labels.get("tenant") != str(tenant):
+            continue
         d = inst.dump()  # locked read: count/min/max move together
         if d["count"] and d["min"] is not None:
-            out[labels["name"]] = SpanStat(
-                d["count"], float(d["sum"]), float(d["min"]),
-                float(d["max"]))
+            s = out.get(labels["name"])
+            if s is None:
+                out[labels["name"]] = SpanStat(
+                    d["count"], float(d["sum"]), float(d["min"]),
+                    float(d["max"]))
+            else:
+                s.count += d["count"]
+                s.total_s += float(d["sum"])
+                s.min_s = min(s.min_s, float(d["min"]))
+                s.max_s = max(s.max_s, float(d["max"]))
     return out
 
 
@@ -126,16 +142,21 @@ def reset_timings() -> None:
     telemetry.reset("tracing.")
 
 
-def report() -> str:
+def report(tenant: "str | None" = None) -> str:
     """Human-readable table of span stats, slowest total first. The
     p50/p99 columns come from the shared pow2 histogram buckets
     (:meth:`cylon_tpu.telemetry.registry.Histogram.quantile`) — mean/
     min/max alone hide tail latency, and the tail is where stragglers
-    live."""
-    insts = {}
+    live. ``tenant=`` isolates one tenant's spans from a mixed
+    multi-tenant recording (series labeled by the serve layer's
+    ambient :func:`cylon_tpu.telemetry.tenant_scope`); the default
+    merges every tenant's series per span name."""
+    insts: dict[str, list] = {}
     for _, labels, inst in telemetry.instruments(SPAN_METRIC):
-        insts[labels.get("name", "?")] = inst
-    snap = timings()
+        if tenant is not None and labels.get("tenant") != str(tenant):
+            continue
+        insts.setdefault(labels.get("name", "?"), []).append(inst)
+    snap = timings(tenant=tenant)
     if not snap:
         return "(no spans recorded)"
     rows = sorted(snap.items(), key=lambda kv: -kv[1].total_s)
@@ -144,7 +165,9 @@ def report() -> str:
              f"{'mean ms':>9}  {'min ms':>8}  {'p50 ms':>8}  "
              f"{'p99 ms':>8}  {'max ms':>8}"]
     for k, s in rows:
-        inst = insts.get(k)
+        # quantiles over the MERGED bucket ladder when a name has
+        # several tenant series (associative by construction)
+        inst = telemetry.merge_histograms(insts.get(k, []))
         p50 = inst.quantile(0.5) if inst is not None else None
         p99 = inst.quantile(0.99) if inst is not None else None
         lines.append(

@@ -95,6 +95,11 @@ SECTIONS: "dict[str, bool]" = {
     "spill_io": True,
     "ooc_pass": False,
     "exchange": False,
+    # one admitted serve request's execution step (cylon_tpu.serve) —
+    # never engine-retryable: re-running a half-executed query after
+    # its SLO passed only deepens the pile-up; the retry decision
+    # belongs to the client
+    "serve_request": False,
 }
 
 # the retryability registry here and the budget-defaults registry in
@@ -216,7 +221,9 @@ def dump_stacks(header: str, file=None) -> None:
 class SectionTiming:
     """One completed section, queryable via :func:`timings` for
     straggler reporting. ``dump_after`` is seconds from section start
-    to the watchdog's stack dump (None = never stalled)."""
+    to the watchdog's stack dump (None = never stalled); ``tenant`` is
+    the serve-layer attribution active when the section completed
+    (None outside a tenant scope)."""
 
     section: str
     detail: str
@@ -224,6 +231,7 @@ class SectionTiming:
     budget: "float | None"
     expired: bool
     dump_after: "float | None" = None
+    tenant: "str | None" = None
 
 
 #: telemetry series section completions feed (the registry is the one
@@ -234,12 +242,18 @@ SECTION_EXPIRED = "watchdog.sections_expired"
 SECTION_RECORDS = "watchdog.section_timings"
 
 
-def timings(section: "str | None" = None) -> "list[SectionTiming]":
+def timings(section: "str | None" = None,
+            tenant: "str | None" = None) -> "list[SectionTiming]":
     """Completed-section timing records, newest last (bounded history,
-    read from the telemetry registry's record store)."""
+    read from the telemetry registry's record store), optionally
+    filtered to one section and/or one serve-layer tenant."""
     recs = telemetry.get_records(SECTION_RECORDS)
-    return recs if section is None else [r for r in recs
-                                         if r.section == section]
+    if section is not None:
+        recs = [r for r in recs if r.section == section]
+    if tenant is not None:
+        recs = [r for r in recs
+                if getattr(r, "tenant", None) == str(tenant)]
+    return recs
 
 
 def clear_timings() -> None:
@@ -253,12 +267,16 @@ def clear_timings() -> None:
     telemetry.reset("watchdog.")
 
 
-def straggler_report(timeline: "list | None" = None) -> dict:
+def straggler_report(timeline: "list | None" = None,
+                     tenant: "str | None" = None) -> dict:
     """Per-section aggregate: count, mean/max elapsed, and how many
     expired — the quickest way to see which blocking layer is the
     straggler. A pure view over the telemetry registry (the
     :data:`SECTION_TIMER` histograms and :data:`SECTION_EXPIRED`
-    counters), not a second accumulation.
+    counters), not a second accumulation. Sections split across
+    tenant-labeled series merge per section; ``tenant=`` restricts the
+    report to one serve-layer tenant's sections — isolating its
+    stragglers from a mixed multi-tenant workload.
 
     **Fleet-aware form**: pass ``timeline`` — a merged multi-rank event
     list from :func:`cylon_tpu.telemetry.trace.merge_timelines` (per-
@@ -266,24 +284,37 @@ def straggler_report(timeline: "list | None" = None) -> dict:
     the report instead walks the timeline and NAMES the straggler:
     ``{"straggler_rank", "dominant_stage", "excess_seconds",
     "rank_walls", "stage_seconds", ...}``
-    (:func:`cylon_tpu.telemetry.trace.critical_path`). The local form
+    (:func:`cylon_tpu.telemetry.trace.critical_path`); ``tenant=``
+    first slices the timeline to that tenant's events
+    (:func:`cylon_tpu.telemetry.trace.filter_tenant`). The local form
     can only say which *section* is slow on this host; the fleet form
     says which *rank* is slow and in which stage."""
     if timeline is not None:
+        if tenant is not None:
+            timeline = telemetry.trace.filter_tenant(timeline, tenant)
         return telemetry.trace.critical_path(timeline)
     agg: dict[str, dict] = {}
     for _, labels, inst in telemetry.instruments(SECTION_TIMER):
         sec = labels.get("section", "?")
         if not inst.count:
             continue
-        exp = telemetry.metric(SECTION_EXPIRED, section=sec)
-        agg[sec] = {
-            "count": inst.count,
-            "total_s": inst.sum,
-            "max_s": inst.max if inst.max is not None else 0.0,
-            "expired": exp.value if exp is not None else 0,
-            "mean_s": inst.sum / inst.count,
-        }
+        if tenant is not None and labels.get("tenant") != str(tenant):
+            continue
+        a = agg.setdefault(sec, {"count": 0, "total_s": 0.0,
+                                 "max_s": 0.0, "expired": 0})
+        a["count"] += inst.count
+        a["total_s"] += inst.sum
+        a["max_s"] = max(a["max_s"],
+                         inst.max if inst.max is not None else 0.0)
+    for _, labels, inst in telemetry.instruments(SECTION_EXPIRED):
+        sec = labels.get("section", "?")
+        if sec not in agg:
+            continue
+        if tenant is not None and labels.get("tenant") != str(tenant):
+            continue
+        agg[sec]["expired"] += inst.value
+    for a in agg.values():
+        a["mean_s"] = a["total_s"] / a["count"]
     return agg
 
 
@@ -307,12 +338,15 @@ class _Section:
 
 def _finish(rec: _Section, expired: bool) -> None:
     elapsed = time.monotonic() - rec.started
-    telemetry.timer(SECTION_TIMER, section=rec.section).observe(elapsed)
+    tl = telemetry.tenant_labels()
+    telemetry.timer(SECTION_TIMER, section=rec.section,
+                    **tl).observe(elapsed)
     if expired:
-        telemetry.counter(SECTION_EXPIRED, section=rec.section).inc()
+        telemetry.counter(SECTION_EXPIRED, section=rec.section,
+                          **tl).inc()
     telemetry.add_record(SECTION_RECORDS, SectionTiming(
         rec.section, rec.detail, elapsed, rec.budget, expired,
-        rec.dump_after))
+        rec.dump_after, tenant=tl.get("tenant")))
     # flight recorder: one complete slice per section, cat="stage" — the
     # unit trace.critical_path attributes straggler wall time to (the
     # section start exists only in monotonic time, so the recorder
@@ -604,13 +638,20 @@ def watched(section: str, detail: str = ""):
     return deco
 
 
-def check(section: str, detail: str = "") -> None:
+def check(section: "str | None" = None, detail: str = "") -> None:
     """Cooperative checkpoint: raise
     :class:`~cylon_tpu.errors.DeadlineExceeded` if the ambient
     :func:`deadline` scope — or the enclosing
     :func:`watched_section`'s budget, however it was set (scope, env
     default, explicit timeout) — has expired. Two contextvar reads on
-    the fast path — cheap enough for per-chunk/per-bucket loops."""
+    the fast path — cheap enough for per-chunk/per-bucket loops.
+
+    ``section=None`` (for checkpoints in caller-agnostic utilities,
+    e.g. ``ops_graph.chunk_stream``) attributes the failure to the
+    ENCLOSING live :func:`watched_section` when one exists — so the
+    same checkpoint reports ``serve_request`` under the serving layer
+    and ``ooc_pass`` inside an out-of-core pass — and to the generic
+    non-retryable label ``"deadline"`` under a bare scope."""
     d = _SCOPE.get()
     exp = None if d is None else d.expires_at
     rec = _ACTIVE_SECTION.get()
@@ -621,7 +662,10 @@ def check(section: str, detail: str = "") -> None:
         return
     now = time.monotonic()
     if now > exp:
-        _require_section(section)
+        if section is None:
+            section = rec.section if rec is not None else "deadline"
+        else:
+            _require_section(section)
         # report the enclosing section's true elapsed/budget when one
         # is live; bare-scope checkpoints can only report the overrun
         elapsed = now - rec.started if rec is not None else now - exp

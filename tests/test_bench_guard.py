@@ -16,7 +16,7 @@ import py_compile
 import pytest
 
 REPO = pathlib.Path(__file__).resolve().parents[1]
-DRIVERS = ["bench_suite.py", "bench.py"]
+DRIVERS = ["bench_suite.py", "bench.py", "cylon_tpu/serve/bench.py"]
 
 _FN = (ast.FunctionDef, ast.AsyncFunctionDef)
 
@@ -309,6 +309,69 @@ def test_chrome_trace_exporter_strict_json(monkeypatch):
     assert ts == sorted(ts)
     assert sum(1 for e in body if e["ph"] == "B") == \
         sum(1 for e in body if e["ph"] == "E")
+
+
+# ------------------------------------------------- serve-layer guards
+def test_serve_record_schema_pinned():
+    """ISSUE 7 satellite: the serve bench record must keep the latency
+    quantiles, throughput, cache-hit and rejection columns — the
+    serving trajectory is unreadable without them (main() asserts the
+    set before emitting, so the pin is enforced at bench runtime too)."""
+    from cylon_tpu.serve.bench import REQUIRED_SERVE_FIELDS
+
+    assert {"p50_s", "p99_s", "qps", "cache_hit_rate", "rejected",
+            "tenants", "oracle_mismatches"} <= REQUIRED_SERVE_FIELDS
+
+
+def _watchdog_section_constants(path: pathlib.Path) -> set:
+    """String constants passed as the section argument to
+    ``watched_section(...)`` / ``bounded(fn, ...)`` / ``check(...)``
+    calls anywhere in ``path``."""
+    tree = ast.parse(path.read_text(), filename=str(path))
+    out = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = (node.func.attr if isinstance(node.func, ast.Attribute)
+                 else getattr(node.func, "id", None))
+        if fname not in ("watched_section", "bounded", "check"):
+            continue
+        pos = 1 if fname == "bounded" else 0
+        args = node.args
+        if len(args) > pos and isinstance(args[pos], ast.Constant) \
+                and isinstance(args[pos].value, str):
+            out.add(args[pos].value)
+    return out
+
+
+def test_every_serve_entrypoint_runs_under_a_named_watchdog_section():
+    """ISSUE 7 satellite: the serve layer's execution paths — the
+    scheduler's step runner (service.py) and the bench replayer — must
+    run under a NAMED watchdog section, and every section name they use
+    must be registered in ``watchdog.SECTIONS`` (an unknown name would
+    raise InvalidArgument at runtime; a missing section would mean a
+    hung request stalls the engine with zero diagnostics)."""
+    from cylon_tpu import watchdog
+
+    for rel in ("cylon_tpu/serve/service.py", "cylon_tpu/serve/bench.py"):
+        secs = _watchdog_section_constants(REPO / rel)
+        assert secs, f"{rel} never enters a named watchdog section"
+        unknown = secs - set(watchdog.SECTIONS)
+        assert not unknown, f"{rel} uses unregistered sections {unknown}"
+        assert "serve_request" in secs, (
+            f"{rel} must run its serve work under the serve_request "
+            "section")
+
+
+def test_serve_request_section_registered_not_retryable():
+    """The serve_request section exists in BOTH registries (watchdog
+    retryability + config budget defaults — the import-time assertion
+    requires them to match) and is never engine-retryable."""
+    from cylon_tpu import watchdog
+    from cylon_tpu.config import DEADLINE_SECTIONS
+
+    assert watchdog.SECTIONS.get("serve_request") is False
+    assert "serve_request" in DEADLINE_SECTIONS
 
 
 def test_checker_accepts_closures_and_comprehensions(tmp_path):
