@@ -16,6 +16,13 @@ over every visible device (1 real chip under axon; N with a mesh).
 by spawned children) and appends a ``trace_artifact`` record pointing
 at the Chrome Trace JSON written next to the records.
 
+The CHAOS section (``--chaos``) is the kill-level robustness proof: for
+each out-of-core op it hard-kills a child mid-pass at a seeded fault
+point (``FaultRule.kill`` → ``os._exit``), resumes from the durable
+checkpoint in a fresh child, and asserts the resumed output is
+byte-identical (sha256) to a fault-free oracle child's — one JSON
+record per op. See docs/resilience.md "Checkpoint & recovery".
+
 The EXCHANGE section (``--exchange``, also spawned automatically at the
 end of a full run) times the multi-device shuffle/dist_join paths on an
 8-device virtual CPU mesh — the one place the variable-size all-to-all
@@ -369,18 +376,18 @@ def _run_tpch(sf, reps, tag_hbm: bool = False, ooc_report=None):
         if not sentinel:
             return
         try:
-            # tmp + rename: the parent may KILL this process at any
-            # instant (that is the point), and a torn half-written
-            # JSON would read as "no report" — losing the whole
-            # checkpoint history
-            with open(sentinel + ".tmp", "w") as f:
-                json.dump({
-                    "tpch_attempted": list(attempted),
-                    "tpch_crashed": list(crashed),
-                    "tpch_skipped": [q for q in selected
-                                     if q not in attempted],
-                    "tpch_ooc": list(ooc_pending)}, f)
-            os.replace(sentinel + ".tmp", sentinel)
+            # tmp + fsync + rename (resilience.atomic_write_json): the
+            # parent may KILL this process at any instant (that is the
+            # point), and a torn half-written JSON would read as "no
+            # report" — losing the whole checkpoint history
+            from cylon_tpu.resilience import atomic_write_json
+
+            atomic_write_json(sentinel, {
+                "tpch_attempted": list(attempted),
+                "tpch_crashed": list(crashed),
+                "tpch_skipped": [q for q in selected
+                                 if q not in attempted],
+                "tpch_ooc": list(ooc_pending)})
         except OSError:
             pass  # checkpointing must never fail the run
 
@@ -512,7 +519,12 @@ def _spawn_sentinel(flag, extra_env=None):
     except (OSError, ValueError):
         part = None
     finally:
-        for p in (sentinel, sentinel + ".tmp"):
+        import glob
+
+        # atomic_write_json tmps are '<name>.tmp<pid>_<tid>_<seq>' —
+        # a child killed mid-sentinel-write (the chaos legs do exactly
+        # that) strands one; sweep the whole family
+        for p in [sentinel] + glob.glob(sentinel + ".tmp*"):
             try:
                 os.unlink(p)
             except OSError:
@@ -795,9 +807,9 @@ def scale_incore_main(leg: str):
 
     sentinel = os.environ.get("CYLON_SCALE_SENTINEL")
     if sentinel:
-        with open(sentinel + ".tmp", "w") as f:
-            json.dump(report, f)
-        os.replace(sentinel + ".tmp", sentinel)
+        from cylon_tpu.resilience import atomic_write_json
+
+        atomic_write_json(sentinel, report)
 
 
 def tpch_main():
@@ -813,12 +825,149 @@ def tpch_main():
     acct = _run_tpch(sf, reps)
     sentinel = os.environ.get("CYLON_SCALE_SENTINEL")
     if sentinel:
-        with open(sentinel + ".tmp", "w") as f:
-            json.dump({"tpch_attempted": acct["attempted"],
-                       "tpch_crashed": acct["crashed"],
-                       "tpch_skipped": acct["skipped"],
-                       "tpch_ooc": acct["ooc_pending"]}, f)
-        os.replace(sentinel + ".tmp", sentinel)
+        from cylon_tpu.resilience import atomic_write_json
+
+        atomic_write_json(sentinel, {
+            "tpch_attempted": acct["attempted"],
+            "tpch_crashed": acct["crashed"],
+            "tpch_skipped": acct["skipped"],
+            "tpch_ooc": acct["ooc_pending"]})
+
+
+def chaos_child_main(op: str):
+    """One chaos run of an out-of-core op (see :func:`chaos_main`):
+    deterministic inputs, an optional seeded hard-kill plan
+    (CYLON_BENCH_CHAOS_KILL="point:nth"), a resume checkpoint dir
+    (CYLON_BENCH_CHAOS_DIR; unset = fault-free oracle run), and a
+    sentinel report carrying the sha256 of the exact byte stream the
+    sink saw — the "byte-identical resumed output" proof is a hash
+    equality across child processes."""
+    import hashlib
+
+    import cylon_tpu  # noqa: F401  (enables x64 + cache)
+    from cylon_tpu import resilience, telemetry
+    from cylon_tpu.outofcore import ooc_groupby, ooc_join, ooc_sort
+
+    n = int(os.environ.get("CYLON_BENCH_CHAOS_ROWS", "40000"))
+    rdir = os.environ.get("CYLON_BENCH_CHAOS_DIR")
+    kill = os.environ.get("CYLON_BENCH_CHAOS_KILL")
+    if kill:
+        point, nth = kill.rsplit(":", 1)
+        resilience.install(resilience.FaultPlan(
+            [resilience.FaultRule.kill(point, nth=int(nth))]))
+    rng = np.random.default_rng(29)
+    h = hashlib.sha256()
+
+    def sink(df):
+        # %.17g round-trips float64 exactly: identical frames hash
+        # identically, and ANY divergence (dtype, order, value) shows
+        h.update(df.to_csv(index=False, float_format="%.17g").encode())
+
+    chunk = n // 7 + 1
+    if op == "sort":
+        src = {"k": rng.integers(0, 1000, n).astype(np.int64),
+               "v": rng.normal(size=n)}
+        total = ooc_sort(src, ["k", "v"], n_partitions=6,
+                         chunk_rows=chunk, sink=sink, resume_dir=rdir)
+    elif op == "join":
+        left = {"k": rng.integers(0, n, n).astype(np.int64),
+                "a": rng.normal(size=n)}
+        right = {"k": rng.integers(0, n, n).astype(np.int64),
+                 "b": rng.normal(size=n)}
+        total = ooc_join(left, right, on="k", n_partitions=6,
+                         chunk_rows=chunk, sink=sink, resume_dir=rdir)
+    elif op == "groupby":
+        src = {"g": rng.integers(0, 64, n).astype(np.int64),
+               "v": rng.normal(size=n)}
+        out = ooc_groupby(src, ["g"],
+                          [("v", "sum", "s"), ("v", "count", "c")],
+                          chunk_rows=chunk, resume_dir=rdir)
+        pdf = out.to_pandas().sort_values("g").reset_index(drop=True)
+        sink(pdf)
+        total = len(pdf)
+    else:
+        raise ValueError(f"unknown chaos op {op!r}")
+    sentinel = os.environ.get("CYLON_SCALE_SENTINEL")
+    if sentinel:
+        from cylon_tpu.resilience import atomic_write_json
+
+        atomic_write_json(sentinel, {
+            "sha256": h.hexdigest(), "rows": int(total),
+            "units_resumed": telemetry.total("ooc.units_resumed")})
+
+
+def chaos_main():
+    """--chaos: the kill-level robustness proof (ISSUE 8). For each
+    out-of-core op (sort/join/groupby), three child processes:
+
+    1. an ORACLE child computes the fault-free output hash;
+    2. a KILLED child runs the same workload with a resume_dir and a
+       seeded ``FaultRule.kill`` plan — it must die HARD
+       (``os._exit``, status ``KILL_EXIT_CODE``) mid-pass, leaving a
+       partial durable checkpoint;
+    3. a RESUME child re-invokes with identical args + resume_dir —
+       it must actually resume (``units_resumed >= 1``) and its output
+       hash must equal the oracle's byte for byte.
+
+    Any deviation (child survived the kill, resumed hash differs,
+    nothing resumed) fails the leg; one JSON record per op pins the
+    artifact."""
+    import shutil
+    import tempfile
+
+    from cylon_tpu.resilience import KILL_EXIT_CODE
+
+    kills = {"sort": "spill_write:2", "join": "spill_write:2",
+             "groupby": "spill_write:2"}
+    failures = []
+    for op, kill in kills.items():
+        tmp = tempfile.mkdtemp(prefix=f"cylon-chaos-{op}-")
+        try:
+            rc0, oracle, _ = _spawn_sentinel(f"--chaos-child={op}")
+            if oracle is None:
+                failures.append(f"{op}: oracle child rc={rc0} with "
+                                "no report")
+                continue
+            rc1, rep1, _ = _spawn_sentinel(
+                f"--chaos-child={op}",
+                {"CYLON_BENCH_CHAOS_DIR": tmp,
+                 "CYLON_BENCH_CHAOS_KILL": kill})
+            killed = rc1 == KILL_EXIT_CODE and rep1 is None
+            if not killed:
+                failures.append(
+                    f"{op}: kill child exited rc={rc1} "
+                    f"(want {KILL_EXIT_CODE}, no sentinel)")
+            rc2, rep2, _ = _spawn_sentinel(
+                f"--chaos-child={op}", {"CYLON_BENCH_CHAOS_DIR": tmp})
+            identical = (rep2 is not None
+                         and rep2["sha256"] == oracle["sha256"]
+                         and rep2["rows"] == oracle["rows"])
+            resumed = bool(rep2) and rep2.get("units_resumed", 0) >= 1
+            if not identical:
+                failures.append(f"{op}: resumed output != fault-free "
+                                f"oracle ({rep2} vs {oracle})")
+            elif not resumed:
+                failures.append(f"{op}: resume child recomputed from "
+                                "scratch (units_resumed=0) — the "
+                                "checkpoint was not used")
+            _emit_record({
+                "metric": f"chaos_{op}_resume",
+                "value": 1.0 if (killed and identical and resumed)
+                else 0.0,
+                "unit": "byte-identical resume",
+                "kill": kill,
+                "killed_rc": rc1,
+                "rows": oracle["rows"],
+                "oracle_sha256": oracle["sha256"],
+                "resumed_sha256": rep2["sha256"] if rep2 else None,
+                "units_resumed": rep2.get("units_resumed") if rep2
+                else None,
+            })
+        finally:
+            shutil.rmtree(tmp, ignore_errors=True)
+    if failures:
+        raise RuntimeError("chaos harness failures: "
+                           + "; ".join(failures))
 
 
 def tpu_exchange_main():
@@ -1075,6 +1224,12 @@ if __name__ == "__main__":
         os.environ["CYLON_TPU_TRACE"] = "1"
     if "--exchange" in sys.argv:
         exchange_main()
+    elif any(a.startswith("--chaos-child=") for a in sys.argv):
+        _op = next(a for a in sys.argv
+                   if a.startswith("--chaos-child=")).split("=", 1)[1]
+        chaos_child_main(_op)
+    elif "--chaos" in sys.argv:
+        chaos_main()
     elif any(a.startswith("--scale-incore=") for a in sys.argv):
         leg = next(a for a in sys.argv
                    if a.startswith("--scale-incore=")).split("=", 1)[1]

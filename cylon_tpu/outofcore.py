@@ -83,6 +83,39 @@ def host_partition_chunks(chunks: Iterable[Mapping[str, np.ndarray]],
     return _scatter_chunks(chunks, pid_of, n_partitions)
 
 
+def _resolve_source(src, op: str, chunk_rows: int):
+    """Normalise ``src`` into a zero-arg factory of FRESH chunk
+    iterators — the replayable-source contract every out-of-core pass
+    needs (two-pass algorithms, retry after a transient fault, resume
+    after a hard kill). Accepts a host column ``Mapping`` (sliced into
+    chunks), a re-iterable of chunk dicts/Tables, or a zero-arg
+    callable returning a fresh iterator. One-shot
+    iterators/generators are REJECTED up front: a second iteration
+    would silently see 0 rows and the pass would produce short output
+    (``ooc_sort`` has had this guard since PR 1; ``ooc_join``/
+    ``ooc_groupby`` route through it now too)."""
+    if isinstance(src, Mapping):
+        return lambda: _as_chunks(src, chunk_rows)
+    if callable(src):
+        return lambda: _as_chunks(src(), chunk_rows)
+    try:
+        probe = iter(src)
+    except TypeError:
+        raise InvalidArgument(
+            f"{op} source must be a column Mapping, a re-iterable of "
+            "chunks, or a zero-arg callable returning a fresh chunk "
+            f"iterator; got {type(src).__name__}") from None
+    if probe is src:
+        raise InvalidArgument(
+            f"{op} needs a REPLAYABLE source (a retry or a "
+            "resume_dir= rerun re-iterates it), but a one-shot "
+            "iterator/generator was passed — a second iteration would "
+            "silently yield 0 rows and produce short output. Wrap it "
+            "in a zero-arg callable returning a fresh iterator, e.g. "
+            "lambda: read_parquet_chunks(path, chunk_rows)")
+    return lambda: _as_chunks(src, chunk_rows)
+
+
 def _as_chunks(src, chunk_rows: int):
     """Accept a dict of host arrays (sliced into chunks), or any
     iterable of dicts / Tables (used as-is). Every chunk passes the
@@ -119,13 +152,25 @@ def _as_chunks(src, chunk_rows: int):
 def ooc_join(left, right, on, how: str = "inner",
              n_partitions: int = 8, chunk_rows: int = 1 << 22,
              sink: Callable | None = None,
-             suffixes=("_x", "_y")) -> int:
-    """Out-of-core equi-join. ``left``/``right``: host column dicts or
-    chunk iterators (see :func:`_as_chunks`). Each of the
-    ``n_partitions`` bucket pairs joins on device with the normal fused
-    program; results spill to host via ``sink(partition_pandas_df)`` —
-    or are only counted when ``sink`` is None. Returns total result
-    rows.
+             suffixes=("_x", "_y"),
+             resume_dir: str | None = None) -> int:
+    """Out-of-core equi-join. ``left``/``right``: host column dicts,
+    re-iterables of chunks, or zero-arg callables returning fresh
+    chunk iterators (one-shot iterators are rejected — see
+    :func:`_resolve_source`). Each of the ``n_partitions`` bucket
+    pairs joins on device with the normal fused program; results spill
+    to host via ``sink(partition_pandas_df)`` — or are only counted
+    when ``sink`` is None. Returns total result rows.
+
+    ``resume_dir`` makes the pass RESUMABLE: every completed
+    partition's joined output checkpoints to a
+    :class:`cylon_tpu.resilience.CheckpointedRun` there (manifest
+    updated atomically per partition), so a killed run re-invoked with
+    the same arguments replays completed partitions from the store and
+    recomputes only the rest — output identical to a fault-free run.
+    The fingerprint (op + keys + how + partition plan) guards against
+    resuming the wrong plan; recorded per-partition input sizes guard
+    against a source that changed underneath the checkpoint.
 
     Parity: completes the 100M x 100M config that exceeds one chip's
     HBM in-core (the reference finishes it by spreading over ranks —
@@ -140,10 +185,15 @@ def ooc_join(left, right, on, how: str = "inner",
     keys = [on] if isinstance(on, str) else list(on)
     if how not in ("inner", "left", "right", "fullouter", "outer"):
         raise InvalidArgument(f"unsupported how={how!r}")
-    lparts = host_partition_chunks(_as_chunks(left, chunk_rows), keys,
-                                   n_partitions)
-    rparts = host_partition_chunks(_as_chunks(right, chunk_rows), keys,
-                                   n_partitions)
+    lchunks = _resolve_source(left, "ooc_join", chunk_rows)
+    rchunks = _resolve_source(right, "ooc_join", chunk_rows)
+    ckpt = None
+    if resume_dir is not None:
+        ckpt = resilience.CheckpointedRun(
+            resume_dir, "join",
+            (tuple(keys), how, int(n_partitions), tuple(suffixes)))
+    lparts = host_partition_chunks(lchunks(), keys, n_partitions)
+    rparts = host_partition_chunks(rchunks(), keys, n_partitions)
 
     total = 0
     for p in range(n_partitions):
@@ -151,10 +201,31 @@ def ooc_join(left, right, on, how: str = "inner",
         lp, rp = lparts[p], rparts[p]
         ln = len(next(iter(lp.values()))) if lp else 0
         rn = len(next(iter(rp.values()))) if rp else 0
+        done = ckpt.completed_rows(p) if ckpt is not None else None
+        if done is not None:
+            # completed partition: verify the re-scattered source still
+            # matches, then replay the durable output (identical bytes,
+            # no device work)
+            ckpt.verify_meta(p, "ooc_join", ln=ln, rn=rn)
+            # count the resume always; read the spill only when a sink
+            # needs the bytes (a count-only run must not pay the IO)
+            ckpt.note_resumed(p)
+            if done and sink is not None:
+                import pandas as pd
+
+                sink(pd.DataFrame(ckpt.load_unit(p)))
+            total += done
+            telemetry.counter("ooc.rows_out", op="join").inc(done)
+            lparts[p] = rparts[p] = None
+            continue
         if ln == 0 and rn == 0:
+            if ckpt is not None:
+                ckpt.complete(p, {}, 0, meta={"ln": ln, "rn": rn})
             continue
         if ln == 0 or rn == 0:
             if how == "inner":
+                if ckpt is not None:
+                    ckpt.complete(p, {}, 0, meta={"ln": ln, "rn": rn})
                 continue
             # outer semantics with an empty side still need the pass
         from cylon_tpu.errors import OutOfCapacity
@@ -200,8 +271,19 @@ def ooc_join(left, right, on, how: str = "inner",
                     "rows — raise n_partitions")
             total += nrows
             telemetry.counter("ooc.rows_out", op="join").inc(nrows)
-            if sink is not None:
-                sink(res.to_pandas())
+            if ckpt is not None or sink is not None:
+                pdf = res.to_pandas()
+                if ckpt is not None:
+                    # checkpoint BEFORE the sink sees the partition: a
+                    # kill between the two replays it on resume, so
+                    # acknowledged output is never recomputed and
+                    # unacknowledged output is never lost
+                    ckpt.complete(
+                        p, {c: pdf[c].to_numpy() for c in pdf.columns},
+                        nrows, meta={"ln": ln, "rn": rn})
+                if sink is not None:
+                    sink(pdf)
+                del pdf
             del res, lt, rt
             lparts[p] = rparts[p] = None  # free the spill as we go
     return total
@@ -210,18 +292,34 @@ def ooc_join(left, right, on, how: str = "inner",
 @watchdog.watched("ooc_pass", "ooc_groupby")
 def ooc_groupby(src, by: Sequence[str], aggs,
                 chunk_rows: int = 1 << 22,
-                transform: Callable | None = None):
+                transform: Callable | None = None,
+                resume_dir: str | None = None):
     """Out-of-core decomposable groupby: per chunk, a device
     pre-combine shrinks the chunk to its partial aggregates (tiny for
     low-cardinality groups); partials accumulate on host and one final
     device combine produces the result Table. ``aggs``: (src, op[,
     out]) with op in sum/count/min/max (decompose mean as sum+count —
-    :mod:`cylon_tpu.tpch.streaming` shows the pattern).
+    :mod:`cylon_tpu.tpch.streaming` shows the pattern). ``src``: a
+    host column Mapping, a re-iterable of chunks, or a zero-arg
+    callable returning a fresh chunk iterator (one-shot iterators are
+    rejected — see :func:`_resolve_source`).
 
     ``transform(chunk_dict) -> Table`` optionally maps each raw chunk
     to the table the pre-combine consumes (filters, derived columns,
     probe-side joins — the TPC-H streaming queries are exactly this
     hook); default is a plain ingest.
+
+    ``resume_dir`` makes the pass RESUMABLE at chunk granularity:
+    every chunk's partial aggregate checkpoints to a
+    :class:`cylon_tpu.resilience.CheckpointedRun` (manifest updated
+    atomically per chunk), so a killed run re-invoked with the same
+    arguments replays completed partials from the store — the chunk
+    source is re-iterated, but the transform + device pre-combine are
+    skipped for every completed chunk, and the final combine (cheap —
+    one row per group per chunk) produces output identical to a
+    fault-free run. The fingerprint covers keys, aggs, chunking and
+    the transform's identity; the recorded per-chunk source rows guard
+    against a source that changed underneath the checkpoint.
 
     Parity: the chunked pre-combine -> final combine structure of
     ``DistributedHashGroupBy`` (groupby/groupby.cpp:62-78) applied to
@@ -238,8 +336,32 @@ def ooc_groupby(src, by: Sequence[str], aggs,
         raise InvalidArgument(
             f"non-decomposable ops {bad}; decompose (mean = sum+count) "
             "or use the in-core path")
+    chunks = _resolve_source(src, "ooc_groupby", chunk_rows)
+    import pandas as pd
+
+    ckpt = None
+    if resume_dir is not None:
+        # the transform is part of the plan: two passes differing only
+        # in their transform must never share partials. Its code
+        # identity (module + qualname) is the best cheap stand-in for
+        # semantic identity; a renamed/relocated transform re-runs.
+        tf = (None if transform is None else
+              (getattr(transform, "__module__", None),
+               getattr(transform, "__qualname__", repr(transform))))
+        ckpt = resilience.CheckpointedRun(
+            resume_dir, "groupby",
+            (tuple(by), tuple(tuple(a) for a in aggs),
+             int(chunk_rows), tf))
     partials: list = []
-    for i, chunk in enumerate(_as_chunks(src, chunk_rows)):
+    for i, chunk in enumerate(chunks()):
+        src_rows = len(next(iter(chunk.values()))) if chunk else 0
+        done = ckpt.completed_rows(i) if ckpt is not None else None
+        if done is not None:
+            ckpt.verify_meta(i, "ooc_groupby", src_rows=src_rows)
+            cols = ckpt.resume_unit(i)
+            if done:
+                partials.append(pd.DataFrame(cols))
+            continue
         with _span("ooc_groupby.chunk", cat="stage", chunk=i):
             t = (Table.from_pydict(chunk) if transform is None
                  else transform(chunk))
@@ -248,11 +370,15 @@ def ooc_groupby(src, by: Sequence[str], aggs,
             # partials hop through pandas: tiny (one row per group),
             # and dictionary key columns decode to values (codes are
             # chunk-local)
-            partials.append(part.to_pandas())
+            pdf = part.to_pandas()
+            if ckpt is not None:
+                ckpt.complete(
+                    i, {c: pdf[c].to_numpy() for c in pdf.columns},
+                    len(pdf), meta={"src_rows": src_rows})
+            partials.append(pdf)
             del t, part
     if not partials:
         raise InvalidArgument("ooc_groupby: empty input")
-    import pandas as pd
 
     merged_df = pd.concat(partials, ignore_index=True)
     final = Table.from_pydict(
@@ -364,7 +490,8 @@ def ooc_sort(src, by, n_partitions: int = 8, chunk_rows: int = 1 << 22,
     total must equal the pass-2 count.
 
     ``resume_dir`` makes pass 2 RESUMABLE: every completed bucket's
-    sorted output spills to a :class:`cylon_tpu.resilience.SpillStore`
+    sorted output checkpoints to a
+    :class:`cylon_tpu.resilience.CheckpointedRun`
     there (manifest updated atomically per bucket), so a killed run
     re-invoked with the same arguments replays completed buckets from
     the store and recomputes only from the first incomplete one — the
@@ -380,27 +507,7 @@ def ooc_sort(src, by, n_partitions: int = 8, chunk_rows: int = 1 << 22,
     from cylon_tpu.utils import pow2_bucket
 
     keys = [by] if isinstance(by, str) else list(by)
-    if callable(src):
-        chunks = lambda: _as_chunks(src(), chunk_rows)  # noqa: E731
-    elif isinstance(src, Mapping):
-        chunks = lambda: _as_chunks(src, chunk_rows)    # noqa: E731
-    else:
-        try:
-            probe = iter(src)
-        except TypeError:
-            raise InvalidArgument(
-                "ooc_sort source must be a column Mapping, a "
-                "re-iterable of chunks, or a zero-arg callable "
-                f"returning a fresh chunk iterator; got "
-                f"{type(src).__name__}") from None
-        if probe is src:
-            raise InvalidArgument(
-                "ooc_sort needs TWO passes over src, but a one-shot "
-                "iterator/generator was passed — pass 1 would exhaust "
-                "it and pass 2 would silently sort 0 rows. Wrap it in "
-                "a zero-arg callable returning a fresh iterator, e.g. "
-                "lambda: read_parquet_chunks(path, chunk_rows)")
-        chunks = lambda: _as_chunks(src, chunk_rows)    # noqa: E731
+    chunks = _resolve_source(src, "ooc_sort", chunk_rows)
 
     # pass 1: strided per-column key samples (each keeps its own
     # dtype) -> equi-spaced splitter tuples; rows counted for the
@@ -422,11 +529,10 @@ def ooc_sort(src, by, n_partitions: int = 8, chunk_rows: int = 1 << 22,
     pos = np.clip(pos, 0, len(order) - 1)
     splitters = [tuple(c[order[p]] for c in scols) for p in pos]
 
-    store = None
+    ckpt = None
     if resume_dir is not None:
-        fp = resilience.fingerprint_arrays(tuple(keys), n_partitions,
-                                           splitters)
-        store = resilience.SpillStore(resume_dir, fingerprint=fp)
+        ckpt = resilience.CheckpointedRun(
+            resume_dir, "sort", (tuple(keys), n_partitions, splitters))
 
     # pass 2: range-partition every chunk into host buckets
     def pid_of(cols_dict):
@@ -458,7 +564,7 @@ def ooc_sort(src, by, n_partitions: int = 8, chunk_rows: int = 1 << 22,
         watchdog.check("ooc_pass", f"sort bucket {p}")
         full = parts[p]
         n = sizes[p]
-        done = store.completed_rows(p) if store is not None else None
+        done = ckpt.completed_rows(p) if ckpt is not None else None
         if done is not None:
             if done != n:
                 raise DataLossError(
@@ -466,12 +572,12 @@ def ooc_sort(src, by, n_partitions: int = 8, chunk_rows: int = 1 << 22,
                     f"for bucket {p} but the re-scattered source has "
                     f"{n} — the source changed since the manifest was "
                     "written; clear the resume_dir")
+            ckpt.note_resumed(p)
             if n and sink is not None:
                 import pandas as pd
 
-                sink(pd.DataFrame(store.read_bucket(p)))
+                sink(pd.DataFrame(ckpt.load_unit(p)))
             total += n
-            telemetry.counter("ooc.buckets_resumed").inc()
             # replayed rows count toward rows_out too: a resumed run
             # produces identical output to a clean one, and must not
             # read as a row deficit on any dashboard
@@ -479,15 +585,15 @@ def ooc_sort(src, by, n_partitions: int = 8, chunk_rows: int = 1 << 22,
             parts[p] = None
             continue
         if n == 0:
-            if store is not None:
-                store.write_bucket(p, {}, 0)
+            if ckpt is not None:
+                ckpt.complete(p, {}, 0)
             continue
         with _span("ooc_sort.bucket", cat="stage", bucket=p, rows=n):
             t = Table.from_pydict(full, capacity=pow2_bucket(n))
             res = sort_table(t, keys)
             pdf = res.to_pandas()
-            if store is not None:
-                store.write_bucket(
+            if ckpt is not None:
+                ckpt.complete(
                     p, {c: pdf[c].to_numpy() for c in pdf.columns}, n)
             total += n
             telemetry.counter("ooc.rows_out", op="sort").inc(n)

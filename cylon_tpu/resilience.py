@@ -27,10 +27,15 @@ before any scale claim is honest:
    :class:`~cylon_tpu.errors.DataLossError` on mismatch — silent
    truncation becomes a loud failure.
 
-:class:`SpillStore` rounds the layer out: a directory-backed bucket
-spill with an atomically-updated completion manifest, so a killed
-out-of-core pass resumes at the first incomplete bucket instead of
-restarting (see ``outofcore.ooc_sort(resume_dir=...)``).
+:class:`SpillStore` and :class:`CheckpointedRun` round the layer out:
+a directory-backed unit spill with a fingerprinted, atomically-updated
+(tmp + fsync + rename) completion manifest, so a pass killed at ANY
+instant — including a hard ``os._exit`` preemption, injectable via
+:meth:`FaultRule.kill` — resumes at the first incomplete unit and
+produces output byte-identical to a fault-free run (every
+``outofcore`` pass takes ``resume_dir=``; the serve engine builds its
+write-ahead journal and catalog snapshot on the same primitives —
+:mod:`cylon_tpu.serve.durability`).
 """
 
 import contextlib
@@ -53,11 +58,18 @@ from cylon_tpu.errors import (Code, CylonError, DataLossError,
                               TransientError)
 
 __all__ = [
-    "INJECTION_POINTS", "FaultRule", "FaultPlan", "install", "active",
-    "scoped", "active_plan", "inject", "is_retryable",
-    "default_policy", "backoff_delays", "retrying", "RowAccount",
-    "accounting_enabled", "SpillStore",
+    "INJECTION_POINTS", "KILL_EXIT_CODE", "FaultRule", "FaultPlan",
+    "install", "active", "scoped", "active_plan", "inject",
+    "is_retryable", "default_policy", "backoff_delays", "retrying",
+    "RowAccount", "accounting_enabled", "atomic_write_json",
+    "SpillStore", "CheckpointedRun",
 ]
+
+#: exit status of a hard-kill FaultRule firing (``FaultRule.kill``) —
+#: distinct from every status the interpreter or pytest uses, so a
+#: chaos driver can assert "the child died AT the seeded fault point"
+#: rather than "the child died".
+KILL_EXIT_CODE = 43
 
 #: Named places the engine agrees to fail on demand. Each maps to a real
 #: failure domain: ``spill_write``/``spill_read`` — the out-of-core
@@ -88,7 +100,17 @@ class FaultRule:
     (:mod:`cylon_tpu.watchdog`) can see it. Which hits fire follows
     the same counting/seeded-prob schedule as raising rules, so delay
     schedules replay exactly too. :meth:`hang` is the documented
-    alias for an effectively-unbounded delay."""
+    alias for an effectively-unbounded delay.
+
+    ``exit_code`` (non-None) is **kill mode**: a firing hit
+    ``os._exit``\\ s the whole process at the fault point — no
+    exception, no ``finally`` blocks, no atexit flushes. This is the
+    injectable twin of a TPU preemption/OOM-kill, the failure class
+    retries cannot absorb and only a checkpoint/resume layer
+    (:class:`CheckpointedRun`, the serve journal) survives.
+    :meth:`kill` is the documented constructor (fixed
+    :data:`KILL_EXIT_CODE` so chaos drivers can assert the death was
+    the seeded one)."""
 
     point: str
     nth: int = 1
@@ -96,6 +118,7 @@ class FaultRule:
     error: "Exception | type | None" = None
     prob: float = 0.0
     delay: float = 0.0
+    exit_code: "int | None" = None
 
     @classmethod
     def hang(cls, point: str, seconds: float = 3600.0,
@@ -105,6 +128,16 @@ class FaultRule:
         injectable twin of a wedged peer or dead mount, detectable
         only by ``watchdog.deadline`` bounds."""
         return cls(point, delay=float(seconds), **kw)
+
+    @classmethod
+    def kill(cls, point: str, nth: int = 1, **kw) -> "FaultRule":
+        """A rule that HARD-KILLS the process (``os._exit``, status
+        :data:`KILL_EXIT_CODE`) on hit ``nth`` of ``point`` — the
+        chaos-harness preemption. Nothing downstream of the fault
+        point runs: no cleanup, no manifest flush beyond what is
+        already durable, which is exactly the window checkpoint/resume
+        must survive."""
+        return cls(point, nth=nth, exit_code=KILL_EXIT_CODE, **kw)
 
 
 class FaultPlan:
@@ -133,6 +166,9 @@ class FaultPlan:
             if r.delay < 0:
                 raise InvalidArgument(
                     f"delay must be >= 0, got {r.delay}")
+            if r.exit_code is not None and not 0 <= r.exit_code <= 255:
+                raise InvalidArgument(
+                    f"exit_code must be in [0, 255], got {r.exit_code}")
         self.seed = seed
         self._lock = threading.Lock()
         self.reset()
@@ -189,6 +225,16 @@ class FaultPlan:
             # injected hang: sleep OUTSIDE the plan lock so other
             # threads' injection points stay live while this one stalls
             time.sleep(hit.delay)
+        if hit.exit_code is not None:
+            # kill mode: die RIGHT HERE, like a preemption would — no
+            # exception propagation, no finally blocks. One stderr
+            # line first so a chaos run's death site is diagnosable.
+            import sys
+
+            print(f"cylon_tpu.resilience: injected HARD KILL at "
+                  f"{point!r} (hit {k}, exit {hit.exit_code})",
+                  file=sys.stderr, flush=True)
+            os._exit(hit.exit_code)
         err = hit.error() if isinstance(hit.error, type) else hit.error
         if err is None and hit.delay == 0:
             err = TransientError(
@@ -403,6 +449,30 @@ def check_conservation(label: str, rows_in, rows_out,
 
 
 # ----------------------------------------------------------- spill store
+def atomic_write_json(path: str, obj) -> None:
+    """Crash-safe JSON write: unique tmp + ``flush`` + ``fsync`` +
+    ``os.replace``. At EVERY instant the target path holds either the
+    previous complete document or the new complete document — a hard
+    kill (``os._exit``, SIGKILL, power loss) mid-write can only strand
+    a tmp file, never a torn target. This is the ONE write primitive
+    every manifest/journal/sentinel site uses (the atomicity audit in
+    ``tests/test_checkpoint.py`` pins the fsync-before-replace order)."""
+    tmp = (f"{path}.tmp{os.getpid()}_"
+           f"{threading.get_ident()}_{next(_TMP_SEQ)}")
+    try:
+        with open(tmp, "w") as f:
+            json.dump(obj, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
 class SpillStore:
     """Directory-backed bucket spill with a completion manifest.
 
@@ -441,8 +511,8 @@ class SpillStore:
 
             own = re.compile(r"^bucket\d{5}\.npz(\.tmp\S*)?$")
             for f in os.listdir(self.root):
-                if own.match(f) or f in (self.MANIFEST,
-                                         self.MANIFEST + ".tmp"):
+                if own.match(f) or f == self.MANIFEST \
+                        or f.startswith(self.MANIFEST + ".tmp"):
                     os.unlink(os.path.join(self.root, f))
             m = {"fingerprint": fingerprint, "completed": {}}
             self._write_manifest(m)
@@ -456,10 +526,7 @@ class SpillStore:
             return None
 
     def _write_manifest(self, m) -> None:
-        tmp = self._mpath + ".tmp"
-        with open(tmp, "w") as f:
-            json.dump(m, f)
-        os.replace(tmp, self._mpath)
+        atomic_write_json(self._mpath, m)
 
     def _bucket_path(self, p: int) -> str:
         return os.path.join(self.root, f"bucket{int(p):05d}.npz")
@@ -473,9 +540,16 @@ class SpillStore:
         v = self._m["completed"].get(str(int(p)))
         return None if v is None else int(v)
 
-    def write_bucket(self, p: int, cols: dict, rows: int) -> None:
-        """Durably spill one bucket's columns, then record completion.
-        Empty buckets record 0 rows with no file."""
+    def bucket_meta(self, p: int) -> "dict | None":
+        """Per-unit metadata recorded at completion (e.g. the input
+        sizes a resumed ``ooc_join`` partition must re-verify)."""
+        return self._m.get("meta", {}).get(str(int(p)))
+
+    def write_bucket(self, p: int, cols: dict, rows: int,
+                     meta: "dict | None" = None) -> None:
+        """Durably spill one bucket's columns, then record completion
+        (plus optional ``meta``, kept in the manifest next to the row
+        count). Empty buckets record 0 rows with no file."""
         path = self._bucket_path(p)
 
         def _write():
@@ -485,12 +559,16 @@ class SpillStore:
             # name would interleave two writers in one inode and
             # os.replace could install the torn file as a "completed"
             # bucket. Distinct inodes + atomic replace keep whichever
-            # rename lands last a complete, valid write.
+            # rename lands last a complete, valid write; the fsync
+            # means the bytes are durable BEFORE the rename can make
+            # the manifest point at them.
             tmp = (f"{path}.tmp{os.getpid()}_"
                    f"{threading.get_ident()}_{next(_TMP_SEQ)}")
             try:
                 with open(tmp, "wb") as f:
                     np.savez(f, **cols)
+                    f.flush()
+                    os.fsync(f.fileno())
                 os.replace(tmp, path)
             except BaseException:
                 try:
@@ -513,6 +591,8 @@ class SpillStore:
             _trace.instant("spill.write", cat="spill", bucket=p,
                            bytes=nb, rows=int(rows))
         self._m["completed"][str(int(p))] = int(rows)
+        if meta is not None:
+            self._m.setdefault("meta", {})[str(int(p))] = dict(meta)
         self._write_manifest(self._m)
 
     def read_bucket(self, p: int) -> dict:
@@ -536,6 +616,96 @@ class SpillStore:
         telemetry.counter("spill.read_buckets").inc()
         _trace.instant("spill.read", cat="spill", bucket=p, bytes=nb)
         return out
+
+
+class CheckpointedRun:
+    """Generic checkpoint/resume for a multi-unit pass.
+
+    Factors the resumable-manifest machinery ``ooc_sort`` pioneered
+    into the reusable shape every long pass threads through
+    (``ooc_join``/``ooc_groupby`` partitions and chunks, the serve
+    catalog snapshot): a run is identified by a **fingerprint** —
+    ``op`` plus the partitioning *plan* (keys, splitters, partition
+    counts, transform identity…) — and made of numbered **units**,
+    each completed atomically (data durable + fsynced BEFORE the
+    manifest records it, via :class:`SpillStore`). The guarantees:
+
+    * a process hard-killed at ANY instant leaves every recorded unit
+      complete and valid — a re-invocation with the same arguments
+      replays recorded units byte-identically and recomputes only the
+      rest, so the final output equals a fault-free run's;
+    * a directory whose fingerprint does not match (different op,
+      keys, plan, data-derived splitters) is DISCARDED, never resumed
+      against the wrong plan;
+    * per-unit ``meta`` recorded at completion lets the resuming run
+      re-verify source stability (e.g. partition input sizes) and
+      raise :class:`~cylon_tpu.errors.DataLossError` instead of
+      silently mixing two generations of the source.
+
+    Every resumed unit counts ``ooc.units_resumed{op=}``.
+    """
+
+    def __init__(self, root: str, op: str, plan=(),
+                 policy: "RetryPolicy | None" = None):
+        self.op = str(op)
+        self.fingerprint = fingerprint_arrays(self.op, *plan)
+        self.store = SpillStore(root, fingerprint=self.fingerprint,
+                                policy=policy)
+
+    @property
+    def completed(self) -> dict:
+        """{unit: rows} for every durably completed unit."""
+        return self.store.completed
+
+    def completed_rows(self, unit: int) -> "int | None":
+        """Recorded row count of ``unit`` (None = not completed)."""
+        return self.store.completed_rows(unit)
+
+    def unit_meta(self, unit: int) -> "dict | None":
+        return self.store.bucket_meta(unit)
+
+    def complete(self, unit: int, cols: dict, rows: int,
+                 meta: "dict | None" = None) -> None:
+        """Durably record ``unit`` done: columns spilled + fsynced,
+        then the manifest updated atomically — a kill between the two
+        just recomputes the unit."""
+        self.store.write_bucket(unit, cols, int(rows), meta=meta)
+
+    def note_resumed(self, unit: int) -> None:
+        """Count a completed unit as resumed (no IO) — the metrics
+        half of :meth:`resume_unit`, for callers that skip the data
+        (count-only runs with no sink)."""
+        telemetry.counter("ooc.units_resumed", op=self.op).inc()
+        _trace.instant("ckpt.resume", cat="resilience", op=self.op,
+                       unit=int(unit))
+
+    def load_unit(self, unit: int) -> dict:
+        """A completed unit's columns from the durable spill ({} for
+        0-row units) — no counting; pair with :meth:`note_resumed`."""
+        rows = self.store.completed_rows(unit)
+        return self.store.read_bucket(unit) if rows else {}
+
+    def resume_unit(self, unit: int) -> dict:
+        """Replay a completed unit's columns from the durable spill
+        ({} for 0-row units) and count it as resumed."""
+        self.note_resumed(unit)
+        return self.load_unit(unit)
+
+    def verify_meta(self, unit: int, label: str, **expect) -> None:
+        """Raise :class:`~cylon_tpu.errors.DataLossError` if ``unit``'s
+        recorded meta disagrees with the re-derived values — the
+        source changed since the manifest was written."""
+        meta = self.unit_meta(unit) or {}
+        bad = {k: (meta.get(k), v) for k, v in expect.items()
+               if meta.get(k) != v}
+        if bad:
+            raise DataLossError(
+                f"{label}: resume manifest for unit {unit} recorded "
+                f"{ {k: got for k, (got, _) in bad.items()} } but the "
+                f"re-derived source has "
+                f"{ {k: want for k, (_, want) in bad.items()} } — the "
+                "source changed since the checkpoint was written; "
+                "clear the resume_dir")
 
 
 def fingerprint_arrays(*parts) -> str:

@@ -8,14 +8,22 @@ One resident mesh, many concurrent queries: a long-lived
 (:func:`cylon_tpu.watchdog.deadline`), shares one compiled-plan cache
 across clients (:func:`cylon_tpu.plan.shared_compiled`) and meters
 everything per tenant (``serve.*`` + tenant-labeled instruments).
+With a ``durable_dir`` the engine is CRASH-SAFE: admitted requests
+journal write-ahead (idempotency-key deduped), resident tables
+snapshot, and ``ServeEngine.recover(dir)`` rebuilds mesh + tables +
+in-flight work after a hard kill; a sustained failure storm trips the
+admission circuit breaker instead of wedging the process.
 ``python -m cylon_tpu.serve.bench --clients 8`` replays a mixed TPC-H
 workload against it. See ``docs/serving.md``.
 """
 
-from cylon_tpu.serve.admission import (AdmissionController, ServePolicy,
+from cylon_tpu.serve.admission import (AdmissionController,
+                                       CircuitBreaker, ServePolicy,
                                        default_policy)
+from cylon_tpu.serve.durability import CatalogSnapshot, RequestJournal
 from cylon_tpu.serve.service import QueryTicket, ServeEngine
 from cylon_tpu.serve.session import Session
 
 __all__ = ["ServeEngine", "QueryTicket", "Session", "ServePolicy",
-           "AdmissionController", "default_policy"]
+           "AdmissionController", "CircuitBreaker", "RequestJournal",
+           "CatalogSnapshot", "default_policy"]

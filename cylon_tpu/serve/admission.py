@@ -14,6 +14,14 @@ RESOURCE_EXHAUSTED discipline, which this module adopts:
   check under one lock, no device work, no blocking), so the client
   learns to back off in microseconds instead of timing out minutes
   later;
+* a **circuit breaker** (:class:`CircuitBreaker`): under a sustained
+  storm of ``DeadlineExceeded``/``ResourceExhausted`` request failures
+  (a wedged mesh, an HBM-exhaustion cascade), the engine stops
+  admitting NEW work — fast rejection, counted as
+  ``serve.shed{reason="breaker"}`` — while in-flight requests keep
+  draining on the scheduler. After ``breaker_cooldown`` seconds the
+  breaker half-opens and admissions probe through again. Degrading
+  gracefully beats dying: the engine stays up, sheds, recovers;
 * a **default SLO** (``default_slo``) stamped on every admitted
   request that doesn't bring its own — the per-request
   :func:`cylon_tpu.watchdog.deadline` budget the scheduler enforces at
@@ -25,25 +33,33 @@ RESOURCE_EXHAUSTED discipline, which this module adopts:
 Knobs (all env-overridable — the ``CYLON_TPU_SERVE_*`` family, read at
 engine construction; see ``docs/serving.md``):
 
-=========================== ============================== =========
-env                         meaning                        default
-=========================== ============================== =========
-``CYLON_TPU_SERVE_MAX_QUEUE``  live-request cap            ``64``
-``CYLON_TPU_SERVE_SLO``        default per-request SLO (s; ``0`` =
-                               unbounded)                  ``0``
-``CYLON_TPU_SERVE_SCHEDULE``   ``roundrobin`` | ``priority``
-                                                           roundrobin
-=========================== ============================== =========
+================================== ============================ =========
+env                                meaning                      default
+================================== ============================ =========
+``CYLON_TPU_SERVE_MAX_QUEUE``      live-request cap             ``64``
+``CYLON_TPU_SERVE_SLO``            default per-request SLO (s;
+                                   ``0`` = unbounded)           ``0``
+``CYLON_TPU_SERVE_SCHEDULE``       ``roundrobin`` | ``priority``
+                                                                roundrobin
+``CYLON_TPU_SERVE_BREAKER_FAILS``  breaker trip threshold
+                                   (failures in window; ``0``
+                                   disables)                    ``5``
+``CYLON_TPU_SERVE_BREAKER_WINDOW`` failure-counting window (s)  ``30``
+``CYLON_TPU_SERVE_BREAKER_COOLDOWN`` open→half-open delay (s)   ``5``
+================================== ============================ =========
 """
 
+import collections
 import dataclasses
 import os
 import threading
+import time
 
 from cylon_tpu import telemetry
 from cylon_tpu.errors import InvalidArgument, ResourceExhausted
 
-__all__ = ["ServePolicy", "default_policy", "AdmissionController"]
+__all__ = ["ServePolicy", "default_policy", "AdmissionController",
+           "CircuitBreaker"]
 
 _SCHEDULES = ("roundrobin", "priority")
 
@@ -55,6 +71,9 @@ class ServePolicy:
     max_queue: int = 64
     default_slo: "float | None" = None
     schedule: str = "roundrobin"
+    breaker_fails: int = 5
+    breaker_window: float = 30.0
+    breaker_cooldown: float = 5.0
 
     def __post_init__(self):
         if self.max_queue < 1:
@@ -68,6 +87,13 @@ class ServePolicy:
             raise InvalidArgument(
                 f"default_slo must be > 0 seconds or None, got "
                 f"{self.default_slo}")
+        if self.breaker_fails < 0:
+            raise InvalidArgument(
+                f"breaker_fails must be >= 0 (0 disables), got "
+                f"{self.breaker_fails}")
+        if self.breaker_window <= 0 or self.breaker_cooldown <= 0:
+            raise InvalidArgument(
+                "breaker_window/breaker_cooldown must be > 0 seconds")
 
 
 def default_policy() -> ServePolicy:
@@ -79,7 +105,86 @@ def default_policy() -> ServePolicy:
         max_queue=int(e.get("CYLON_TPU_SERVE_MAX_QUEUE", "64")),
         default_slo=slo if slo > 0 else None,
         schedule=e.get("CYLON_TPU_SERVE_SCHEDULE", "roundrobin"),
+        breaker_fails=int(e.get("CYLON_TPU_SERVE_BREAKER_FAILS", "5")),
+        breaker_window=float(
+            e.get("CYLON_TPU_SERVE_BREAKER_WINDOW", "30")),
+        breaker_cooldown=float(
+            e.get("CYLON_TPU_SERVE_BREAKER_COOLDOWN", "5")),
     )
+
+
+class CircuitBreaker:
+    """Failure-storm gate: open = shed new admissions, drain in-flight.
+
+    ``record_failure(kind)`` feeds request retirements whose error
+    class signals systemic overload (:data:`BREAKING_KINDS` — SLO
+    storms and resource exhaustion, NOT per-request bugs); when
+    ``threshold`` such failures land within ``window`` seconds the
+    breaker OPENS. While open, :meth:`allow` is False — the admission
+    controller sheds with a fast ResourceExhausted — until ``cooldown``
+    seconds pass, when the breaker half-opens: the failure ledger
+    clears and admissions probe through (a fresh storm re-trips it). A
+    success in the closed state clears the ledger — only *sustained*
+    storms trip. ``threshold <= 0`` disables the breaker entirely."""
+
+    #: error type names that count toward tripping: the systemic-
+    #: overload classes (a deadline storm from a wedged mesh, resource
+    #: exhaustion from an HBM cascade). Per-request failures
+    #: (InvalidArgument, a query bug) never trip the breaker.
+    BREAKING_KINDS = frozenset({"DeadlineExceeded", "ResourceExhausted"})
+
+    def __init__(self, threshold: int = 5, window: float = 30.0,
+                 cooldown: float = 5.0):
+        self.threshold = int(threshold)
+        self.window = float(window)
+        self.cooldown = float(cooldown)
+        self._mu = threading.Lock()
+        self._failures: "collections.deque[float]" = collections.deque()
+        self._opened_at: "float | None" = None
+
+    @property
+    def state(self) -> str:
+        with self._mu:
+            return "open" if self._opened_at is not None else "closed"
+
+    def record_failure(self, kind: str) -> None:
+        if self.threshold <= 0 or kind not in self.BREAKING_KINDS:
+            return
+        now = time.monotonic()
+        with self._mu:
+            self._failures.append(now)
+            while self._failures and \
+                    now - self._failures[0] > self.window:
+                self._failures.popleft()
+            if (self._opened_at is None
+                    and len(self._failures) >= self.threshold):
+                self._opened_at = now
+                telemetry.counter("serve.breaker_trips").inc()
+                telemetry.gauge("serve.breaker_open").set(1)
+
+    def record_success(self) -> None:
+        """A completed request in the closed state clears the streak
+        (the storm was not sustained)."""
+        with self._mu:
+            if self._opened_at is None:
+                self._failures.clear()
+
+    def allow(self) -> bool:
+        """May a new request be admitted right now? Transitions
+        open → half-open after ``cooldown`` (ledger cleared, admissions
+        probe through)."""
+        if self.threshold <= 0:
+            return True
+        with self._mu:
+            if self._opened_at is None:
+                return True
+            if time.monotonic() - self._opened_at < self.cooldown:
+                return False
+            # half-open: let traffic probe; a fresh storm re-trips
+            self._opened_at = None
+            self._failures.clear()
+            telemetry.gauge("serve.breaker_open").set(0)
+            return True
 
 
 class AdmissionController:
@@ -96,6 +201,10 @@ class AdmissionController:
         self.policy = policy or default_policy()
         self._mu = threading.Lock()
         self._live = 0
+        self.breaker = CircuitBreaker(
+            threshold=self.policy.breaker_fails,
+            window=self.policy.breaker_window,
+            cooldown=self.policy.breaker_cooldown)
 
     @property
     def live(self) -> int:
@@ -103,6 +212,18 @@ class AdmissionController:
             return self._live
 
     def admit(self, tenant: str) -> None:
+        if not self.breaker.allow():
+            # open breaker: shed BEFORE taking a slot — in-flight work
+            # keeps draining, new work is refused in microseconds
+            telemetry.counter("serve.shed", reason="breaker",
+                              tenant=tenant).inc()
+            telemetry.counter("serve.rejected", tenant=tenant).inc()
+            raise ResourceExhausted(
+                f"serve circuit breaker open (sustained "
+                f"DeadlineExceeded/ResourceExhausted storm; tenant "
+                f"{tenant!r}): shedding new admissions while in-flight "
+                f"work drains; retry after "
+                f"{self.policy.breaker_cooldown:.1f}s")
         with self._mu:
             if self._live >= self.policy.max_queue:
                 depth = self._live
@@ -113,6 +234,8 @@ class AdmissionController:
                 admitted = True
         telemetry.gauge("serve.queue_depth").set(depth)
         if not admitted:
+            telemetry.counter("serve.shed", reason="queue_full",
+                              tenant=tenant).inc()
             telemetry.counter("serve.rejected", tenant=tenant).inc()
             raise ResourceExhausted(
                 f"serve queue full: {depth} live requests >= cap "

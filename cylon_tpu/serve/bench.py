@@ -45,7 +45,8 @@ import numpy as np
 REQUIRED_SERVE_FIELDS = frozenset({
     "metric", "clients", "requests_total", "tenants", "schedule",
     "p50_s", "p99_s", "qps", "cache_hit_rate", "rejected", "errors",
-    "expired", "oracle_mismatches",
+    "expired", "oracle_mismatches", "shed", "journal_replayed",
+    "recoveries",
 })
 
 #: default mixed workload: groupby-heavy scan, 3-way join + top-k,
@@ -239,6 +240,13 @@ def run_bench(clients: int = 8, requests: int = 2, sf: float = 0.002,
         "rejected": telemetry.total("serve.rejected"),
         "errors": telemetry.total("serve.errors"),
         "expired": telemetry.total("serve.expired"),
+        # robustness columns (ISSUE 8): load shed by the admission
+        # layer (queue_full / breaker), journal replays and recoveries
+        # — 0 on a healthy fault-free replay, pinned so a chaos run's
+        # sheds/replays ride the trajectory
+        "shed": telemetry.total("serve.shed"),
+        "journal_replayed": telemetry.total("serve.journal_replayed"),
+        "recoveries": telemetry.total("serve.recoveries"),
         "cache_hit_rate": round(cache["hit_rate"], 4),
         "cache_hits": cache["hits"],
         "cache_misses": cache["misses"],

@@ -1,0 +1,256 @@
+"""Serve-engine durability: the write-ahead journal + catalog snapshot.
+
+The always-on engine (:mod:`cylon_tpu.serve.service`) is exactly the
+process a preemption hurts most: it holds resident tables other
+processes registered and requests clients already got tickets for.
+This module gives :class:`~cylon_tpu.serve.ServeEngine` a durable spine
+so ``ServeEngine.recover(dir)`` can rebuild both after a hard kill:
+
+* :class:`RequestJournal` — an append-only JSONL **write-ahead
+  journal**. Every admitted request lands as an ``admit`` line (fsynced
+  BEFORE the request is dispatched to the scheduler — the write-ahead
+  invariant the bench guard enforces statically), and every retirement
+  as a ``done`` line. Recovery replays admitted-but-not-done entries.
+  Client-supplied **idempotency keys** make the replay exactly-once: a
+  client retrying a request it never got an answer for reuses its key,
+  and the engine dedups against both live and replayed requests instead
+  of double-executing.
+
+* :class:`CatalogSnapshot` — the resident tables, spilled through the
+  same fsync-then-rename :class:`~cylon_tpu.resilience.SpillStore`
+  machinery the out-of-core checkpoints use. ``register_table`` on a
+  durable engine snapshots the table's host content; ``recover``
+  restores every snapshot into the process catalog (distributed tables
+  restore as local tables — re-scatter against the recovered mesh if
+  the deployment shards them).
+
+Crash-window contract (shared with :class:`CheckpointedRun`): every
+manifest write is tmp + fsync + ``os.replace``; journal lines are
+flushed + fsynced per record, and a torn trailing line (the kill landed
+mid-append) is skipped on replay, never fatal.
+"""
+
+import json
+import os
+import threading
+
+from cylon_tpu.resilience import SpillStore, atomic_write_json
+from cylon_tpu.utils.logging import get_logger
+
+__all__ = ["RequestJournal", "CatalogSnapshot"]
+
+
+class RequestJournal:
+    """Append-only JSONL write-ahead journal of serve requests.
+
+    One line per event::
+
+        {"kind": "admit", "rid": 3, "key": "c1-q3-0", "name": "q3",
+         "args": [...], "kwargs": {...}, "tenant": "t1", "priority": 1,
+         "slo": null, "tables": ["tpch/lineitem"], "replayable": true}
+        {"kind": "done", "rid": 3, "key": "c1-q3-0", "state": "done"}
+
+    ``admit`` is written (flush + fsync) BEFORE the request reaches the
+    scheduler, so a kill at any later instant leaves the request
+    recoverable. A request whose args are not JSON-serializable (or
+    that was submitted as a bare callable rather than a registered
+    named query) is journaled with ``replayable: false`` — recovery
+    reports it as lost instead of silently dropping it.
+    """
+
+    FILE = "journal.jsonl"
+
+    def __init__(self, root: str):
+        self.root = str(root)
+        os.makedirs(self.root, exist_ok=True)
+        self.path = os.path.join(self.root, self.FILE)
+        self._mu = threading.Lock()
+        self._f = open(self.path, "a")
+
+    def _append(self, entry: dict) -> None:
+        line = json.dumps(entry)
+        with self._mu:
+            self._f.write(line + "\n")
+            self._f.flush()
+            os.fsync(self._f.fileno())
+
+    def admit(self, *, rid: int, key: "str | None", name: "str | None",
+              args=(), kwargs=None, tenant: str = "default",
+              priority: int = 1, slo: "float | None" = None,
+              tables=()) -> None:
+        """Write-ahead record of one admitted request. Falls back to
+        ``replayable: false`` (with args dropped) when the payload is
+        not JSON-serializable — the journal must never fail a submit
+        that the engine would otherwise accept."""
+        entry = {"kind": "admit", "rid": int(rid), "key": key,
+                 "name": name, "args": list(args),
+                 "kwargs": dict(kwargs or {}), "tenant": str(tenant),
+                 "priority": int(priority), "slo": slo,
+                 "tables": list(tables),
+                 "replayable": name is not None}
+        try:
+            self._append(entry)
+        except (TypeError, ValueError):
+            entry.update(args=[], kwargs={}, replayable=False)
+            self._append(entry)
+
+    def done(self, *, rid: int, key: "str | None", state: str) -> None:
+        """Retirement record (state ``done``/``failed``): the request
+        needs no replay — even a FAILED one, whose error the client
+        already observed (re-running it on recovery would surprise an
+        idempotent client with a second side-effect attempt)."""
+        self._append({"kind": "done", "rid": int(rid), "key": key,
+                      "state": str(state)})
+
+    # ---------------------------------------------------------- replay
+    @staticmethod
+    def read(root: str) -> "list[dict]":
+        """All parseable journal entries under ``root`` (missing file =
+        empty). A torn trailing line — the kill landed mid-append — is
+        skipped; a torn line FOLLOWED by valid lines would mean
+        fsync-ordering was violated and is logged loudly but still
+        skipped (recovery must degrade, not die)."""
+        path = os.path.join(str(root), RequestJournal.FILE)
+        entries: list = []
+        torn = 0
+        try:
+            with open(path) as f:
+                lines = f.read().splitlines()
+        except OSError:
+            return entries
+        for i, line in enumerate(lines):
+            if not line.strip():
+                continue
+            try:
+                entries.append(json.loads(line))
+            except ValueError:
+                torn += 1
+                if i != len(lines) - 1:
+                    get_logger().error(
+                        "serve journal %s: torn NON-final line %d "
+                        "(skipped) — fsync ordering violated?",
+                        path, i + 1)
+        if torn:
+            get_logger().warning(
+                "serve journal %s: skipped %d torn line(s)", path, torn)
+        return entries
+
+    @staticmethod
+    def incomplete(root: str) -> "tuple[list[dict], list[dict]]":
+        """(replayable, unreplayable) admitted-but-not-done entries, in
+        admission order, deduped by idempotency key (a key journaled
+        twice — e.g. admitted again by a previous recovery — replays
+        once)."""
+        done_keys, done_rids = set(), set()
+        for e in RequestJournal.read(root):
+            if e.get("kind") == "done":
+                if e.get("key") is not None:
+                    done_keys.add(e["key"])
+                done_rids.add(e.get("rid"))
+        replayable, unreplayable, seen = [], [], set()
+        for e in RequestJournal.read(root):
+            if e.get("kind") != "admit":
+                continue
+            key = e.get("key")
+            if key is not None:
+                if key in done_keys or key in seen:
+                    continue
+                seen.add(key)
+            elif e.get("rid") in done_rids:
+                continue
+            (replayable if e.get("replayable") and e.get("name")
+             else unreplayable).append(e)
+        return replayable, unreplayable
+
+    def close(self) -> None:
+        with self._mu:
+            try:
+                self._f.close()
+            except OSError:  # pragma: no cover - close best-effort
+                pass
+
+
+class CatalogSnapshot:
+    """Durable image of the resident-table catalog.
+
+    Tables spill into a :class:`~cylon_tpu.resilience.SpillStore` under
+    ``<root>/catalog/`` (one bucket per table, fsync-then-rename data +
+    manifest), with a ``tables.json`` map from table id to bucket —
+    itself written via :func:`~cylon_tpu.resilience.atomic_write_json`.
+    The store's fingerprint is a fixed format tag, so reopening after a
+    kill resumes the snapshot rather than discarding it."""
+
+    FORMAT = "serve-catalog-v1"
+    MAP = "tables.json"
+
+    def __init__(self, root: str):
+        self.root = os.path.join(str(root), "catalog")
+        self.store = SpillStore(self.root, fingerprint=self.FORMAT)
+        self._mpath = os.path.join(self.root, self.MAP)
+        try:
+            with open(self._mpath) as f:
+                self._map = json.load(f)
+        except (OSError, ValueError):
+            self._map = {"tables": {}, "next": 0}
+
+    def _flush_map(self) -> None:
+        atomic_write_json(self._mpath, self._map)
+
+    @property
+    def tables(self) -> "list[str]":
+        return sorted(self._map["tables"])
+
+    def save(self, table_id: str, table, env=None) -> None:
+        """Snapshot one table's host content (distributed tables
+        gather to host first). Data lands durably BEFORE the map names
+        it — a kill mid-save leaves the previous snapshot intact."""
+        pdf = self._host_frame(table, env)
+        if not len(pdf.columns):
+            get_logger().warning(
+                "catalog snapshot: table %r has no columns; skipped",
+                table_id)
+            return
+        ent = self._map["tables"].get(table_id)
+        if ent is None:
+            bucket = int(self._map["next"])
+            self._map["next"] = bucket + 1
+        else:
+            bucket = int(ent["bucket"])
+        self.store.write_bucket(
+            bucket, {c: pdf[c].to_numpy() for c in pdf.columns},
+            max(len(pdf), 1), meta={"table_id": table_id,
+                                    "rows": int(len(pdf))})
+        self._map["tables"][table_id] = {"bucket": bucket,
+                                         "rows": int(len(pdf))}
+        self._flush_map()
+
+    @staticmethod
+    def _host_frame(table, env=None):
+        from cylon_tpu.parallel import dtable
+
+        if dtable.is_distributed(table):
+            from cylon_tpu.parallel import dist_to_pandas
+
+            return dist_to_pandas(env, table)
+        return table.to_pandas()
+
+    def drop(self, table_id: str) -> None:
+        """Forget a table's snapshot (the orphaned bucket is left on
+        disk; the map is authoritative)."""
+        if self._map["tables"].pop(table_id, None) is not None:
+            self._flush_map()
+
+    def restore(self) -> "dict[str, object]":
+        """Rebuild every snapshot table: {table_id: Table}. Rows==0
+        snapshots restore with their schema (the spill kept empty
+        columns)."""
+        from cylon_tpu.table import Table
+
+        out: dict = {}
+        for tid, ent in sorted(self._map["tables"].items()):
+            cols = self.store.read_bucket(int(ent["bucket"]))
+            rows = int(ent["rows"])
+            out[tid] = Table.from_pydict(
+                {k: v[:rows] for k, v in cols.items()},
+                capacity=None if rows else 1)
+        return out

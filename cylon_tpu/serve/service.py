@@ -55,6 +55,19 @@ Design — an assembly of the subsystems the previous PRs built:
   scope can never leak into another tenant's step), and resident-table
   pins (:func:`cylon_tpu.catalog.pin`) keep a concurrent ``drop`` from
   yanking a table out from under an in-flight query.
+
+* **Durability** (:mod:`cylon_tpu.serve.durability`): with a
+  ``durable_dir``, every admitted request is journaled (fsynced
+  write-ahead, BEFORE dispatch — the invariant the bench guard lints
+  statically) and every registered table snapshots through the
+  checkpoint spill machinery, so a hard-killed engine process recovers
+  via :meth:`ServeEngine.recover`: mesh restarted, resident tables
+  restored, journaled-but-incomplete **named** requests re-run exactly
+  once (client-supplied idempotency keys dedup a client's own retries
+  against the replay). A sustained failure storm trips the admission
+  circuit breaker (:class:`~cylon_tpu.serve.admission.CircuitBreaker`)
+  instead of wedging the engine: new work sheds fast, in-flight work
+  drains.
 """
 
 import contextlib
@@ -228,7 +241,8 @@ class ServeEngine:
 
     _ids = itertools.count(1)
 
-    def __init__(self, env=None, policy: "ServePolicy | None" = None):
+    def __init__(self, env=None, policy: "ServePolicy | None" = None,
+                 durable_dir: "str | None" = None):
         self._env = env
         self._admission = AdmissionController(policy)
         self._policy = self._admission.policy
@@ -240,6 +254,20 @@ class ServeEngine:
         self._thread: "threading.Thread | None" = None
         self._closed = False
         self._op_ids = itertools.count(1)
+        #: named-query registry: the replayable submission surface
+        #: (recovery can only re-run what it can name)
+        self._queries: "dict[str, object]" = {}
+        #: idempotency-key -> ticket (live AND retired): a retried key
+        #: returns the existing ticket instead of double-executing
+        self._idem: "dict[str, QueryTicket]" = {}
+        self._journal = self._snapshot = None
+        if durable_dir is not None:
+            from cylon_tpu.serve.durability import (CatalogSnapshot,
+                                                    RequestJournal)
+
+            self._journal = RequestJournal(durable_dir)
+            self._snapshot = CatalogSnapshot(durable_dir)
+        self.durable_dir = durable_dir
 
     # ------------------------------------------------- resident tables
     @property
@@ -250,15 +278,28 @@ class ServeEngine:
         """Register a resident table (Table or DataFrame) in the
         process catalog under ``table_id`` — the shared store every
         request reads through (pin-protected; see
-        :func:`cylon_tpu.catalog.drop`)."""
+        :func:`cylon_tpu.catalog.drop`). On a durable engine the
+        table's host content also snapshots to ``durable_dir`` so
+        :meth:`recover` can restore it after a kill."""
         t = getattr(table, "table", table)
         catalog.put_table(table_id, t)
+        if self._snapshot is not None:
+            self._snapshot.save(table_id, t, env=self._env)
 
     def drop_table(self, table_id: str) -> None:
         """Pin-respecting drop: raises
         :class:`~cylon_tpu.errors.FailedPrecondition` naming the
         holders while any session/request still pins the table."""
         catalog.drop(table_id, if_exists=False)
+        if self._snapshot is not None:
+            self._snapshot.drop(table_id)
+
+    def register_query(self, name: str, fn) -> None:
+        """Name a query function for :meth:`submit_named` — the
+        REPLAYABLE submission surface: only named queries (with
+        JSON-able args) can be re-run by :meth:`recover`, because the
+        journal can name them where it cannot serialize a closure."""
+        self._queries[str(name)] = fn
 
     def table_stats(self) -> dict:
         """Per-table rows/bytes/pins of the resident catalog."""
@@ -274,7 +315,10 @@ class ServeEngine:
     # ------------------------------------------------------ submission
     def submit(self, fn, *args, tenant: str = "default",
                priority: int = 1, slo: "float | None" = None,
-               tables=(), fault_plan=None, **kwargs) -> QueryTicket:
+               tables=(), fault_plan=None,
+               idempotency_key: "str | None" = None,
+               _journal_name: "str | None" = None,
+               **kwargs) -> QueryTicket:
         """Admit one query for scheduled execution.
 
         ``fn(*args, **kwargs)`` runs on the scheduler thread — a plain
@@ -284,11 +328,27 @@ class ServeEngine:
         (``CYLON_TPU_SERVE_SLO``); ``slo <= 0`` explicitly unbounds the
         request. ``tables`` are catalog ids pinned for the request's
         lifetime. ``fault_plan`` (tests/chaos drills) is installed only
-        around this request's steps. Raises
+        around this request's steps. ``idempotency_key`` dedups: a key
+        the engine has already seen (live or retired) returns the
+        EXISTING ticket — the same request is never executed twice, so
+        a client retrying after a lost answer (or a recovery replaying
+        the journal) is safe. Raises
         :class:`~cylon_tpu.errors.ResourceExhausted` immediately when
-        the live-request cap is hit."""
+        the live-request cap is hit or the circuit breaker is open."""
         if self._closed:
             raise InvalidArgument("engine is closed")
+        key = idempotency_key
+        if key is not None:
+            with self._cond:
+                existing = self._idem.get(key)
+            if existing is not None:
+                telemetry.counter("serve.idempotent_hits",
+                                  tenant=tenant).inc()
+                return existing
+        # journal the PRE-normalization slo: an explicit slo<=0
+        # ("unbounded") must replay unbounded, not pick up the engine
+        # default the way a None would
+        slo_raw = slo
         if slo is None:
             slo = self._policy.default_slo
         elif slo <= 0:
@@ -310,14 +370,107 @@ class ServeEngine:
         op = _QueryOp(next(self._op_ids), self, ticket, fn, args,
                       kwargs, fault_plan, pinned)
         op._holder = holder
+        op._idem_key = key
+        if key is not None:
+            with self._cond:
+                existing = self._idem.get(key)
+                if existing is not None:  # lost a submit race: undo
+                    self._undo_admission(op)
+                    telemetry.counter("serve.idempotent_hits",
+                                      tenant=tenant).inc()
+                    return existing
+                self._idem[key] = ticket
+                self._evict_idem_locked()
         telemetry.counter("serve.requests", tenant=ticket.tenant).inc()
         _trace.instant("serve.admit", cat="serve", tenant=ticket.tenant,
                        rid=ticket.rid, slo=slo)
+        # WRITE-AHEAD: the journal records the admission durably BEFORE
+        # the scheduler can touch it — a kill at any later instant
+        # leaves the request recoverable (bench-guard lints this order).
+        # A journal that cannot be written fails the submit CLEANLY
+        # (slot/pins/key released): accepting an unjournalable request
+        # would silently void the recovery contract.
+        try:
+            self._journal_admit(ticket, _journal_name, args, kwargs,
+                                key, slo_raw, tables)
+        except BaseException:
+            with self._cond:
+                self._undo_admission(op)
+            raise
+        self._dispatch(op, ticket)
+        return ticket
+
+    def _undo_admission(self, op: "_QueryOp") -> None:
+        """Roll back an admission that never reached the scheduler:
+        release pins + the admission slot + the idempotency entry.
+        Caller holds ``self._cond``."""
+        for tid in op._pins:
+            try:
+                catalog.unpin(tid, holder=op._holder)
+            except Exception:  # pragma: no cover - unpin best-effort
+                pass
+        self._admission.release()
+        if op._idem_key is not None and \
+                self._idem.get(op._idem_key) is op.ticket:
+            self._idem.pop(op._idem_key, None)
+
+    def _evict_idem_locked(self) -> None:
+        """Bound the idempotency map (always-on engines would otherwise
+        grow it — and every retained result — forever): past the cap,
+        drop the oldest RETIRED entries; live tickets are never
+        evicted. Caller holds ``self._cond``. An evicted key loses its
+        dedup guarantee, which is why the cap is generous and
+        env-tunable (``CYLON_TPU_SERVE_IDEM_ENTRIES``)."""
+        import os
+
+        cap = int(os.environ.get("CYLON_TPU_SERVE_IDEM_ENTRIES",
+                                 "65536"))
+        if cap <= 0 or len(self._idem) <= cap:
+            return
+        for k in [k for k, t in self._idem.items() if t.done]:
+            if len(self._idem) <= cap:
+                break
+            del self._idem[k]
+
+    def submit_named(self, name: str, *args,
+                     idempotency_key: "str | None" = None,
+                     **kwargs) -> QueryTicket:
+        """Submit a query registered via :meth:`register_query` — the
+        durable submission surface: the journal records the NAME plus
+        JSON-able args, so :meth:`recover` can re-run the request in a
+        fresh process. Accepts every :meth:`submit` keyword
+        (tenant/priority/slo/tables/fault_plan)."""
+        fn = self._queries.get(str(name))
+        if fn is None:
+            raise InvalidArgument(
+                f"no query registered under {name!r}; "
+                f"register_query() it first (known: "
+                f"{sorted(self._queries)})")
+        return self.submit(fn, *args, idempotency_key=idempotency_key,
+                           _journal_name=str(name), **kwargs)
+
+    def _journal_admit(self, ticket: QueryTicket,
+                       name: "str | None", args, kwargs,
+                       key: "str | None", slo_raw, tables) -> None:
+        """No-op unless durable (see :class:`RequestJournal`).
+        ``slo_raw`` is the caller's pre-normalization slo argument, so
+        an explicit 0 ("unbounded") survives a replay as 0."""
+        if self._journal is None:
+            return
+        self._journal.admit(
+            rid=ticket.rid, key=key, name=name, args=args,
+            kwargs=kwargs, tenant=ticket.tenant,
+            priority=ticket.priority, slo=slo_raw,
+            tables=list(tables))
+
+    def _dispatch(self, op: "_QueryOp", ticket: QueryTicket) -> None:
+        """Hand one admitted (and, if durable, journaled) request to
+        the scheduler. The ONLY place ops enter the execution set —
+        the bench guard pins that statically, so no future submission
+        path can skip the write-ahead journal."""
         with self._cond:
             if self._closed:  # lost a race with close(): undo and refuse
-                for tid in pinned:
-                    catalog.unpin(tid, holder=holder)
-                self._admission.release()
+                self._undo_admission(op)
                 raise InvalidArgument("engine is closed")
             if self._policy.schedule == "priority":
                 self._exec.add_op(op, ticket.priority)
@@ -329,7 +482,6 @@ class ServeEngine:
                     daemon=True)
                 self._thread.start()
             self._cond.notify_all()
-        return ticket
 
     # ------------------------------------------------- scheduler loop
     def _loop(self) -> None:
@@ -360,10 +512,22 @@ class ServeEngine:
         if error is None:
             t.state, t.value = DONE, value
             telemetry.counter("serve.completed", tenant=t.tenant).inc()
+            self._admission.breaker.record_success()
         else:
             t.state, t.error = FAILED, error
             telemetry.counter("serve.errors", tenant=t.tenant,
                               kind=type(error).__name__).inc()
+            # feed the circuit breaker: a sustained storm of systemic
+            # failures (SLO expiries, resource exhaustion) trips it
+            # and new admissions shed while this in-flight set drains
+            self._admission.breaker.record_failure(type(error).__name__)
+        if self._journal is not None:
+            try:
+                self._journal.done(rid=t.rid,
+                                   key=getattr(op, "_idem_key", None),
+                                   state=t.state)
+            except OSError:  # pragma: no cover - journal best-effort
+                pass  # a full disk must not wedge retirement
         telemetry.timer("serve.request_seconds",
                         tenant=t.tenant).observe(wall)
         _trace.instant("serve.done" if error is None else "serve.error",
@@ -420,6 +584,91 @@ class ServeEngine:
         (:func:`cylon_tpu.plan.plan_cache_stats`)."""
         return plan.plan_cache_stats()
 
+    # -------------------------------------------------------- recovery
+    @classmethod
+    def recover(cls, durable_dir: str, env=None,
+                policy: "ServePolicy | None" = None,
+                queries: "dict | None" = None,
+                replay: bool = True) -> "ServeEngine":
+        """Rebuild a killed durable engine from ``durable_dir``.
+
+        1. **Mesh**: ``env=None`` starts a fresh resident
+           :class:`~cylon_tpu.context.CylonEnv` in this process (the
+           old one died with the old process).
+        2. **Resident tables**: every
+           :class:`~cylon_tpu.serve.durability.CatalogSnapshot` table
+           restores into the process catalog (and re-registers in the
+           new engine's snapshot, so the recovered engine is itself
+           recoverable).
+        3. **Requests**: journaled-but-incomplete NAMED requests re-run
+           via :meth:`submit_named` with their original idempotency
+           keys — exactly once (``serve.journal_replayed`` counts
+           them); incomplete requests the journal cannot name (bare
+           callables, non-JSON args) are reported, not silently lost.
+
+        ``queries`` maps names to query functions (the registry does
+        not survive the process — code is re-supplied, state is
+        restored). The report lands on ``engine.recovery_report``::
+
+            {"replayed": {key_or_rid: QueryTicket}, "restored_tables":
+             [...], "unreplayable": [journal entries]}
+
+        Counts one ``serve.recoveries``.
+        """
+        from cylon_tpu.serve.durability import RequestJournal
+
+        if env is None:
+            import cylon_tpu as ct
+
+            env = ct.CylonEnv(ct.TPUConfig())
+        engine = cls(env, policy, durable_dir=durable_dir)
+        for name, fn in (queries or {}).items():
+            engine.register_query(name, fn)
+        telemetry.counter("serve.recoveries").inc()
+        _trace.instant("serve.recover", cat="serve", dir=durable_dir)
+        restored = engine._snapshot.restore()
+        for tid, table in restored.items():
+            catalog.put_table(tid, table)
+        replayable, unreplayable = RequestJournal.incomplete(durable_dir)
+        tickets: dict = {}
+        if replay:
+            for e in list(replayable):
+                if e["name"] not in engine._queries:
+                    # journaled under a name this recovery cannot
+                    # resolve: report it lost, don't die mid-recovery
+                    unreplayable.append(e)
+                    continue
+                tickets[e.get("key") or e["rid"]] = engine.submit_named(
+                    e["name"], *e.get("args", ()),
+                    idempotency_key=e.get("key"),
+                    tenant=e.get("tenant", "default"),
+                    priority=e.get("priority", 1),
+                    slo=e.get("slo"), tables=e.get("tables", ()),
+                    **e.get("kwargs", {}))
+                # retire the ORIGINAL journal entry of a KEYLESS
+                # request: the replay's own admit line (just written,
+                # ahead of its dispatch) now carries it — without this
+                # the entry reads incomplete forever and re-executes on
+                # EVERY subsequent recovery. Keyed entries must NOT get
+                # this line (a done'd key would hide the replay if THIS
+                # process is killed mid-replay); their exactly-once
+                # comes from first-admit-per-key dedup instead.
+                if e.get("key") is None:
+                    engine._journal.done(rid=e["rid"], key=None,
+                                         state="replayed")
+                telemetry.counter("serve.journal_replayed",
+                                  tenant=e.get("tenant",
+                                               "default")).inc()
+        for e in unreplayable:
+            telemetry.counter("serve.journal_unreplayable",
+                              tenant=e.get("tenant", "default")).inc()
+        engine.recovery_report = {
+            "replayed": tickets,
+            "restored_tables": sorted(restored),
+            "unreplayable": unreplayable,
+        }
+        return engine
+
     # -------------------------------------------------------- lifecycle
     def close(self, wait: bool = True,
               timeout: "float | None" = None) -> None:
@@ -440,6 +689,8 @@ class ServeEngine:
             self._cond.notify_all()
         if self._thread is not None and self._thread.is_alive():
             self._thread.join(timeout)
+        if self._journal is not None:
+            self._journal.close()
 
     def __enter__(self) -> "ServeEngine":
         return self

@@ -47,8 +47,12 @@ def lineitem_chunks(data: Mapping, columns, chunk_rows: int
 
 
 def q1_ooc(data: Mapping, chunk_rows: int = 1 << 22,
-           cutoff: int | None = None) -> DataFrame:
-    """Q1, out-of-core: device never holds more than one chunk."""
+           cutoff: int | None = None,
+           resume_dir: str | None = None) -> DataFrame:
+    """Q1, out-of-core: device never holds more than one chunk.
+    ``resume_dir`` checkpoints every chunk's partial aggregate so a
+    killed SF100-class run resumes instead of restarting (ROADMAP
+    item 1; see ``docs/resilience.md`` "Checkpoint & recovery")."""
     from cylon_tpu.outofcore import ooc_groupby
 
     if cutoff is None:
@@ -68,9 +72,11 @@ def q1_ooc(data: Mapping, chunk_rows: int = 1 << 22,
         return t.add_column("charge", charge.column)
 
     # averages decompose: partial = sums + count, final avg =
-    # sum_of_sums / sum_of_counts
+    # sum_of_sums / sum_of_counts. The source is a zero-arg callable
+    # returning a FRESH generator: ooc passes require replayable
+    # sources (a resume or a retry re-iterates them from the top)
     out = ooc_groupby(
-        lineitem_chunks(data, need, chunk_rows),
+        lambda: lineitem_chunks(data, need, chunk_rows),
         ["l_returnflag", "l_linestatus"],
         [("l_quantity", "sum", "sum_qty"),
          ("l_extendedprice", "sum", "sum_base_price"),
@@ -78,7 +84,8 @@ def q1_ooc(data: Mapping, chunk_rows: int = 1 << 22,
          ("charge", "sum", "sum_charge"),
          ("l_discount", "sum", "sum_disc"),
          ("l_quantity", "count", "count_order")],
-        chunk_rows=chunk_rows, transform=transform)
+        chunk_rows=chunk_rows, transform=transform,
+        resume_dir=resume_dir)
     g = DataFrame._wrap(out)
     cnt = g.series("count_order")
     for num, name in (("sum_qty", "avg_qty"),
@@ -94,8 +101,10 @@ def q1_ooc(data: Mapping, chunk_rows: int = 1 << 22,
 
 def q5_ooc(data: Mapping, chunk_rows: int = 1 << 22,
            region: str = "ASIA", date_from: int | None = None,
-           date_to: int | None = None) -> DataFrame:
-    """Q5, out-of-core: build sides in-core, lineitem streamed."""
+           date_to: int | None = None,
+           resume_dir: str | None = None) -> DataFrame:
+    """Q5, out-of-core: build sides in-core, lineitem streamed.
+    ``resume_dir``: per-chunk checkpoint/resume like :func:`q1_ooc`."""
     if date_from is None:
         date_from = date_int(1994, 1, 1)
     if date_to is None:
@@ -148,8 +157,9 @@ def q5_ooc(data: Mapping, chunk_rows: int = 1 << 22,
 
     from cylon_tpu.outofcore import ooc_groupby
 
-    out = ooc_groupby(lineitem_chunks(data, need, chunk_rows),
+    out = ooc_groupby(lambda: lineitem_chunks(data, need, chunk_rows),
                       ["n_name"], [("revenue", "sum", "revenue")],
-                      chunk_rows=chunk_rows, transform=transform)
+                      chunk_rows=chunk_rows, transform=transform,
+                      resume_dir=resume_dir)
     g = DataFrame._wrap(out).sort_values(["revenue"], ascending=[False])
     return g[["n_name", "revenue"]]

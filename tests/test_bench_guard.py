@@ -374,6 +374,91 @@ def test_serve_request_section_registered_not_retryable():
     assert "serve_request" in DEADLINE_SECTIONS
 
 
+def test_serve_record_schema_pins_robustness_columns():
+    """ISSUE 8 satellite: the shed/journal/recovery counters are part
+    of the pinned serve-record schema — a chaos run's load sheds and
+    journal replays ride the serving trajectory, and a refactor cannot
+    silently drop them."""
+    from cylon_tpu.serve.bench import REQUIRED_SERVE_FIELDS
+
+    assert {"shed", "journal_replayed",
+            "recoveries"} <= REQUIRED_SERVE_FIELDS
+
+
+# ----------------------------------------- checkpoint/journal guards
+def test_every_ooc_entrypoint_accepts_resume_dir():
+    """ISSUE 8 satellite: every public out-of-core entrypoint must
+    accept ``resume_dir`` — a new OOC pass shipped without the
+    checkpoint hook would silently re-create the non-resumable class
+    of multi-hour run this PR exists to kill."""
+    path = REPO / "cylon_tpu" / "outofcore.py"
+    tree = ast.parse(path.read_text(), filename=str(path))
+    ops = [n for n in ast.iter_child_nodes(tree)
+           if isinstance(n, _FN) and n.name.startswith("ooc_")]
+    assert len(ops) >= 3, "OOC entrypoint surface unexpectedly small"
+    bare = []
+    for fn in ops:
+        names = {a.arg for a in (fn.args.posonlyargs + fn.args.args
+                                 + fn.args.kwonlyargs)}
+        if "resume_dir" not in names:
+            bare.append(fn.name)
+    assert not bare, (
+        f"OOC entrypoints without resume_dir: {bare} — thread them "
+        "through resilience.CheckpointedRun like the others")
+
+
+def _serve_engine_methods():
+    path = REPO / "cylon_tpu" / "serve" / "service.py"
+    tree = ast.parse(path.read_text(), filename=str(path))
+    cls = next(n for n in ast.iter_child_nodes(tree)
+               if isinstance(n, ast.ClassDef)
+               and n.name == "ServeEngine")
+    return [n for n in ast.iter_child_nodes(cls) if isinstance(n, _FN)]
+
+
+def _method_calls(fn: "ast.FunctionDef", attr: str) -> list:
+    """Line numbers of every ``<x>.<attr>(...)`` call inside ``fn``."""
+    out = []
+    for node in ast.walk(fn):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == attr):
+            out.append(node.lineno)
+    return out
+
+
+def test_write_ahead_invariant_journal_before_dispatch():
+    """ISSUE 8 satellite, enforced statically: the ONLY place ops
+    enter the scheduler's execution set is ``_dispatch``, and every
+    submission path that reaches ``_dispatch`` must write the
+    write-ahead journal (``_journal_admit``) FIRST — a future
+    submission path that skips the journal would make its requests
+    unrecoverable, invisibly."""
+    methods = _serve_engine_methods()
+    dispatchers = [m.name for m in methods
+                   if _method_calls(m, "add_op")]
+    assert dispatchers == ["_dispatch"], (
+        f"ops enter the scheduler outside _dispatch: {dispatchers}")
+    submitters = [m for m in methods if _method_calls(m, "_dispatch")]
+    assert submitters, "no submission path reaches _dispatch"
+    for m in submitters:
+        journal_lines = _method_calls(m, "_journal_admit")
+        assert journal_lines, (
+            f"ServeEngine.{m.name} dispatches without journaling — "
+            "the write-ahead invariant is broken")
+        assert min(journal_lines) < min(_method_calls(m, "_dispatch")), (
+            f"ServeEngine.{m.name} journals AFTER dispatch — a kill "
+            "in between loses an already-running request")
+
+
+def test_durable_mutations_maintain_catalog_snapshot():
+    """register_table/drop_table on a durable engine must keep the
+    snapshot in sync (the tables recover() restores)."""
+    methods = {m.name: m for m in _serve_engine_methods()}
+    assert _method_calls(methods["register_table"], "save")
+    assert _method_calls(methods["drop_table"], "drop")
+
+
 def test_checker_accepts_closures_and_comprehensions(tmp_path):
     p = tmp_path / "ok.py"
     p.write_text(

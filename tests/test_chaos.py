@@ -1,0 +1,201 @@
+"""Kill-level chaos tests: ``os._exit`` mid-pass, resume, byte-compare.
+
+The ISSUE-8 acceptance bar, at test scale: a seeded
+``FaultRule.kill`` HARD-KILLS a child process (no exception handling,
+no atexit — status ``KILL_EXIT_CODE``) inside each out-of-core op, at
+>= 2 distinct seeded kill points per op; re-invoking with the same
+arguments and ``resume_dir`` yields output byte-identical to a
+fault-free run. The oracle runs IN-PROCESS through exec() of the same
+driver source the child executes, so the two code paths cannot drift.
+
+The second kill point per op is marked ``slow`` (each test costs two
+fresh-interpreter jax imports), keeping tier-1 at one kill point per
+op plus the machinery checks.
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from cylon_tpu.resilience import KILL_EXIT_CODE
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+#: shared op driver: the parent exec()s it for the oracle, the child
+#: script embeds it verbatim — identical inputs, chunking and sink
+#: byte-ification in both processes
+DRIVER = '''
+import numpy as np
+
+
+def run(op, resume_dir, out_path):
+    from cylon_tpu.outofcore import ooc_groupby, ooc_join, ooc_sort
+
+    rng = np.random.default_rng(7)
+    n, chunk = 6000, 900
+    frames = []
+    sink = frames.append
+    if op == "sort":
+        src = {"k": rng.integers(0, 300, n).astype(np.int64),
+               "v": rng.normal(size=n)}
+        total = ooc_sort(src, ["k", "v"], n_partitions=4,
+                         chunk_rows=chunk, sink=sink,
+                         resume_dir=resume_dir)
+    elif op == "join":
+        left = {"k": rng.integers(0, n, n).astype(np.int64),
+                "a": rng.normal(size=n)}
+        right = {"k": rng.integers(0, n, n).astype(np.int64),
+                 "b": rng.normal(size=n)}
+        total = ooc_join(left, right, on="k", n_partitions=4,
+                         chunk_rows=chunk, sink=sink,
+                         resume_dir=resume_dir)
+    elif op == "groupby":
+        src = {"g": rng.integers(0, 40, n).astype(np.int64),
+               "v": rng.normal(size=n)}
+        out = ooc_groupby(src, ["g"],
+                          [("v", "sum", "s"), ("v", "count", "c")],
+                          chunk_rows=chunk, resume_dir=resume_dir)
+        pdf = out.to_pandas().sort_values("g").reset_index(drop=True)
+        frames.append(pdf)
+        total = len(pdf)
+    else:
+        raise ValueError(op)
+    text = "".join(f.to_csv(index=False, float_format="%.17g")
+                   for f in frames)
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(text)
+    return total, text
+'''
+
+CHILD = DRIVER + '''
+
+if __name__ == "__main__":
+    import os
+    import sys
+
+    import cylon_tpu  # noqa: F401  (x64, matching the test process)
+    from cylon_tpu import resilience, telemetry
+
+    op, rdir, out_path = sys.argv[1:4]
+    kill = os.environ.get("CHAOS_KILL")
+    if kill:
+        point, nth = kill.rsplit(":", 1)
+        resilience.install(resilience.FaultPlan(
+            [resilience.FaultRule.kill(point, nth=int(nth))]))
+    total, _ = run(op, rdir or None, out_path or None)
+    print(f"TOTAL={total}")
+    print(f"RESUMED={telemetry.total('ooc.units_resumed')}")
+'''
+
+
+def _oracle(op):
+    ns: dict = {}
+    exec(DRIVER, ns)
+    return ns["run"](op, None, None)
+
+
+def _child_env(**extra):
+    """Child env: repo on PYTHONPATH (the scripts live in tmp), CPU
+    backend to match the test process."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH",
+                                                         "")
+    env.pop("CHAOS_KILL", None)
+    env.update(extra)
+    return env
+
+
+def _run_child(tmp_path, op, rdir, out, kill=None, timeout=240):
+    script = tmp_path / "chaos_child.py"
+    script.write_text(CHILD)
+    env = _child_env(**({"CHAOS_KILL": kill} if kill else {}))
+    return subprocess.run(
+        [sys.executable, str(script), op, rdir or "", out or ""],
+        env=env, cwd=str(REPO), capture_output=True, text=True,
+        timeout=timeout)
+
+
+def _kill_resume_scenario(tmp_path, op, kill):
+    """Kill a child at the seeded point; resume in a fresh child;
+    assert byte-identical output vs the in-process oracle."""
+    total, want = _oracle(op)
+    rdir = tmp_path / "ckpt"
+    out = tmp_path / "out.csv"
+
+    p1 = _run_child(tmp_path, op, str(rdir), str(out), kill=kill)
+    assert p1.returncode == KILL_EXIT_CODE, (
+        f"kill child survived or died differently: rc={p1.returncode}\n"
+        f"{p1.stderr[-2000:]}")
+    assert "injected HARD KILL" in p1.stderr
+    # partial progress is durable and the manifest is valid JSON even
+    # though the process died without any cleanup
+    manifest = json.loads((rdir / "manifest.json").read_text())
+    assert 0 < len(manifest["completed"]) < 8
+    assert not out.exists() or out.read_text() != want  # mid-pass kill
+
+    p2 = _run_child(tmp_path, op, str(rdir), str(out))
+    assert p2.returncode == 0, p2.stderr[-2000:]
+    assert f"TOTAL={total}" in p2.stdout
+    resumed = int(p2.stdout.split("RESUMED=")[1].split()[0])
+    assert resumed >= 1, "resume recomputed everything from scratch"
+    assert out.read_text() == want  # byte-identical to fault-free
+
+
+# one kill point per op stays in tier-1 — the acceptance proof
+@pytest.mark.parametrize("op,kill", [
+    ("sort", "spill_write:2"),
+    ("join", "spill_write:3"),
+    ("groupby", "spill_write:2"),
+])
+def test_hard_kill_and_resume_byte_identical(tmp_path, op, kill):
+    _kill_resume_scenario(tmp_path, op, kill)
+
+
+# the second seeded kill point per op (different progress depth, and
+# for groupby a different POINT — the chunk source, not the spill
+# write) is slow-marked: same proof, heavier budget
+@pytest.mark.slow
+@pytest.mark.parametrize("op,kill", [
+    ("sort", "spill_write:4"),
+    ("join", "spill_write:2"),
+    ("groupby", "chunk_source:4"),
+])
+def test_hard_kill_and_resume_second_point(tmp_path, op, kill):
+    _kill_resume_scenario(tmp_path, op, kill)
+
+
+def test_fault_rule_kill_constructor_and_validation():
+    from cylon_tpu.errors import InvalidArgument
+    from cylon_tpu.resilience import FaultPlan, FaultRule
+
+    r = FaultRule.kill("spill_write", nth=3)
+    assert r.exit_code == KILL_EXIT_CODE and r.nth == 3
+    FaultPlan([r])  # registers cleanly
+    with pytest.raises(InvalidArgument, match="exit_code"):
+        FaultPlan([FaultRule("exchange", exit_code=4096)])
+
+
+def test_fault_rule_kill_fires_via_os_exit(tmp_path):
+    """The kill really is os._exit at the fault point: no cleanup runs
+    (the atexit sentinel is never written), status is KILL_EXIT_CODE."""
+    script = tmp_path / "killer.py"
+    script.write_text(
+        "import atexit, sys\n"
+        "import cylon_tpu  # noqa: F401\n"
+        "from cylon_tpu import resilience\n"
+        "atexit.register(lambda: open("
+        f"{str(tmp_path / 'atexit.ran')!r}, 'w').close())\n"
+        "resilience.install(resilience.FaultPlan("
+        "[resilience.FaultRule.kill('io_read')]))\n"
+        "resilience.inject('io_read', 'probe')\n"
+        "sys.exit(0)\n")
+    p = subprocess.run([sys.executable, str(script)],
+                       env=_child_env(), cwd=str(REPO),
+                       capture_output=True, text=True, timeout=240)
+    assert p.returncode == KILL_EXIT_CODE, p.stderr[-2000:]
+    assert not (tmp_path / "atexit.ran").exists()

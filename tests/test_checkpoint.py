@@ -1,0 +1,300 @@
+"""CheckpointedRun + resume for ooc_join/ooc_groupby + atomicity audit.
+
+The in-process half of the ISSUE-8 tentpole: the generic checkpoint
+layer factored out of ooc_sort works identically for the other two
+long passes (fault-kill → resume → identical output, fingerprint
+guards, source-change detection), one-shot iterators are rejected by
+every OOC entrypoint, and the crash-window contract holds — a
+truncated half-written manifest is discarded cleanly, never raised on.
+(The ``os._exit`` kill-level versions live in tests/test_chaos.py.)
+"""
+
+import json
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from cylon_tpu import resilience, telemetry
+from cylon_tpu.errors import (DataLossError, InvalidArgument,
+                              TransientError)
+from cylon_tpu.outofcore import ooc_groupby, ooc_join, ooc_sort
+from cylon_tpu.resilience import (CheckpointedRun, FaultPlan, FaultRule,
+                                  atomic_write_json)
+
+
+@pytest.fixture(autouse=True)
+def _no_plan_leaks():
+    yield
+    resilience.install(None)
+
+
+# ------------------------------------------------- CheckpointedRun unit
+def test_checkpointed_run_roundtrip_meta_and_fingerprint(tmp_path):
+    ck = CheckpointedRun(str(tmp_path / "c"), "join",
+                         (("k",), "inner", 4))
+    ck.complete(0, {"x": np.arange(5)}, 5, meta={"ln": 9, "rn": 7})
+    ck.complete(1, {}, 0, meta={"ln": 0, "rn": 0})
+    assert ck.completed == {0: 5, 1: 0}
+    assert ck.unit_meta(0) == {"ln": 9, "rn": 7}
+    ck.verify_meta(0, "t", ln=9, rn=7)  # matches: no raise
+    with pytest.raises(DataLossError, match="source changed"):
+        ck.verify_meta(0, "t", ln=9, rn=8)
+    # same plan resumes; resumed units count ooc.units_resumed{op=}
+    telemetry.reset("ooc.units_resumed")
+    again = CheckpointedRun(str(tmp_path / "c"), "join",
+                            (("k",), "inner", 4))
+    np.testing.assert_array_equal(again.resume_unit(0)["x"],
+                                  np.arange(5))
+    assert again.resume_unit(1) == {}
+    assert telemetry.counter("ooc.units_resumed",
+                             op="join").value == 2
+    # a different op or plan discards: fingerprints must not collide
+    other = CheckpointedRun(str(tmp_path / "c"), "sort",
+                            (("k",), "inner", 4))
+    assert other.completed == {}
+
+
+def test_truncated_manifest_discarded_cleanly(tmp_path):
+    """Crash-window audit: a manifest half-written by a dying process
+    (torn JSON) is discarded on open — resume starts fresh instead of
+    raising."""
+    root = tmp_path / "c"
+    ck = CheckpointedRun(str(root), "sort", ("k",))
+    ck.complete(0, {"x": np.arange(3)}, 3)
+    mpath = root / "manifest.json"
+    text = mpath.read_text()
+    mpath.write_text(text[:len(text) // 2])  # torn mid-document
+    fresh = CheckpointedRun(str(root), "sort", ("k",))
+    assert fresh.completed == {}  # discarded, no exception
+    # and the discarded state does not resurrect stale buckets
+    assert not (root / "bucket00000.npz").exists()
+
+
+def test_atomic_write_json_never_leaves_torn_target(tmp_path):
+    p = str(tmp_path / "doc.json")
+    atomic_write_json(p, {"gen": 1})
+    atomic_write_json(p, {"gen": 2})
+    assert json.load(open(p)) == {"gen": 2}
+    # a failed write (unserializable) leaves the previous doc intact
+    # and cleans its tmp
+    with pytest.raises(TypeError):
+        atomic_write_json(p, {"bad": object()})
+    assert json.load(open(p)) == {"gen": 2}
+    assert [f for f in os.listdir(tmp_path) if ".tmp" in f] == []
+
+
+def test_spill_store_fsyncs_before_rename():
+    """The atomicity audit, statically: every manifest write routes
+    through atomic_write_json (fsync before os.replace), and the
+    bucket writer fsyncs its data file before renaming it in."""
+    import inspect
+
+    src = inspect.getsource(resilience.SpillStore._write_manifest)
+    assert "atomic_write_json" in src
+    wsrc = inspect.getsource(resilience.SpillStore.write_bucket)
+    assert "os.fsync" in wsrc
+    assert wsrc.index("os.fsync") < wsrc.rindex("os.replace(tmp")
+    asrc = inspect.getsource(atomic_write_json)
+    assert asrc.index("os.fsync") < asrc.rindex("os.replace(tmp")
+
+
+# ------------------------------------------- one-shot source parity fix
+def _gen_chunks(data, step=500):
+    n = len(next(iter(data.values())))
+    return ({k: v[lo:lo + step] for k, v in data.items()}
+            for lo in range(0, n, step))
+
+
+def test_ooc_join_rejects_one_shot_iterators(rng):
+    n = 1000
+    left = {"k": rng.integers(0, 50, n).astype(np.int64),
+            "a": rng.normal(size=n)}
+    right = {"k": rng.integers(0, 50, n).astype(np.int64),
+             "b": rng.normal(size=n)}
+    with pytest.raises(InvalidArgument, match="one-shot iterator"):
+        ooc_join(_gen_chunks(left), right, on="k", n_partitions=2)
+    with pytest.raises(InvalidArgument, match="one-shot iterator"):
+        ooc_join(left, _gen_chunks(right), on="k", n_partitions=2)
+    with pytest.raises(InvalidArgument, match="ooc_join source"):
+        ooc_join(object(), right, on="k", n_partitions=2)
+    # a LIST of chunks and a callable stay accepted
+    total = ooc_join(list(_gen_chunks(left)),
+                     lambda: _gen_chunks(right), on="k",
+                     n_partitions=2, chunk_rows=256)
+    want = pd.DataFrame(left).merge(pd.DataFrame(right), on="k")
+    assert total == len(want)
+
+
+def test_ooc_groupby_rejects_one_shot_iterators(rng):
+    n = 1000
+    src = {"g": rng.integers(0, 9, n).astype(np.int64),
+           "v": rng.normal(size=n)}
+    with pytest.raises(InvalidArgument, match="one-shot iterator"):
+        ooc_groupby(_gen_chunks(src), ["g"], [("v", "sum", "s")])
+    with pytest.raises(InvalidArgument, match="ooc_groupby source"):
+        ooc_groupby(42, ["g"], [("v", "sum", "s")])
+    out = ooc_groupby(lambda: _gen_chunks(src), ["g"],
+                      [("v", "sum", "s")], chunk_rows=256)
+    got = out.to_pandas().sort_values("g").reset_index(drop=True)
+    want = (pd.DataFrame(src).groupby("g").agg(s=("v", "sum"))
+            .reset_index())
+    pd.testing.assert_frame_equal(got, want, check_dtype=False,
+                                  check_exact=False, rtol=1e-9)
+
+
+# --------------------------------------------- ooc_join resume semantics
+def test_ooc_join_fault_kill_and_resume_identical(tmp_path, rng):
+    """The ooc_sort acceptance scenario, generalized to ooc_join: a
+    seeded fault exhausts the retry budget mid-pass; the rerun with
+    the same resume_dir replays completed partitions and produces
+    output identical to the fault-free oracle."""
+    n = 4000
+    left = {"k": rng.integers(0, 400, n).astype(np.int64),
+            "a": rng.normal(size=n)}
+    right = {"k": rng.integers(0, 400, n).astype(np.int64),
+             "b": rng.normal(size=n)}
+    kw = dict(on="k", how="inner", n_partitions=4, chunk_rows=700)
+
+    want_parts: list = []
+    want_total = ooc_join(left, right, sink=want_parts.append, **kw)
+    want = pd.concat(want_parts, ignore_index=True)
+
+    rdir = str(tmp_path / "resume")
+    plan = FaultPlan([FaultRule("spill_write", nth=3, times=0)])
+    got_parts: list = []
+    with resilience.active(plan):
+        with pytest.raises(TransientError):
+            ooc_join(left, right, sink=got_parts.append,
+                     resume_dir=rdir, **kw)
+    manifest = json.loads(
+        (tmp_path / "resume" / "manifest.json").read_text())
+    assert 0 < len(manifest["completed"]) < 4  # durable partial
+
+    telemetry.reset("ooc.units_resumed")
+    got_parts = []
+    total = ooc_join(left, right, sink=got_parts.append,
+                     resume_dir=rdir, **kw)
+    assert total == want_total
+    got = pd.concat(got_parts, ignore_index=True)
+    pd.testing.assert_frame_equal(got, want)
+    assert telemetry.counter("ooc.units_resumed",
+                             op="join").value >= 1
+
+
+def test_ooc_join_resume_detects_changed_source(tmp_path, rng):
+    n = 2000
+    left = {"k": rng.integers(0, 100, n).astype(np.int64),
+            "a": rng.normal(size=n)}
+    right = {"k": rng.integers(0, 100, n).astype(np.int64),
+             "b": rng.normal(size=n)}
+    rdir = str(tmp_path / "r")
+    kw = dict(on="k", n_partitions=3, chunk_rows=600)
+    ooc_join(left, right, resume_dir=rdir, **kw)
+    grown = {k: np.concatenate([v, v[:100]]) for k, v in left.items()}
+    with pytest.raises(DataLossError, match="source changed"):
+        ooc_join(grown, right, resume_dir=rdir, **kw)
+
+
+# ------------------------------------------ ooc_groupby resume semantics
+def test_ooc_groupby_fault_kill_and_resume_identical(tmp_path, rng):
+    """Chunk-granular resume: a fault kills the pass mid-chunk-stream;
+    the rerun replays completed partials (no recompute — proven by a
+    spill_write poison pill) and the final combine matches the
+    fault-free oracle exactly."""
+    n = 3000
+    src = {"g": rng.integers(0, 23, n).astype(np.int64),
+           "v": rng.normal(size=n)}
+    kw = dict(chunk_rows=500)
+    aggs = [("v", "sum", "s"), ("v", "count", "c"),
+            ("v", "min", "mn")]
+    want = ooc_groupby(src, ["g"], aggs, **kw).to_pandas() \
+        .sort_values("g").reset_index(drop=True)
+
+    rdir = str(tmp_path / "r")
+    plan = FaultPlan([FaultRule("chunk_source", nth=4, times=0)])
+    with resilience.active(plan):
+        with pytest.raises(TransientError):
+            ooc_groupby(src, ["g"], aggs, resume_dir=rdir, **kw)
+    manifest = json.loads((tmp_path / "r" / "manifest.json").read_text())
+    done_before = len(manifest["completed"])
+    assert 0 < done_before < 6  # 6 chunks total, killed at #4
+
+    telemetry.reset("ooc.units_resumed")
+    got = ooc_groupby(src, ["g"], aggs, resume_dir=rdir, **kw) \
+        .to_pandas().sort_values("g").reset_index(drop=True)
+    pd.testing.assert_frame_equal(got, want)
+    assert telemetry.counter("ooc.units_resumed",
+                             op="groupby").value == done_before
+
+    # a THIRD run over the now-complete manifest replays everything:
+    # poison spill_write to prove no chunk is recomputed/re-spilled
+    poison = FaultPlan([FaultRule("spill_write", nth=1, times=0)])
+    with resilience.active(poison):
+        again = ooc_groupby(src, ["g"], aggs, resume_dir=rdir, **kw) \
+            .to_pandas().sort_values("g").reset_index(drop=True)
+    assert poison.hits("spill_write") == 0
+    pd.testing.assert_frame_equal(again, want)
+
+
+def test_ooc_groupby_resume_fingerprint_covers_transform(tmp_path, rng):
+    """Two passes differing only in their transform must not share
+    partials: the fingerprint includes the transform identity, so the
+    second pass discards and recomputes."""
+    from cylon_tpu.table import Table
+
+    n = 1200
+    src = {"g": rng.integers(0, 7, n).astype(np.int64),
+           "v": np.ones(n)}
+    rdir = str(tmp_path / "r")
+
+    def doubled(chunk):
+        return Table.from_pydict({"g": chunk["g"],
+                                  "v": chunk["v"] * 2.0})
+
+    plain = ooc_groupby(src, ["g"], [("v", "sum", "s")],
+                        chunk_rows=400, resume_dir=rdir)
+    p = plain.to_pandas().sort_values("g").reset_index(drop=True)
+    twice = ooc_groupby(src, ["g"], [("v", "sum", "s")],
+                        chunk_rows=400, resume_dir=rdir,
+                        transform=doubled)
+    t = twice.to_pandas().sort_values("g").reset_index(drop=True)
+    np.testing.assert_allclose(t["s"].to_numpy(),
+                               2.0 * p["s"].to_numpy())
+
+
+def test_ooc_sort_units_resumed_labelled_op_sort(tmp_path, rng):
+    """Satellite: the old ooc.buckets_resumed counter is now
+    ooc.units_resumed{op=sort} — one labeled family across ops."""
+    n = 1500
+    src = {"k": rng.integers(0, 60, n).astype(np.int64)}
+    rdir = str(tmp_path / "r")
+    assert ooc_sort(src, "k", n_partitions=3, chunk_rows=400,
+                    resume_dir=rdir) == n
+    telemetry.reset("ooc.units_resumed")
+    assert ooc_sort(src, "k", n_partitions=3, chunk_rows=400,
+                    resume_dir=rdir) == n
+    assert telemetry.counter("ooc.units_resumed", op="sort").value == 3
+    assert telemetry.total("ooc.units_resumed") == 3
+
+
+def test_streaming_q1_ooc_resumes(tmp_path):
+    """The TPC-H streaming entrypoints thread resume_dir through (the
+    ROADMAP item-1 lifeline): a killed q1_ooc resumes to the exact
+    in-core oracle result."""
+    from cylon_tpu import tpch
+    from cylon_tpu.tpch.streaming import q1_ooc
+
+    data = tpch.generate(0.002, 5)
+    want = tpch.q1(data).to_pandas().reset_index(drop=True)
+    rdir = str(tmp_path / "q1")
+    plan = FaultPlan([FaultRule("chunk_source", nth=3, times=0)])
+    with resilience.active(plan):
+        with pytest.raises(TransientError):
+            q1_ooc(data, chunk_rows=3000, resume_dir=rdir)
+    got = q1_ooc(data, chunk_rows=3000, resume_dir=rdir) \
+        .to_pandas().reset_index(drop=True)
+    pd.testing.assert_frame_equal(got[want.columns], want,
+                                  check_dtype=False,
+                                  check_exact=False, rtol=1e-9)

@@ -1,0 +1,431 @@
+"""Serve-engine durability: journal, idempotency, recover(), breaker.
+
+The crash-safe-serve half of ISSUE 8: a durable engine write-ahead
+journals every admitted request (fsync before dispatch), snapshots
+resident tables, dedups client retries by idempotency key, and —
+after a hard kill, proven by a subprocess — ``ServeEngine.recover``
+restarts the mesh, restores the tables and re-runs exactly the
+journaled-but-incomplete requests, exactly once, with oracle-exact
+results. The circuit breaker sheds new admissions under a sustained
+DeadlineExceeded storm while in-flight work drains.
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from cylon_tpu import catalog, telemetry
+from cylon_tpu.errors import (DeadlineExceeded, InvalidArgument,
+                              ResourceExhausted)
+from cylon_tpu.resilience import KILL_EXIT_CODE
+from cylon_tpu.serve import ServeEngine, ServePolicy
+from cylon_tpu.serve.durability import RequestJournal
+from cylon_tpu.table import Table
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    catalog.clear()
+    telemetry.reset("serve.")
+    yield
+    catalog.clear()
+    telemetry.reset("serve.")
+
+
+def _t(n=32):
+    return Table.from_pydict({"k": np.arange(n, dtype=np.int64),
+                              "v": np.arange(n, dtype=np.float64)})
+
+
+def _vsum(scale=1.0):
+    tab = catalog.get_table("resident")
+    return float(np.asarray(
+        tab.column("v").data)[:tab.num_rows].sum()) * scale
+
+
+# --------------------------------------------------- journal semantics
+def test_journal_is_write_ahead_of_execution(tmp_path):
+    """The admit line is durable BEFORE the query function ever runs —
+    read from disk inside the first step."""
+    eng = ServeEngine(policy=ServePolicy(max_queue=4),
+                      durable_dir=str(tmp_path))
+    eng.register_query("probe", lambda: [
+        e for e in RequestJournal.read(str(tmp_path))
+        if e["kind"] == "admit"])
+    seen = eng.submit_named("probe", idempotency_key="k1",
+                            tenant="a").result(10)
+    assert len(seen) == 1
+    assert seen[0]["key"] == "k1" and seen[0]["name"] == "probe"
+    assert seen[0]["replayable"] is True
+    eng.close()
+    kinds = [e["kind"] for e in RequestJournal.read(str(tmp_path))]
+    assert kinds == ["admit", "done"]
+
+
+def test_journal_incomplete_and_done_dedup(tmp_path):
+    j = RequestJournal(str(tmp_path))
+    j.admit(rid=1, key="a", name="q", args=[1], tenant="t")
+    j.admit(rid=2, key="b", name="q", args=[2], tenant="t")
+    j.admit(rid=3, key=None, name=None, tenant="t")  # bare callable
+    j.done(rid=1, key="a", state="done")
+    j.close()
+    replayable, unreplayable = RequestJournal.incomplete(str(tmp_path))
+    assert [e["key"] for e in replayable] == ["b"]
+    assert len(unreplayable) == 1 and unreplayable[0]["rid"] == 3
+
+
+def test_torn_journal_tail_is_skipped(tmp_path):
+    """A kill mid-append leaves a torn final line; replay skips it
+    cleanly instead of raising (the crash-window contract)."""
+    j = RequestJournal(str(tmp_path))
+    j.admit(rid=1, key="a", name="q", tenant="t")
+    j.close()
+    with open(os.path.join(str(tmp_path), RequestJournal.FILE),
+              "a") as f:
+        f.write('{"kind": "admit", "rid": 2, "key": "b", "na')  # torn
+    entries = RequestJournal.read(str(tmp_path))
+    assert [e["rid"] for e in entries] == [1]
+    replayable, _ = RequestJournal.incomplete(str(tmp_path))
+    assert [e["key"] for e in replayable] == ["a"]
+
+
+def test_failed_request_is_journaled_done_not_replayed(tmp_path):
+    """A request that FAILED (client saw the error) must not replay on
+    recovery — only admitted-with-no-outcome requests do."""
+    eng = ServeEngine(policy=ServePolicy(max_queue=4),
+                      durable_dir=str(tmp_path))
+
+    def boom():
+        raise InvalidArgument("query bug")
+
+    eng.register_query("boom", boom)
+    tk = eng.submit_named("boom", idempotency_key="f1", tenant="a")
+    with pytest.raises(InvalidArgument):
+        tk.result(10)
+    eng.close()
+    replayable, unreplayable = RequestJournal.incomplete(str(tmp_path))
+    assert replayable == [] and unreplayable == []
+
+
+# ------------------------------------------------------- idempotency
+def test_idempotency_key_dedups_live_and_completed(tmp_path):
+    calls = []
+    eng = ServeEngine(policy=ServePolicy(max_queue=4),
+                      durable_dir=str(tmp_path))
+    eng.register_query("q", lambda x: calls.append(x) or x * 2)
+    t1 = eng.submit_named("q", 21, idempotency_key="once", tenant="a")
+    assert t1.result(10) == 42
+    # a client retry with the same key returns the SAME ticket — the
+    # query does not run again, even after completion
+    t2 = eng.submit_named("q", 21, idempotency_key="once", tenant="a")
+    assert t2 is t1 and t2.result(10) == 42
+    assert calls == [21]
+    assert telemetry.counter("serve.idempotent_hits",
+                             tenant="a").value == 1
+    # a different key executes fresh
+    assert eng.submit_named("q", 1, idempotency_key="twice",
+                            tenant="a").result(10) == 2
+    assert calls == [21, 1]
+    eng.close()
+
+
+def test_submit_named_requires_registration():
+    eng = ServeEngine(policy=ServePolicy(max_queue=4))
+    with pytest.raises(InvalidArgument, match="register_query"):
+        eng.submit_named("ghost")
+    eng.close()
+
+
+# ------------------------------------------------- kill -> recover()
+SERVE_CHILD = '''
+import sys
+import threading
+
+import numpy as np
+
+import cylon_tpu  # noqa: F401
+from cylon_tpu import catalog, resilience
+from cylon_tpu.serve import ServeEngine, ServePolicy
+from cylon_tpu.table import Table
+
+durable = sys.argv[1]
+eng = ServeEngine(policy=ServePolicy(max_queue=8), durable_dir=durable)
+eng.register_table("resident", Table.from_pydict(
+    {"k": np.arange(32, dtype=np.int64),
+     "v": np.arange(32, dtype=np.float64)}))
+
+
+def qsum(scale):
+    tab = catalog.get_table("resident")
+    return float(np.asarray(
+        tab.column("v").data)[:tab.num_rows].sum()) * scale
+
+
+#: the killing request idles (scheduler thread) until the main thread
+#: has admitted request 3 too — so the kill provably lands with BOTH
+#: incomplete requests already journaled
+admitted_all = threading.Event()
+
+
+def qkill(scale):
+    admitted_all.wait(30)
+    resilience.inject("worker", "kill step")
+    return qsum(scale)
+
+
+eng.register_query("qsum", qsum)
+eng.register_query("qkill", qkill)
+# request 1 completes cleanly (journaled admit + done)
+t1 = eng.submit_named("qsum", 1.0, idempotency_key="req-1", tenant="a")
+assert t1.result(60) == float(np.arange(32).sum())
+# request 2 carries a seeded kill plan; request 3 is admitted behind
+# it and never gets to run — both are journaled, neither completes
+plan = resilience.FaultPlan([resilience.FaultRule.kill("worker")])
+t2 = eng.submit_named("qkill", 2.0, idempotency_key="req-2",
+                      tenant="a", fault_plan=plan)
+t3 = eng.submit_named("qsum", 3.0, idempotency_key="req-3", tenant="b")
+admitted_all.set()
+t2.result(60)
+raise SystemExit("unreachable: the kill never fired")
+'''
+
+
+def test_serve_kill_then_recover_replays_exactly_once(tmp_path):
+    """The serve acceptance scenario: hard-kill a durable engine
+    mid-request (subprocess), then recover() in THIS process — mesh
+    restarted, resident table restored, the two incomplete journaled
+    requests replayed exactly once each (idempotency-key dedup), with
+    oracle-exact results; the completed request is NOT re-run."""
+    durable = tmp_path / "dur"
+    script = tmp_path / "serve_child.py"
+    script.write_text(SERVE_CHILD)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH",
+                                                         "")
+    p = subprocess.run([sys.executable, str(script), str(durable)],
+                       env=env, cwd=str(REPO), capture_output=True,
+                       text=True, timeout=240)
+    assert p.returncode == KILL_EXIT_CODE, p.stderr[-2000:]
+    # journal state after the kill: 3 admits, exactly 1 done
+    kinds = [e["kind"] for e in RequestJournal.read(str(durable))]
+    assert kinds.count("admit") == 3 and kinds.count("done") == 1
+
+    calls = []
+
+    def qsum(scale):
+        calls.append(scale)
+        return _vsum(scale)
+
+    telemetry.reset("serve.")
+    eng = ServeEngine.recover(str(durable),
+                              queries={"qsum": qsum, "qkill": qsum})
+    try:
+        rep = eng.recovery_report
+        assert rep["restored_tables"] == ["resident"]
+        assert catalog.get_table("resident").num_rows == 32
+        assert rep["unreplayable"] == []
+        assert set(rep["replayed"]) == {"req-2", "req-3"}
+        oracle = float(np.arange(32).sum())
+        assert rep["replayed"]["req-2"].result(60) == 2.0 * oracle
+        assert rep["replayed"]["req-3"].result(60) == 3.0 * oracle
+        # exactly once each; req-1 (journaled done) never re-ran
+        assert sorted(calls) == [2.0, 3.0]
+        assert telemetry.total("serve.journal_replayed") == 2
+        assert telemetry.total("serve.recoveries") == 1
+        # a client retrying its lost request post-recovery dedups
+        # against the replay instead of double-executing
+        again = eng.submit_named("qsum", 2.0, idempotency_key="req-2",
+                                 tenant="a")
+        assert again.result(60) == 2.0 * oracle
+        assert sorted(calls) == [2.0, 3.0]
+        # the recovered engine is itself durable: the replays are
+        # journaled done, so a SECOND recovery replays nothing
+        eng.close()
+        telemetry.reset("serve.")
+        eng2 = ServeEngine.recover(str(durable), env=eng.env,
+                                   queries={"qsum": qsum,
+                                            "qkill": qsum})
+        assert eng2.recovery_report["replayed"] == {}
+        assert sorted(calls) == [2.0, 3.0]
+        eng2.close()
+    finally:
+        try:
+            eng.close()
+        except Exception:
+            pass
+
+
+def test_keyless_replay_does_not_repeat_across_recoveries(tmp_path):
+    """Review fix: a KEYLESS journaled request replays on the first
+    recovery and is retired in the journal — a second recovery must
+    not execute it again (the original entry would otherwise read
+    incomplete forever)."""
+    j = RequestJournal(str(tmp_path))
+    j.admit(rid=1, key=None, name="q", args=[5], tenant="t")
+    j.close()
+    calls = []
+    eng = ServeEngine.recover(str(tmp_path), env=object(),
+                              queries={"q": lambda x: calls.append(x)
+                                       or x})
+    assert list(eng.recovery_report["replayed"]) == [1]
+    assert eng.recovery_report["replayed"][1].result(10) == 5
+    eng.close()
+    assert calls == [5]
+    eng2 = ServeEngine.recover(str(tmp_path), env=object(),
+                               queries={"q": lambda x: calls.append(x)
+                                        or x})
+    assert eng2.recovery_report["replayed"] == {}
+    assert calls == [5]  # executed exactly once across both recoveries
+    eng2.close()
+
+
+def test_explicit_unbounded_slo_survives_replay(tmp_path, monkeypatch):
+    """Review fix: slo=0 ("explicitly unbounded") journals as 0, so a
+    replay under an engine default SLO stays unbounded instead of
+    inheriting the default."""
+    eng = ServeEngine(policy=ServePolicy(max_queue=4),
+                      durable_dir=str(tmp_path))
+    eng.register_query("q", lambda: 1)
+    eng.submit_named("q", idempotency_key="u", tenant="a",
+                     slo=0).result(10)
+    eng.close()
+    entry = [e for e in RequestJournal.read(str(tmp_path))
+             if e["kind"] == "admit"][0]
+    assert entry["slo"] == 0  # pre-normalization value, not null
+
+
+def test_journal_failure_rolls_back_admission(tmp_path):
+    """Review fix: a journal write failure fails the submit CLEANLY —
+    admission slot, pins and idempotency entry all released, so the
+    engine keeps serving instead of leaking one slot per attempt."""
+    catalog.put_table("t", _t())
+    eng = ServeEngine(policy=ServePolicy(max_queue=4),
+                      durable_dir=str(tmp_path))
+    eng.register_query("q", lambda: 1)
+
+    def boom(**kw):
+        raise OSError("disk full")
+
+    eng._journal.admit = boom
+    for _ in range(6):  # more attempts than the queue cap
+        with pytest.raises(OSError, match="disk full"):
+            eng.submit_named("q", idempotency_key="k", tenant="a",
+                             tables=["t"])
+    assert eng.live == 0            # every slot released
+    assert catalog.pins("t") == {}  # every pin released
+    assert "k" not in eng._idem     # key free for a real retry
+    eng.close()
+
+
+def test_recover_reports_unreplayable_without_registry(tmp_path):
+    """Recovery with an unknown query name degrades: the entry lands
+    in the unreplayable report instead of dying mid-recovery."""
+    j = RequestJournal(str(tmp_path))
+    j.admit(rid=1, key="x", name="mystery", args=[], tenant="t")
+    j.close()
+    eng = ServeEngine.recover(str(tmp_path), env=object(), queries={})
+    try:
+        rep = eng.recovery_report
+        assert rep["replayed"] == {}
+        assert [e["key"] for e in rep["unreplayable"]] == ["x"]
+        assert telemetry.total("serve.journal_unreplayable") == 1
+    finally:
+        eng.close()
+
+
+# ------------------------------------------------- circuit breaker
+def test_breaker_sheds_under_deadline_storm_and_drains_inflight():
+    """Sustained DeadlineExceeded failures trip the breaker: new
+    admissions shed fast (ResourceExhausted, serve.shed{reason=
+    breaker}), a request already in flight still drains, and after the
+    cooldown admissions probe through again."""
+    eng = ServeEngine(policy=ServePolicy(
+        max_queue=16, breaker_fails=3, breaker_window=30.0,
+        breaker_cooldown=0.2))
+    gate = threading.Event()
+
+    def survivor():
+        while not gate.is_set():
+            yield
+            time.sleep(0.001)
+        return "drained"
+
+    alive = eng.submit(survivor, tenant="ok")
+
+    def storm():
+        raise DeadlineExceeded("wedged mesh", section="serve_request")
+
+    for _ in range(3):
+        with pytest.raises(DeadlineExceeded):
+            eng.submit(storm, tenant="noisy").result(10)
+    assert eng._admission.breaker.state == "open"
+    t0 = time.perf_counter()
+    with pytest.raises(ResourceExhausted, match="circuit breaker"):
+        eng.submit(lambda: 1, tenant="late")
+    assert time.perf_counter() - t0 < 0.5  # fast shed, no blocking
+    assert telemetry.counter("serve.shed", reason="breaker",
+                             tenant="late").value == 1
+    # in-flight work drains while the breaker is open
+    gate.set()
+    assert alive.result(10) == "drained"
+    # after the cooldown the breaker half-opens and admits again
+    time.sleep(0.25)
+    assert eng.submit(lambda: 2, tenant="late").result(10) == 2
+    assert eng._admission.breaker.state == "closed"
+    eng.close()
+
+
+def test_breaker_ignores_per_request_bugs_and_resets_on_success():
+    """Per-request failures (InvalidArgument) never trip the breaker,
+    and a success between systemic failures clears the streak — only
+    SUSTAINED storms trip."""
+    eng = ServeEngine(policy=ServePolicy(
+        max_queue=16, breaker_fails=2, breaker_window=30.0,
+        breaker_cooldown=60.0))
+
+    def bug():
+        raise InvalidArgument("caller error")
+
+    def slow():
+        raise DeadlineExceeded("one-off", section="serve_request")
+
+    for _ in range(4):
+        with pytest.raises(InvalidArgument):
+            eng.submit(bug, tenant="a").result(10)
+    assert eng._admission.breaker.state == "closed"
+    with pytest.raises(DeadlineExceeded):
+        eng.submit(slow, tenant="a").result(10)
+    assert eng.submit(lambda: 1, tenant="a").result(10) == 1  # resets
+    with pytest.raises(DeadlineExceeded):
+        eng.submit(slow, tenant="a").result(10)
+    assert eng._admission.breaker.state == "closed"  # streak broken
+    eng.close()
+
+
+def test_queue_full_shed_reason_counted():
+    eng = ServeEngine(policy=ServePolicy(max_queue=1))
+    gate = threading.Event()
+
+    def gated():
+        while not gate.is_set():
+            yield
+            time.sleep(0.001)
+        return 1
+
+    tk = eng.submit(gated, tenant="a")
+    with pytest.raises(ResourceExhausted):
+        eng.submit(lambda: 2, tenant="b")
+    assert telemetry.counter("serve.shed", reason="queue_full",
+                             tenant="b").value == 1
+    gate.set()
+    assert tk.result(10) == 1
+    eng.close()
