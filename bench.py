@@ -211,7 +211,7 @@ REQUIRED_HEADLINE_FIELDS = frozenset({
 #: much it holds (``tests/test_bench_guard.py`` pins this set).
 REQUIRED_TRACE_FIELDS = frozenset({
     "trace_path", "trace_events", "trace_rank_tracks",
-    "trace_stage_coverage",
+    "trace_stage_coverage", "trace_dropped",
 })
 
 
@@ -269,6 +269,10 @@ def _traced_headline_join(n: int, rng) -> dict:
         "trace_rank_tracks": len(pids),
         "trace_stage_coverage": (round(coverage, 4)
                                  if coverage is not None else None),
+        # silent-loss audit: events the ring bound evicted before the
+        # export — a non-zero value means the artifact is a WINDOW,
+        # not the whole run (raise CYLON_TPU_TRACE_EVENTS)
+        "trace_dropped": trace.dropped(),
     }
 
 

@@ -145,9 +145,30 @@ def table_nbytes(table: Table) -> int:
     return total
 
 
+def table_device_nbytes(table: Table) -> "dict[str, int]":
+    """Per-device byte split of ``table``'s buffers —
+    ``{"tpu:0": n, ...}`` from each array's addressable shard layout
+    (shard metadata only: no host sync, no transfer; the key scheme
+    and host fallback are
+    :func:`cylon_tpu.telemetry.memory.accumulate_array_bytes`, shared
+    with the live-bytes walk so the two accountings cross-check).
+    This is the split the serve ``/tables`` endpoint reports: on a
+    distributed table it shows exactly how evenly the resident bytes
+    spread over the mesh."""
+    from cylon_tpu.telemetry.memory import accumulate_array_bytes
+
+    out: "dict[str, int]" = {}
+    for c in table.columns.values():
+        accumulate_array_bytes(c.data, out)
+        if c.validity is not None:
+            accumulate_array_bytes(c.validity, out)
+    return out
+
+
 def stats() -> "dict[str, dict]":
-    """Per-table catalog statistics: ``{id: {rows, bytes, capacity,
-    columns, distributed, pins, holders}}`` — the resident-table
+    """Per-table catalog statistics: ``{id: {rows, bytes,
+    bytes_by_device, capacity, columns, distributed, pins,
+    holders}}`` — the resident-table
     inventory ``cylon_tpu.serve`` reports. ``rows`` is the true row
     count (summed across shards for distributed tables; one small host
     fetch per table); tables whose count is not host-reachable (e.g.
@@ -169,6 +190,7 @@ def stats() -> "dict[str, dict]":
         out[tid] = {
             "rows": rows,
             "bytes": table_nbytes(t),
+            "bytes_by_device": table_device_nbytes(t),
             "capacity": int(t.capacity),
             "columns": t.num_columns,
             "distributed": bool(dtable.is_distributed(t)),

@@ -31,6 +31,7 @@ from typing import Callable, Iterable, Mapping, Sequence
 import numpy as np
 
 from cylon_tpu import resilience, telemetry, watchdog
+from cylon_tpu.telemetry import memory as _memory
 from cylon_tpu.errors import DataLossError, InvalidArgument
 from cylon_tpu.utils.tracing import span as _span
 
@@ -236,6 +237,9 @@ def ooc_join(left, right, on, how: str = "inner",
         # visible by eye instead of buried in the pass total
         with _span("ooc_join.partition", cat="stage", partition=p,
                    rows_left=ln, rows_right=rn):
+            # stage-boundary HBM sample: the live-bytes gauge the
+            # in-core-vs-spill decision (ROADMAP item 1) will read
+            _memory.sample(op="ooc_join")
             # power-of-2 capacities bound the compiled-shape count to
             # O(log(rows)) across partitions
             lt = Table.from_pydict(lp, capacity=pow2_bucket(max(ln, 1)))
@@ -363,6 +367,7 @@ def ooc_groupby(src, by: Sequence[str], aggs,
                 partials.append(pd.DataFrame(cols))
             continue
         with _span("ooc_groupby.chunk", cat="stage", chunk=i):
+            _memory.sample(op="ooc_groupby")
             t = (Table.from_pydict(chunk) if transform is None
                  else transform(chunk))
             part = groupby_aggregate(t, list(by),
@@ -589,6 +594,7 @@ def ooc_sort(src, by, n_partitions: int = 8, chunk_rows: int = 1 << 22,
                 ckpt.complete(p, {}, 0)
             continue
         with _span("ooc_sort.bucket", cat="stage", bucket=p, rows=n):
+            _memory.sample(op="ooc_sort")
             t = Table.from_pydict(full, capacity=pow2_bucket(n))
             res = sort_table(t, keys)
             pdf = res.to_pandas()

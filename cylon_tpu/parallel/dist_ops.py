@@ -41,6 +41,7 @@ from cylon_tpu.parallel.shuffle import (checked_recv, poison,
                                         shuffle_local, transport_words,
                                         wire_rows_per_shard)
 from cylon_tpu.table import Table
+from cylon_tpu.telemetry import memory as _memory
 from cylon_tpu.telemetry import trace as _trace
 from cylon_tpu.utils.tracing import span as _span, traced
 
@@ -640,6 +641,10 @@ def _note_exchange(env: CylonEnv, op: str, tables,
     telemetry.counter("exchange.rows", op=op).inc(rows)
     telemetry.counter("exchange.bytes_true", op=op).inc(true_b)
     telemetry.counter("exchange.bytes_padded", op=op).inc(pad_b)
+    # HBM accounting at the stage boundary: one (throttled) live-bytes
+    # sample feeds memory.live_bytes{device} and this op's
+    # memory.peak_bytes{op} watermark (telemetry.memory)
+    _memory.sample(op=op)
     if true_b:
         telemetry.gauge("exchange.pad_ratio",
                         op=op).set(pad_b / true_b)

@@ -449,7 +449,9 @@ class CompiledQuery:
         import numpy as np
 
         from cylon_tpu.parallel import dtable
+        from cylon_tpu.telemetry import memory as _memory
         from cylon_tpu.utils import pow2_bucket
+        from cylon_tpu.utils.tracing import span as _span
 
         dyn_pos, static_pos, static_kw, dyn_kw = _split_args(args, kwargs)
         key = (static_pos, static_kw)
@@ -491,8 +493,17 @@ class CompiledQuery:
                 _trace.instant("plan.compile", cat="plan", scale=scale,
                                row_hint=hint,
                                fn=getattr(self._fn, "__name__", "?"))
-            raw, bad = self._jitted(scale, hint, static_pos, static_kw,
-                                    tuple(dyn_pos), **dyn_kw)
+            # the compile-vs-execute split the ANALYZE profile reads:
+            # on a cache miss this span is dominated by trace+compile
+            # (dispatch is async), on a hit it is pure host dispatch;
+            # the plan.fetch span below is the wait on real execution.
+            # An allocation failure here gets the resident-consumer
+            # forensics dump (telemetry.memory) before it propagates.
+            with _span("plan.dispatch", cat="stage", cache_hit=hit), \
+                    _memory.forensics("plan.dispatch"):
+                raw, bad = self._jitted(scale, hint, static_pos,
+                                        static_kw, tuple(dyn_pos),
+                                        **dyn_kw)
             if not self._check:
                 return raw
             out = self._slicer(buckets, raw) if buckets is not None \
@@ -502,7 +513,9 @@ class CompiledQuery:
                 # intermediate poison masked by downstream ops) + the
                 # result-table nrows scan + small result buffers, all
                 # fetched in ONE transfer
-                _check_overflow(out, bad)
+                with _span("plan.fetch", cat="stage"), \
+                        _memory.forensics("plan.fetch"):
+                    _check_overflow(out, bad)
             except OutOfCapacity as err:
                 if buckets is not None and not bool(np.asarray(bad)):
                     # maybe only the memoized result buckets were

@@ -21,9 +21,10 @@ from cylon_tpu.serve.admission import (AdmissionController,
                                        CircuitBreaker, ServePolicy,
                                        default_policy)
 from cylon_tpu.serve.durability import CatalogSnapshot, RequestJournal
+from cylon_tpu.serve.introspect import IntrospectServer
 from cylon_tpu.serve.service import QueryTicket, ServeEngine
 from cylon_tpu.serve.session import Session
 
 __all__ = ["ServeEngine", "QueryTicket", "Session", "ServePolicy",
            "AdmissionController", "CircuitBreaker", "RequestJournal",
-           "CatalogSnapshot", "default_policy"]
+           "CatalogSnapshot", "default_policy", "IntrospectServer"]

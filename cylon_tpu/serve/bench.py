@@ -47,6 +47,10 @@ REQUIRED_SERVE_FIELDS = frozenset({
     "p50_s", "p99_s", "qps", "cache_hit_rate", "rejected", "errors",
     "expired", "oracle_mismatches", "shed", "journal_replayed",
     "recoveries",
+    # attribution columns (ISSUE 9): every serve artifact carries the
+    # slowest request's ANALYZE profile and the run's HBM high-water
+    # mark, not just p50/p99
+    "slowest_profile", "peak_live_bytes",
 })
 
 #: default mixed workload: groupby-heavy scan, 3-way join + top-k,
@@ -171,6 +175,7 @@ def run_bench(clients: int = 8, requests: int = 2, sf: float = 0.002,
     engine = ServeEngine(env, policy)
     mismatches = []
     rejected_local = [0]
+    all_tickets = []  # (query, ticket) across every client thread
     lock = threading.Lock()
 
     def client(i: int):
@@ -184,9 +189,11 @@ def run_bench(clients: int = 8, requests: int = 2, sf: float = 0.002,
             for r in range(requests):
                 q = mix[(i + r) % len(mix)]
                 try:
-                    tickets.append(
-                        (q, s.submit(_staged_query(compiled[q],
-                                                   resident, env))))
+                    tk = s.submit(_staged_query(compiled[q],
+                                                resident, env))
+                    tickets.append((q, tk))
+                    with lock:
+                        all_tickets.append((q, tk))
                 except ResourceExhausted:
                     with lock:
                         rejected_local[0] += 1
@@ -216,6 +223,7 @@ def run_bench(clients: int = 8, requests: int = 2, sf: float = 0.002,
         for th in threads:
             th.join()
     wall = time.perf_counter() - t0
+    http_addr = engine.http_address  # captured before close unbinds
     engine.close(wait=True)
 
     hist = telemetry.merge_histograms(
@@ -254,6 +262,24 @@ def run_bench(clients: int = 8, requests: int = 2, sf: float = 0.002,
         "mismatch_detail": mismatches[:8],
         "resident_tables": len(resident),
     }
+    # attribution (ISSUE 9): the slowest completed request's ANALYZE
+    # profile rides the artifact — a p99 regression in the trajectory
+    # names its stages, operators and bytes instead of being a bare
+    # number — plus the run's HBM high-water mark
+    slowest = None
+    for q, tk in all_tickets:
+        if tk.finished is None or tk.state != "done":
+            continue
+        w = tk.finished - tk.submitted
+        if slowest is None or w > slowest[0]:
+            slowest = (w, q, tk)
+    prof = slowest[2].profile() if slowest is not None else None
+    if prof is not None:
+        prof["query"] = slowest[1]
+    record["slowest_profile"] = prof
+    record["peak_live_bytes"] = telemetry.memory.peak_live_bytes()
+    if http_addr is not None:
+        record["http_url"] = "http://%s:%d" % http_addr
     return record
 
 

@@ -1,0 +1,191 @@
+"""Read-only live introspection endpoint for the serve engine.
+
+Until this module the only way to see inside a running
+:class:`~cylon_tpu.serve.ServeEngine` was to kill it and read the
+atexit telemetry flush. This is the ops plane: a stdlib
+``http.server`` thread serving the engine's live state as JSON (and
+Prometheus text), armed ONLY by ``CYLON_TPU_SERVE_HTTP_PORT`` — the
+same no-threads-unless-armed contract as every other telemetry
+surface: with the env unset, :func:`maybe_start` is one env read and
+returns None; no socket is bound, no thread starts (pinned by
+``tests/test_introspect.py``).
+
+Endpoints (all GET, all read-only — the bench guard lints statically
+that no handler can reach ``submit``/``register_*``/``drop_*``/
+``close``):
+
+=======================  ==============================================
+path                     payload
+=======================  ==============================================
+``/healthz``             liveness: state, live request count, uptime
+``/metrics``             live Prometheus text (the PR 3 exposition
+                         formatter over a fresh registry snapshot)
+``/queries``             in-flight tickets — tenant, state, elapsed,
+                         remaining SLO budget, step count — plus the
+                         process's active watchdog sections (what the
+                         engine is blocked on RIGHT NOW)
+``/tenants``             ``ServeEngine.tenant_stats()``
+``/tables``              resident catalog: rows/bytes/pins/holders +
+                         the per-device byte split
+``/profiles/<rid>``      one retired-or-live request's ANALYZE
+                         profile (``QueryTicket.profile()``)
+=======================  ==============================================
+
+Binding is loopback-only (``127.0.0.1``) — this is an operator
+diagnostic port, not a public API; port ``0`` binds an ephemeral port
+(tests), the bound address is ``IntrospectServer.address``.
+"""
+
+import json
+import os
+import threading
+import time
+
+__all__ = ["maybe_start", "IntrospectServer", "ENDPOINTS"]
+
+#: the read-only surface (for docs and the landing page)
+ENDPOINTS = ("/healthz", "/metrics", "/queries", "/tenants",
+             "/tables", "/profiles/<rid>")
+
+
+def maybe_start(engine) -> "IntrospectServer | None":
+    """Start the introspection server for ``engine`` IFF
+    ``CYLON_TPU_SERVE_HTTP_PORT`` is set — otherwise one env read,
+    None returned, no socket/thread exists.
+
+    Startup failures (malformed port value, address already in use)
+    are logged LOUDLY and degrade to None instead of raising: the
+    endpoint is a diagnostic, and a stale listener on the configured
+    port must never take down engine construction — least of all
+    ``ServeEngine.recover()``, where failing here would abandon a
+    durable engine's journaled requests."""
+    port = os.environ.get("CYLON_TPU_SERVE_HTTP_PORT")
+    if not port:
+        return None
+    from cylon_tpu.utils.logging import get_logger
+
+    try:
+        return IntrospectServer(engine, int(port))
+    except (ValueError, OSError) as e:
+        get_logger().warning(
+            "introspection endpoint NOT started "
+            "(CYLON_TPU_SERVE_HTTP_PORT=%r): %s: %s — the engine "
+            "runs without its ops plane", port, type(e).__name__, e)
+        return None
+
+
+class IntrospectServer:
+    """One daemon HTTP thread serving an engine's live state."""
+
+    def __init__(self, engine, port: int):
+        import http.server
+
+        self._engine = engine
+        self._started = time.monotonic()
+        outer = self
+
+        class _Handler(http.server.BaseHTTPRequestHandler):
+            server_version = "cylon-tpu-introspect"
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):  # quiet by default
+                from cylon_tpu.utils.logging import get_logger
+
+                get_logger().debug("introspect: " + fmt, *args)
+
+            def do_GET(self):  # noqa: N802 - stdlib handler name
+                try:
+                    outer._route(self)
+                except BrokenPipeError:  # client went away mid-write
+                    pass
+                except Exception as e:  # never kill the server thread
+                    try:
+                        outer._send(self, 500, {
+                            "error": f"{type(e).__name__}: {e}"})
+                    except Exception:
+                        pass
+
+        self._httpd = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", port), _Handler)
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="cylon-serve-introspect", daemon=True)
+        self._thread.start()
+
+    @property
+    def address(self) -> "tuple[str, int]":
+        """(host, port) actually bound (port 0 resolves here)."""
+        return self._httpd.server_address[:2]
+
+    @property
+    def url(self) -> str:
+        host, port = self.address
+        return f"http://{host}:{port}"
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+    # ---------------------------------------------------------- routes
+    def _send(self, h, code: int, payload, content_type=None) -> None:
+        from cylon_tpu import telemetry
+
+        if isinstance(payload, (dict, list)):
+            body = json.dumps(telemetry.json_safe(payload),
+                              allow_nan=False).encode()
+            content_type = content_type or "application/json"
+        else:
+            body = str(payload).encode()
+            content_type = content_type or "text/plain; charset=utf-8"
+        h.send_response(code)
+        h.send_header("Content-Type", content_type)
+        h.send_header("Content-Length", str(len(body)))
+        h.end_headers()
+        h.wfile.write(body)
+
+    def _route(self, h) -> None:
+        from cylon_tpu import telemetry, watchdog
+
+        path = h.path.split("?", 1)[0].rstrip("/") or "/"
+        eng = self._engine
+        if path == "/healthz":
+            self._send(h, 200, {
+                "status": "closed" if eng._closed else "ok",
+                "live": eng.live,
+                "uptime_s": time.monotonic() - self._started,
+            })
+        elif path == "/metrics":
+            self._send(h, 200, telemetry.to_prometheus(),
+                       content_type="text/plain; version=0.0.4; "
+                                    "charset=utf-8")
+        elif path == "/queries":
+            self._send(h, 200, {
+                "queries": eng.queries(),
+                "active_sections": [
+                    {"section": s, "detail": d, "elapsed_s": e}
+                    for s, d, e in watchdog.active_sections()],
+            })
+        elif path == "/tenants":
+            self._send(h, 200, eng.tenant_stats())
+        elif path == "/tables":
+            self._send(h, 200, eng.table_stats())
+        elif path.startswith("/profiles/"):
+            rid = path.rsplit("/", 1)[1]
+            ticket = eng.ticket(int(rid)) if rid.isdigit() else None
+            if ticket is None:
+                self._send(h, 404, {"error": f"unknown rid {rid!r}"})
+                return
+            prof = ticket.profile()
+            if prof is None:
+                self._send(h, 404, {
+                    "error": f"request {rid} has no profile "
+                             "(CYLON_TPU_SERVE_PROFILE=0?)"})
+                return
+            self._send(h, 200, prof)
+        elif path == "/":
+            self._send(h, 200, {"endpoints": list(ENDPOINTS)})
+        else:
+            self._send(h, 404, {"error": f"unknown path {path!r}",
+                                "endpoints": list(ENDPOINTS)})

@@ -70,8 +70,10 @@ Design — an assembly of the subsystems the previous PRs built:
   drains.
 """
 
+import collections
 import contextlib
 import itertools
+import os
 import threading
 import time
 
@@ -82,6 +84,9 @@ from cylon_tpu.ops_graph.execution import (PriorityExecution,
                                            RoundRobinExecution)
 from cylon_tpu.ops_graph.op import Op
 from cylon_tpu.serve.admission import AdmissionController, ServePolicy
+from cylon_tpu.serve import introspect
+from cylon_tpu.telemetry import memory as _memory
+from cylon_tpu.telemetry import profile as _profile
 from cylon_tpu.telemetry import trace as _trace
 from cylon_tpu.utils import tracing
 
@@ -111,6 +116,10 @@ class QueryTicket:
         self.value = None
         self.error: "BaseException | None" = None
         self._event = threading.Event()
+        #: ANALYZE profiler (telemetry.profile.RequestProfiler), set
+        #: at admission unless CYLON_TPU_SERVE_PROFILE=0
+        self._profiler = None
+        self._retired = False
 
     def remaining(self) -> "float | None":
         """Seconds of SLO budget left (None = unbounded)."""
@@ -124,6 +133,17 @@ class QueryTicket:
 
     def wait(self, timeout: "float | None" = None) -> bool:
         return self._event.wait(timeout)
+
+    def profile(self) -> "dict | None":
+        """The request's EXPLAIN ANALYZE profile
+        (:data:`cylon_tpu.telemetry.profile.REQUIRED_PROFILE_FIELDS`):
+        per-stage walls, rows/bytes per operator, compile-vs-execute
+        split, spill bytes, retries/faults and the HBM peak watermark
+        — live (partial) while running, final once retired. None when
+        profiling is disabled (``CYLON_TPU_SERVE_PROFILE=0``)."""
+        if self._profiler is None:
+            return None
+        return self._profiler.render(self)
 
     def result(self, timeout: "float | None" = None):
         """Block for the result; re-raise the request's failure."""
@@ -184,6 +204,13 @@ class _QueryOp(Op):
             self._run_step(rem)
         except BaseException as e:  # noqa: BLE001 - isolate per request
             self._engine._retire(self, error=e)
+        finally:
+            # the client-visible completion signal fires only AFTER
+            # the step's profiler/forensics scopes have fully unwound:
+            # a result() that returned implies the ANALYZE profile is
+            # complete, not racing the scheduler's bookkeeping
+            if t.state in (DONE, FAILED):
+                t._event.set()
         return True
 
     def _run_step(self, rem: "float | None") -> None:
@@ -214,6 +241,13 @@ class _QueryOp(Op):
             stack.enter_context(watchdog.watched_section(
                 "serve_request", detail=f"{t.tenant}/{t.rid}"
                 f"#{self._step}"))
+            if t._profiler is not None:
+                # registry-delta + memory-sample bracket: the one-step-
+                # at-a-time scheduler makes the delta THIS request's
+                stack.enter_context(t._profiler.step())
+            # allocation failures inside the step get the resident-
+            # consumer forensics dump before the request fails
+            stack.enter_context(_memory.forensics("serve_request"))
             self._step += 1
             if self._gen is None:
                 first = self._fn(*self._args, **self._kwargs)
@@ -268,6 +302,15 @@ class ServeEngine:
             self._journal = RequestJournal(durable_dir)
             self._snapshot = CatalogSnapshot(durable_dir)
         self.durable_dir = durable_dir
+        #: bounded rid -> ticket history (live AND retired): the
+        #: lookup surface behind /profiles/<rid> and QueryTicket
+        #: retrieval after the fact
+        self._recent: "collections.OrderedDict[int, QueryTicket]" = \
+            collections.OrderedDict()
+        #: the ops-plane HTTP thread — armed ONLY by
+        #: CYLON_TPU_SERVE_HTTP_PORT (None otherwise: no socket, no
+        #: thread — the telemetry fast-path contract, pinned by test)
+        self._http = introspect.maybe_start(self)
 
     # ------------------------------------------------- resident tables
     @property
@@ -356,6 +399,8 @@ class ServeEngine:
         self._admission.admit(tenant)  # may raise ResourceExhausted
         ticket = QueryTicket(next(self._ids), str(tenant),
                              int(priority), slo)
+        if _profile.profiling_enabled():
+            ticket._profiler = _profile.RequestProfiler()
         holder = f"{tenant}/req{ticket.rid}"
         pinned: list[str] = []
         try:
@@ -397,6 +442,21 @@ class ServeEngine:
             with self._cond:
                 self._undo_admission(op)
             raise
+        with self._cond:
+            # bounded rid->ticket history: the /profiles + ticket()
+            # lookup surface (oldest-first eviction; generous cap,
+            # env-tunable like the idempotency map). Defensive parse:
+            # a malformed env value must not fail a submit AFTER the
+            # journal write (the slot/pins would leak — the exact
+            # window the journal-failure rollback exists to close)
+            self._recent[ticket.rid] = ticket
+            try:
+                cap = int(os.environ.get(
+                    "CYLON_TPU_SERVE_RECENT_ENTRIES", "1024"))
+            except ValueError:
+                cap = 1024
+            while cap > 0 and len(self._recent) > cap:
+                self._recent.popitem(last=False)
         self._dispatch(op, ticket)
         return ticket
 
@@ -505,8 +565,12 @@ class ServeEngine:
         and the admission slot, wake waiters. Runs on the scheduler
         thread (once per request — ops retire exactly once)."""
         t = op.ticket
-        if t.done:  # pragma: no cover - retire races are scheduler bugs
+        if getattr(t, "_retired", False):
+            # a request that retired successfully can still raise on
+            # scope exit (a deadline verdict from watched_section);
+            # the first retirement's outcome stands
             return
+        t._retired = True
         t.finished = time.monotonic()
         wall = t.finished - t.submitted
         if error is None:
@@ -541,13 +605,49 @@ class ServeEngine:
             except Exception:  # pragma: no cover - unpin best-effort
                 pass
         self._admission.release()
-        t._event.set()
+        # NOTE: t._event is set by _QueryOp.progress() after the step
+        # scopes unwind (see there) — not here, which runs inside them
 
     # ------------------------------------------------------- reporting
     @property
     def live(self) -> int:
         """Live (queued + running) request count."""
         return self._admission.live
+
+    @property
+    def http_address(self) -> "tuple[str, int] | None":
+        """(host, port) of the introspection endpoint, or None when
+        ``CYLON_TPU_SERVE_HTTP_PORT`` is unarmed."""
+        return None if self._http is None else self._http.address
+
+    def ticket(self, rid: int) -> "QueryTicket | None":
+        """Look up a recent (live or retired) request by rid — the
+        ``/profiles/<rid>`` surface. None once evicted from the
+        bounded history (``CYLON_TPU_SERVE_RECENT_ENTRIES``)."""
+        with self._cond:
+            return self._recent.get(int(rid))
+
+    def queries(self) -> "list[dict]":
+        """In-flight request inventory (the ``/queries`` payload):
+        rid, tenant, state, priority, elapsed, queue wait, remaining
+        SLO budget and step count per live request."""
+        with self._cond:
+            ops = list(self._exec.ops)
+        now = time.monotonic()
+        out = []
+        for op in ops:
+            t = op.ticket
+            out.append({
+                "rid": t.rid,
+                "tenant": t.tenant,
+                "state": t.state,
+                "priority": t.priority,
+                "elapsed_s": now - t.submitted,
+                "queue_wait_s": (t.started or now) - t.submitted,
+                "remaining_slo_s": t.remaining(),
+                "steps": op._step,
+            })
+        return out
 
     def tenant_stats(self) -> "dict[str, dict]":
         """Per-tenant serving report: requests/completed/errors/
@@ -691,6 +791,9 @@ class ServeEngine:
             self._thread.join(timeout)
         if self._journal is not None:
             self._journal.close()
+        if self._http is not None:
+            self._http.close()
+            self._http = None
 
     def __enter__(self) -> "ServeEngine":
         return self

@@ -21,6 +21,12 @@ updates only; no thread starts, no file opens. Exporters
 (:mod:`cylon_tpu.telemetry.export`): JSONL snapshot lines + a
 Prometheus text dump per process, armed lazily off the env knob.
 
+The ops plane on top (ISSUE 9): :mod:`cylon_tpu.telemetry.memory`
+(HBM live-bytes gauges, per-op peak watermarks, OOM forensics) and
+:mod:`cylon_tpu.telemetry.profile` (per-query EXPLAIN plans and the
+per-request ANALYZE profiles ``QueryTicket.profile()`` serves), both
+read live by :mod:`cylon_tpu.serve.introspect`'s HTTP endpoint.
+
 The event-level half is :mod:`cylon_tpu.telemetry.trace` — the
 ``CYLON_TPU_TRACE`` flight recorder: per-rank span/instant/counter
 timelines, Chrome Trace export (:func:`to_chrome_trace` /
@@ -30,7 +36,7 @@ straggler attribution (``trace.critical_path``). Same
 no-overhead-when-off contract. See ``docs/observability.md``.
 """
 
-from cylon_tpu.telemetry import trace
+from cylon_tpu.telemetry import memory, profile, trace
 from cylon_tpu.telemetry.aggregate import (gather_metrics,
                                            gather_traces,
                                            merge_snapshots)
@@ -66,5 +72,5 @@ __all__ = [
     "ICI_LINK_BYTES_PER_SEC", "fraction_of_peak", "trace",
     "to_chrome_trace", "chrome_trace_json", "write_chrome_trace",
     "tenant_scope", "current_tenant", "tenant_labels",
-    "merge_histograms",
+    "merge_histograms", "memory", "profile",
 ]

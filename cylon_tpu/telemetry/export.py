@@ -443,6 +443,13 @@ def bench_metrics() -> dict:
     from cylon_tpu.telemetry import registry as _r
 
     out = {k: _r.total(k) for k in REQUIRED_BENCH_KEYS}
+    # the run's HBM high-water mark (telemetry.memory) — absent when
+    # sampling never ran
+    from cylon_tpu.telemetry import memory as _memory
+
+    peak = _memory.peak_live_bytes()
+    if peak is not None:
+        out["memory.peak_bytes"] = json_safe(peak)
     for gname in ("exchange.pad_ratio", "exchange.headroom_ratio"):
         ratios = []
         for _, _, inst in _r.instruments(gname):

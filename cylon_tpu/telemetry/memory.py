@@ -1,0 +1,374 @@
+"""HBM memory accounting: live-bytes gauges, per-op peak watermarks,
+and OOM forensics.
+
+The engine's scale ceiling is device memory, yet until this module
+nothing in the system could answer "how much HBM is resident right
+now, and who owns it?" — the OOC executor decides in-core vs spill
+blind, and an XLA ``RESOURCE_EXHAUSTED`` names an allocation size but
+none of the consumers (resident catalog tables, plan-cache programs,
+spill buffers) that crowded it out. Three pieces close that:
+
+* :func:`device_bytes` / :func:`sample` — per-device live bytes, from
+  the backend's allocator stats (``device.memory_stats()`` on TPU)
+  with a ``jax.live_arrays()`` host-walk fallback where the backend
+  keeps none (CPU). :func:`sample` publishes
+  ``memory.live_bytes{device=}`` gauges, the process-wide
+  ``memory.peak_bytes`` high-water mark, and — when called with an
+  ``op=`` — the per-op watermark ``memory.peak_bytes{op=}``. Samples
+  are taken at *stage boundaries* (serve steps, eager exchange
+  dispatches, OOC partition/chunk/bucket loops), never inside device
+  code.
+
+* :func:`watermark` — context manager bracketing one op with
+  before/after samples, for callers outside the instrumented layers.
+
+* :func:`forensics` / :func:`oom_report` — when an allocation path
+  fails (:func:`is_oom` pattern-matches the backend's
+  RESOURCE_EXHAUSTED / out-of-memory shapes), the forensics scope
+  logs ONE warning naming the top resident consumers — catalog tables
+  with their pins, plan-cache entries, spill byte totals, the largest
+  live arrays — and re-raises. The report is also available
+  programmatically for the serve layer's error payloads.
+
+Fast-path contract: sampling is gated by ``CYLON_TPU_MEMORY_SAMPLING``
+(default ON — one gauge write per device per stage boundary; ``0``
+disables every sample to a single env read). No threads, no file
+handles, ever.
+"""
+
+import contextlib
+import os
+
+from cylon_tpu.telemetry import registry as _r
+
+__all__ = [
+    "enabled", "device_bytes", "live_bytes", "sample", "watermark",
+    "peak_live_bytes", "accumulate_array_bytes", "is_oom",
+    "oom_report", "format_oom_report", "forensics",
+]
+
+
+def enabled() -> bool:
+    """Is stage-boundary sampling on? (``CYLON_TPU_MEMORY_SAMPLING``,
+    default yes — one env read, the entire off-path cost.)"""
+    return os.environ.get("CYLON_TPU_MEMORY_SAMPLING", "1") not in (
+        "0", "off", "false")
+
+
+def _device_key(d) -> str:
+    return f"{d.platform}:{d.id}"
+
+
+def accumulate_array_bytes(arr, out: dict) -> None:
+    """Add one array's bytes into ``out`` keyed per device
+    (:func:`_device_key`), from its addressable-shard metadata — no
+    sync, no transfer; host-resident buffers (numpy) land under
+    ``"host"``. THE shared accumulation both this module's live-walk
+    and ``catalog.table_device_nbytes`` use, so the per-device key
+    scheme cannot drift between the two accountings."""
+    import jax
+
+    if isinstance(arr, jax.Array):
+        try:
+            for sh in arr.addressable_shards:
+                key = _device_key(sh.device)
+                out[key] = out.get(key, 0) + int(sh.data.nbytes)
+            return
+        except Exception:  # non-addressable / deleted buffer
+            pass
+    out["host"] = out.get("host", 0) + int(
+        getattr(arr, "nbytes", arr.size * arr.dtype.itemsize))
+
+
+def _allocator_bytes() -> "dict[str, int] | None":
+    """Per-device live bytes from the backend allocator ONLY —
+    ``device.memory_stats()["bytes_in_use"]``, O(devices), no array
+    walk. None when any device keeps no stats (plain CPU), i.e. when
+    only the expensive :func:`device_bytes` walk can answer."""
+    import jax
+
+    out: "dict[str, int]" = {}
+    for d in jax.devices():
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats or stats.get("bytes_in_use") is None:
+            return None
+        out[_device_key(d)] = int(stats["bytes_in_use"])
+    return out
+
+
+def device_bytes() -> "dict[str, int]":
+    """Live bytes per device, ``{"tpu:0": n, ...}``.
+
+    Preferred source is the backend allocator
+    (``device.memory_stats()["bytes_in_use"]`` — exact, O(devices));
+    backends that keep no stats (CPU) fall back to summing
+    ``jax.live_arrays()`` shard-by-shard — the *host view* of device
+    residency (O(live arrays), still no device sync or transfer).
+    """
+    import jax
+
+    out: "dict[str, int]" = {}
+    fallback = []
+    for d in jax.devices():
+        stats = None
+        try:
+            stats = d.memory_stats()
+        except Exception:  # backend without allocator stats
+            stats = None
+        if stats and stats.get("bytes_in_use") is not None:
+            out[_device_key(d)] = int(stats["bytes_in_use"])
+        else:
+            fallback.append(d)
+    if len(fallback) == 1 and not out:
+        # ONE stat-less device (plain CPU): every live byte is its —
+        # skip the per-shard walk (a .nbytes sum is ~2x cheaper)
+        total = 0
+        for a in jax.live_arrays():
+            try:
+                total += int(a.nbytes)
+            except Exception:  # deleted/donated array mid-walk
+                continue
+        out[_device_key(fallback[0])] = total
+    elif fallback:
+        want = {_device_key(d) for d in fallback}
+        acc = {k: 0 for k in want}
+        for a in jax.live_arrays():
+            try:
+                for sh in a.addressable_shards:
+                    k = _device_key(sh.device)
+                    if k in acc:
+                        acc[k] += int(sh.data.nbytes)
+            except Exception:  # deleted/donated array mid-walk
+                continue
+        out.update(acc)
+    return out
+
+
+def live_bytes() -> int:
+    """Total live bytes across devices (one :func:`device_bytes`)."""
+    return sum(device_bytes().values())
+
+
+def _raise_watermark(gauge, v: int) -> None:
+    """Monotone gauge update: the watermark only ever rises (the
+    read-modify-write holds the instrument's own lock, so concurrent
+    samplers cannot regress it)."""
+    with gauge._lock:
+        if gauge.value is None or v > gauge.value:
+            gauge.value = v
+
+
+#: throttle state: (last sample monotonic ts, last total). Hot layers
+#: (one exchange dispatch can fire thousands of times a second in a
+#: chunked pass) call :func:`sample` freely; the walk itself runs at
+#: most once per ``CYLON_TPU_MEMORY_SAMPLE_INTERVAL`` seconds
+#: (default 0.25) — in between, watermarks update from the cached
+#: total at dict-write cost.
+_THROTTLE = [0.0, 0]  # unlocked: a race costs one extra sample
+
+
+def _interval() -> float:
+    try:
+        return float(os.environ.get(
+            "CYLON_TPU_MEMORY_SAMPLE_INTERVAL", "0.25"))
+    except ValueError:
+        return 0.25
+
+
+def sample(op: "str | None" = None, force: bool = False) -> int:
+    """One stage-boundary sample: publish ``memory.live_bytes{device=}``
+    gauges, raise the process ``memory.peak_bytes`` watermark (and the
+    ``memory.peak_bytes{op=}`` watermark when ``op`` is given), return
+    the total. No-op returning 0 when sampling is disabled.
+
+    Cost discipline: an unforced call (the hot paths — one per eager
+    exchange dispatch, per OOC unit) is throttled
+    (:data:`_THROTTLE`) AND restricted to the O(devices) allocator
+    read — on a stat-less backend (plain CPU) it reuses the last
+    forced walk's total rather than paying (and jittering op walls
+    by) an O(live-arrays) scan. ``force=True`` (serve step
+    boundaries, :func:`watermark` brackets) always takes the full
+    :func:`device_bytes` view."""
+    import time
+
+    if not enabled():
+        return 0
+    now = time.monotonic()
+    if not force and now - _THROTTLE[0] < _interval():
+        total = _THROTTLE[1]
+        if op is not None and total:
+            _raise_watermark(_r.gauge("memory.peak_bytes", op=op),
+                             total)
+        return total
+    if force:
+        per = device_bytes()
+    else:
+        per = _allocator_bytes()
+        if per is None:  # stat-less backend: hot path stays cheap
+            total = _THROTTLE[1]
+            if op is not None and total:
+                _raise_watermark(
+                    _r.gauge("memory.peak_bytes", op=op), total)
+            return total
+    total = 0
+    for dev, n in per.items():
+        _r.gauge("memory.live_bytes", device=dev).set(n)
+        total += n
+    _THROTTLE[0], _THROTTLE[1] = now, total
+    _raise_watermark(_r.gauge("memory.peak_bytes"), total)
+    if op is not None:
+        _raise_watermark(_r.gauge("memory.peak_bytes", op=op), total)
+    return total
+
+
+def peak_live_bytes(op: "str | None" = None) -> "int | None":
+    """The recorded high-water mark (process-wide, or one op's) — None
+    when never sampled."""
+    g = (_r.metric("memory.peak_bytes") if op is None
+         else _r.metric("memory.peak_bytes", op=op))
+    return None if g is None else g.value
+
+
+@contextlib.contextmanager
+def watermark(op: str):
+    """Bracket one op with before/after samples (unthrottled) so its
+    peak watermark is recorded even when nothing inside it samples."""
+    sample(op=op, force=True)
+    try:
+        yield
+    finally:
+        sample(op=op, force=True)
+
+
+# ------------------------------------------------------- OOM forensics
+#: message fragments that identify an allocation failure across the
+#: backends this engine meets: XLA/PJRT (RESOURCE_EXHAUSTED, "out of
+#: memory", "Out of memory allocating"), host numpy
+#: (_ArrayMemoryError "Unable to allocate"), and raw MemoryError.
+_OOM_MARKS = ("resource_exhausted", "out of memory",
+              "oom when allocating", "unable to allocate",
+              "bad_alloc", "memory exhausted")
+
+
+def is_oom(exc: BaseException) -> bool:
+    """Does ``exc`` look like an allocation failure?"""
+    if isinstance(exc, MemoryError):
+        return True
+    msg = f"{type(exc).__name__}: {exc}".lower()
+    return any(m in msg for m in _OOM_MARKS)
+
+
+def oom_report(limit: int = 8) -> dict:
+    """Name the top resident consumers — the dump an OOM needs next to
+    the allocator's "tried to allocate N bytes" line:
+
+    - ``devices``: live bytes per device (:func:`device_bytes`),
+    - ``tables``: the ``limit`` largest catalog tables (id, bytes,
+      rows, pins, holders — a pinned table cannot be evicted, which is
+      exactly why its holders are named),
+    - ``plan_cache``: compiled-program cache occupancy
+      (:func:`cylon_tpu.plan.plan_cache_stats` + per-query entry
+      counts),
+    - ``spill``: cumulative spill read/write bytes (the pressure valve
+      that *was* available),
+    - ``top_arrays``: the ``limit`` largest live arrays by bytes
+      (shape/dtype/device) — what the catalog cannot name,
+    - ``peak_bytes``: the recorded high-water mark.
+    """
+    from cylon_tpu import catalog
+
+    rep: dict = {"devices": device_bytes()}
+    tables = []
+    try:
+        for tid, st in catalog.stats().items():
+            tables.append({"id": tid, "bytes": st["bytes"],
+                           "rows": st["rows"], "pins": st["pins"],
+                           "holders": st["holders"]})
+    except Exception:  # catalog stats must never fail the report
+        pass
+    tables.sort(key=lambda t: -(t["bytes"] or 0))
+    rep["tables"] = tables[:limit]
+    try:
+        from cylon_tpu import plan
+
+        stats = plan.plan_cache_stats()
+        stats["entries_per_query"] = {
+            getattr(fn, "__name__", "?"): len(cq._compiled)
+            for (fn, _), cq in list(plan._SHARED.items())}
+        rep["plan_cache"] = stats
+    except Exception:
+        rep["plan_cache"] = {}
+    rep["spill"] = {"read_bytes": _r.total("spill.read_bytes"),
+                    "write_bytes": _r.total("spill.write_bytes")}
+    arrays = []
+    try:
+        import jax
+
+        live = sorted(jax.live_arrays(), key=lambda a: -a.nbytes)
+        for a in live[:limit]:
+            try:
+                devs = ",".join(sorted(_device_key(d)
+                                       for d in a.devices()))
+            except Exception:
+                devs = "?"
+            arrays.append({"bytes": int(a.nbytes),
+                           "shape": list(a.shape),
+                           "dtype": str(a.dtype), "devices": devs})
+    except Exception:
+        pass
+    rep["top_arrays"] = arrays
+    rep["peak_bytes"] = peak_live_bytes()
+    return rep
+
+
+def format_oom_report(rep: "dict | None" = None) -> str:
+    """Human-readable rendering of :func:`oom_report` (the warning-log
+    payload)."""
+    rep = oom_report() if rep is None else rep
+    lines = ["resident-memory forensics:"]
+    for dev, n in sorted(rep.get("devices", {}).items()):
+        lines.append(f"  device {dev}: {n} bytes live")
+    for t in rep.get("tables", []):
+        pin = (f" pinned by {t['holders']}" if t.get("pins") else "")
+        lines.append(f"  table {t['id']!r}: {t['bytes']} bytes, "
+                     f"rows={t['rows']}{pin}")
+    pc = rep.get("plan_cache") or {}
+    if pc:
+        lines.append(f"  plan cache: {pc.get('shared_queries', 0)} "
+                     f"shared queries, entries "
+                     f"{pc.get('entries_per_query', {})}")
+    sp = rep.get("spill", {})
+    lines.append(f"  spill: {sp.get('read_bytes', 0)} read / "
+                 f"{sp.get('write_bytes', 0)} written bytes")
+    for a in rep.get("top_arrays", []):
+        lines.append(f"  array {a['shape']} {a['dtype']} on "
+                     f"{a['devices']}: {a['bytes']} bytes")
+    if rep.get("peak_bytes") is not None:
+        lines.append(f"  peak live bytes: {rep['peak_bytes']}")
+    return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def forensics(point: str):
+    """Wrap an allocation path: an exception :func:`is_oom` recognises
+    increments ``memory.oom_events{point=}`` and logs ONE warning with
+    the :func:`format_oom_report` dump before re-raising — the error
+    finally names its crowd, not just its size. Non-OOM errors pass
+    through untouched."""
+    try:
+        yield
+    except BaseException as e:
+        if is_oom(e):
+            _r.counter("memory.oom_events", point=point).inc()
+            try:
+                from cylon_tpu.utils.logging import get_logger
+
+                get_logger().warning(
+                    "allocation failure in %s (%s: %s)\n%s", point,
+                    type(e).__name__, e, format_oom_report())
+            except Exception:  # forensics must never mask the OOM
+                pass
+        raise

@@ -1,0 +1,475 @@
+"""Per-query EXPLAIN / ANALYZE: pre-execution plans and per-request
+execution profiles.
+
+The missing answer to "where did *this* query's time and HBM go?".
+Everything here is assembled from machinery previous PRs already
+built — span timers (:data:`cylon_tpu.utils.tracing.SPAN_METRIC`),
+watchdog section histograms, ``_note_exchange`` byte pricing, the
+plan-cache counters, spill/retry/fault counters and the
+:mod:`cylon_tpu.telemetry.memory` watermarks — no new instrumentation
+runs inside device code.
+
+**EXPLAIN** (:func:`explain`): the pre-execution view of a query —
+the relational ops its code reaches, each input's true rows /
+power-of-2 bucket / buffer capacity / bytes, the row hint and
+capacity scale a :class:`~cylon_tpu.plan.CompiledQuery` would dispatch
+at, and whether that dispatch would be a plan-cache hit or a fresh
+trace (:func:`cylon_tpu.plan.plan_cache_stats` state). Nothing is
+executed and nothing compiles.
+
+**ANALYZE** (:class:`RequestProfiler` → ``QueryTicket.profile()``):
+the serve scheduler runs request steps one at a time on ONE thread,
+so a registry delta bracketed around a step is attributable to that
+request — the profiler snapshots the relevant counter/timer series
+before each step, accumulates the deltas, and samples the memory
+gauges at the step boundary. The rendered profile carries per-stage
+walls, rows/bytes per operator, the compile-vs-execute split
+(``plan.dispatch`` span on a cache miss is trace+compile; the
+``plan.fetch`` span and ``overflow_fetch`` section are the execution
+wait), headroom, spill bytes, retries/faults and the HBM peak
+watermark. Field set pinned by :data:`REQUIRED_PROFILE_FIELDS`
+(bench-guard enforced).
+
+Cost model: two registry scans plus one memory sample per step —
+host-side dict walks, no device syncs. ``CYLON_TPU_SERVE_PROFILE=0``
+disables per-request profiling entirely.
+"""
+
+import contextlib
+import os
+import time
+
+from cylon_tpu.telemetry import registry as _r
+from cylon_tpu.telemetry.export import json_safe
+
+__all__ = [
+    "REQUIRED_PROFILE_FIELDS", "profiling_enabled", "RequestProfiler",
+    "explain", "explain_text", "profile_text",
+]
+
+#: every ``QueryTicket.profile()`` dict carries these keys — the schema
+#: ``tests/test_bench_guard.py`` pins so a refactor cannot silently
+#: drop the attribution columns the perf trajectory reads.
+REQUIRED_PROFILE_FIELDS = (
+    "rid", "tenant", "state", "slo_s", "queue_wait_s", "wall_s",
+    "steps", "stages", "operators", "compile", "memory", "spill",
+    "faults", "plan_cache", "headroom_ratio", "stage_walls_s",
+    "stage_coverage",
+)
+
+
+def profiling_enabled() -> bool:
+    """Per-request ANALYZE profiles on? (``CYLON_TPU_SERVE_PROFILE``,
+    default yes — the cost is two registry walks per step.)"""
+    return os.environ.get("CYLON_TPU_SERVE_PROFILE", "1") not in (
+        "0", "off", "false")
+
+
+#: counter metrics the per-step delta tracks, keyed per label series.
+#: The serve scheduler's one-step-at-a-time execution makes the delta
+#: attributable; rare off-thread increments (an exporter, a client
+#: submit) touch none of these names.
+_COUNTERS = (
+    "exchange.calls", "exchange.rows", "exchange.bytes_true",
+    "exchange.bytes_padded", "exchange.tight_dispatches",
+    "exchange.fallback_regrows", "plan.compile_count",
+    "plan.cache_hits", "plan.cache_misses", "plan.overflow_events",
+    "plan.capacity_rescales", "plan.prefetch_bytes",
+    "spill.read_bytes", "spill.write_bytes", "resilience.retries",
+    "resilience.faults_injected", "ooc.chunks", "ooc.rows_out",
+)
+
+_SPAN_METRIC = "tracing.span_seconds"
+_SECTION_METRIC = "watchdog.section_seconds"
+
+#: span names excluded from profile attribution: the serve step span
+#: wraps the entire step (it IS the wall, not a stage of it).
+_SELF_SPANS = frozenset({"serve.step"})
+
+
+def _grab():
+    """One registry snapshot of the profile-relevant series:
+    ``(counters, spans, sections)`` where counters map
+    ``(name, op_label) -> value`` and spans/sections map
+    ``name -> cumulative seconds``."""
+    counters: dict = {}
+    spans: dict = {}
+    sections: dict = {}
+    want = set(_COUNTERS)
+    for name, labels, inst in _r.instruments():
+        if name in want:
+            lab = (labels.get("op") or labels.get("site")
+                   or labels.get("kind") or labels.get("point")
+                   or labels.get("code") or "")
+            key = (name, lab)
+            counters[key] = counters.get(key, 0) + inst.value
+        elif name == _SPAN_METRIC:
+            sname = labels.get("name", "?")
+            if sname not in _SELF_SPANS:
+                spans[sname] = spans.get(sname, 0.0) + inst.sum
+        elif name == _SECTION_METRIC:
+            sec = labels.get("section", "?")
+            sections[sec] = sections.get(sec, 0.0) + inst.sum
+    return counters, spans, sections
+
+
+def _diff(cur: dict, prev: dict, into: dict) -> None:
+    for k, v in cur.items():
+        d = v - prev.get(k, 0)
+        if d:
+            into[k] = into.get(k, 0) + d
+
+
+class RequestProfiler:
+    """Accumulates one request's ANALYZE profile across its steps.
+
+    Created at admission (``ServeEngine.submit``) and advanced by the
+    scheduler via :meth:`step` around each ``_QueryOp`` step; rendered
+    on demand by ``QueryTicket.profile()``. Not thread-safe by design:
+    only the scheduler thread writes it (the one-step-at-a-time
+    execution model is what makes the deltas attributable at all)."""
+
+    def __init__(self):
+        import threading
+
+        # the scheduler thread writes (step); any client/HTTP thread
+        # may read (render) while the request is LIVE — the lock keeps
+        # a concurrent render from iterating a dict mid-insert
+        self._mu = threading.Lock()
+        self.steps = 0
+        self.counters: dict = {}
+        self.spans: dict = {}
+        self.sections: dict = {}
+        self.step_wall_s = 0.0
+        self.mem_start: "int | None" = None
+        self.mem_peak: "int | None" = None
+        self.mem_end: "int | None" = None
+
+    @contextlib.contextmanager
+    def step(self):
+        """Bracket one scheduler step: registry delta + boundary
+        memory sample."""
+        from cylon_tpu.telemetry import memory
+
+        sampling = memory.enabled()
+        c0, s0, w0 = _grab()
+        if sampling and self.mem_start is None:
+            self.mem_start = memory.sample(op="serve_request",
+                                           force=True)
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            c1, s1, w1 = _grab()
+            # memory.sample()'s disabled path returns a 0 SENTINEL —
+            # recording it would fake a zero-residency measurement
+            m = (memory.sample(op="serve_request", force=True)
+                 if sampling else None)
+            with self._mu:
+                self.step_wall_s += dt
+                self.steps += 1
+                _diff(c1, c0, self.counters)
+                _diff(s1, s0, self.spans)
+                _diff(w1, w0, self.sections)
+                if m is not None:
+                    self.mem_end = m
+                    if self.mem_peak is None or m > self.mem_peak:
+                        self.mem_peak = m
+
+    # ------------------------------------------------------- rendering
+    @staticmethod
+    def _counter(counters: dict, name: str):
+        return sum(v for (n, _), v in counters.items() if n == name)
+
+    def render(self, ticket) -> dict:
+        """The ANALYZE profile dict (:data:`REQUIRED_PROFILE_FIELDS`).
+
+        ``stages`` is the per-stage wall map: sub-stage spans (names
+        with a dot — ``dist_join.dispatch``, ``plan.fetch``, ...) plus
+        watchdog sections. ``operators`` merges each top-level op
+        span's wall with its exchange pricing deltas. The coverage
+        metric ``stage_walls_s`` sums non-nested units only — op
+        seconds that fit inside the ``plan.dispatch`` span are assumed
+        nested in it (a cache-miss dispatch TRACES the query fn, op
+        spans included), so the fraction can only undercount, never
+        exceed the wall by double counting.
+        """
+        now = time.monotonic()
+        started = ticket.started if ticket.started is not None else now
+        finished = ticket.finished if ticket.finished is not None \
+            else now
+        wall = max(finished - started, 0.0)
+        with self._mu:  # consistent copy vs a concurrent step()
+            steps = self.steps
+            counters = dict(self.counters)
+            spans = dict(self.spans)
+            sections = dict(self.sections)
+            mem_start, mem_peak, mem_end = (self.mem_start,
+                                            self.mem_peak,
+                                            self.mem_end)
+        stages = {n: s for n, s in spans.items() if "." in n}
+        stages.update({f"section:{n}": s
+                       for n, s in sections.items()
+                       if n != "serve_request"})
+        operators: dict = {}
+        for n, s in spans.items():
+            if "." not in n:
+                operators[n] = {"wall_s": s}
+        for (name, op), v in counters.items():
+            if not name.startswith("exchange.") or not op:
+                continue
+            d = operators.setdefault(op, {})
+            d[name.split(".", 1)[1]] = d.get(
+                name.split(".", 1)[1], 0) + v
+        top_walls = sum(d.get("wall_s", 0.0)
+                        for d in operators.values())
+        dispatch_s = spans.get("plan.dispatch", 0.0)
+        plan_walls = dispatch_s + spans.get("plan.fetch", 0.0)
+        # no overcount: on a plan-cache miss the query fn TRACES inside
+        # the plan.dispatch span, so its op spans are nested in it —
+        # assume worst-case overlap (every op second that fits inside
+        # dispatch happened there) so coverage can only UNDERcount
+        stage_walls = plan_walls + max(0.0, top_walls - dispatch_s)
+        # worst (max) last-observed headroom across the per-op gauge
+        # series — a process-wide gauge, like bench_metrics reports it
+        headroom = None
+        for _, _, inst in _r.instruments("exchange.headroom_ratio"):
+            v = json_safe(inst.value)
+            if isinstance(v, (int, float)):
+                headroom = v if headroom is None else max(headroom, v)
+        misses = self._counter(counters, "plan.cache_misses")
+        prof = {
+            "rid": ticket.rid,
+            "tenant": ticket.tenant,
+            "state": ticket.state,
+            "slo_s": ticket.slo,
+            "queue_wait_s": max(started - ticket.submitted, 0.0),
+            "wall_s": wall,
+            "steps": steps,
+            "stages": stages,
+            "operators": operators,
+            "compile": {
+                # the split: a cache-miss dispatch span is dominated
+                # by trace+compile; fetch (and the overflow_fetch
+                # section inside it) is the wait on real execution
+                "compile_count": self._counter(
+                    counters, "plan.compile_count"),
+                "cache_hits": self._counter(
+                    counters, "plan.cache_hits"),
+                "cache_misses": misses,
+                "dispatch_s": spans.get("plan.dispatch", 0.0),
+                "execute_s": spans.get("plan.fetch", 0.0),
+            },
+            "memory": {
+                "live_bytes_start": mem_start,
+                "live_bytes_peak": mem_peak,
+                "live_bytes_end": mem_end,
+            },
+            "spill": {
+                "read_bytes": self._counter(
+                    counters, "spill.read_bytes"),
+                "write_bytes": self._counter(
+                    counters, "spill.write_bytes"),
+            },
+            "faults": {
+                "retries": self._counter(
+                    counters, "resilience.retries"),
+                "injected": self._counter(
+                    counters, "resilience.faults_injected"),
+                "overflow_events": self._counter(
+                    counters, "plan.overflow_events"),
+                "capacity_rescales": self._counter(
+                    counters, "plan.capacity_rescales"),
+            },
+            "plan_cache": {
+                "hits": self._counter(counters, "plan.cache_hits"),
+                "misses": misses,
+            },
+            "headroom_ratio": headroom,
+            "stage_walls_s": stage_walls,
+            "stage_coverage": (stage_walls / wall if wall > 0
+                               else None),
+        }
+        return json_safe(prof)
+
+
+# ----------------------------------------------------------- EXPLAIN
+#: relational-op vocabulary the static scan recognises in a query
+#: function's code objects — the pre-execution "ops" line of EXPLAIN.
+_OP_NAMES = frozenset({
+    "join", "dist_join", "colocated_join", "groupby",
+    "groupby_aggregate", "dist_groupby", "colocated_groupby",
+    "dist_sort", "sort_table", "sort_values", "shuffle",
+    "repartition", "dist_unique", "unique", "dist_union", "union",
+    "dist_intersect", "intersect", "dist_subtract", "subtract",
+    "dist_aggregate", "dist_filter", "dist_head", "dist_concat",
+    "merge", "head", "select", "filter",
+})
+
+
+def _query_ops(fn) -> list:
+    """Relational ops reachable from ``fn``'s code (static scan of
+    ``co_names`` through nested code objects) — an approximation of
+    the logical plan, honest about its provenance (EXPLAIN labels it
+    ``static_scan``)."""
+    import types
+
+    target = getattr(fn, "_fn", fn)  # unwrap CompiledQuery
+    code = getattr(target, "__code__", None)
+    if code is None:
+        return []
+    seen, todo, ops = set(), [code], []
+    while todo:
+        c = todo.pop()
+        if id(c) in seen:
+            continue
+        seen.add(id(c))
+        # co_names: global/attr loads; co_freevars: ops captured from
+        # an enclosing scope (queries defined inside functions)
+        for name in (*c.co_names, *c.co_freevars):
+            if name in _OP_NAMES and name not in ops:
+                ops.append(name)
+        for const in c.co_consts:
+            if isinstance(const, types.CodeType):
+                todo.append(const)
+    return ops
+
+
+def _input_tables(args, kwargs) -> list:
+    from cylon_tpu.plan import _result_tables
+
+    return _result_tables((list(args), dict(kwargs)))
+
+
+def explain(fn, *args, **kwargs) -> dict:
+    """Pre-execution plan for ``fn(*args, **kwargs)`` — nothing runs,
+    nothing compiles.
+
+    Returns::
+
+        {"query": name, "compiled": bool, "ops": [...],
+         "ops_source": "static_scan",
+         "inputs": [{"rows", "bucket", "capacity", "bytes",
+                     "columns", "distributed"}, ...],
+         "row_hint": pow2-bucket | None, "scale": int,
+         "cache_state": "hit" | "miss" | "untracked",
+         "plan_cache": plan_cache_stats()}
+
+    For a :class:`~cylon_tpu.plan.CompiledQuery` (or
+    ``plan.shared_compiled`` product) the scale / row hint /
+    cache-state are exactly what the next call would dispatch with;
+    for a bare callable they are the defaults a fresh compile would
+    start from.
+    """
+    import jax
+
+    from cylon_tpu import catalog, plan
+    from cylon_tpu.parallel import dtable
+    from cylon_tpu.parallel.dist_ops import batched_true_rows
+    from cylon_tpu.utils import pow2_bucket
+
+    cq = fn if isinstance(fn, plan.CompiledQuery) else None
+    tables = _input_tables(args, kwargs)
+    rows = batched_true_rows(tables) if tables else None
+    inputs = []
+    for i, t in enumerate(tables):
+        r = None if rows is None else rows[i]
+        inputs.append({
+            "rows": r,
+            "bucket": None if r is None else pow2_bucket(r),
+            "capacity": int(t.capacity),
+            "bytes": catalog.table_nbytes(t),
+            "columns": t.num_columns,
+            "distributed": bool(dtable.is_distributed(t)),
+        })
+    hint = None if rows is None else pow2_bucket(max(rows))
+    scale, cache_state = 1, "untracked"
+    if cq is not None:
+        dyn_pos, static_pos, static_kw, dyn_kw = plan._split_args(
+            args, kwargs)
+        key = (static_pos, static_kw)
+        use_hint = (hint if cq._check and plan.tight_enabled()
+                    and plan.adaptive_enabled() else None)
+        shape_sig = tuple(
+            (getattr(x, "shape", None), str(getattr(x, "dtype", "")))
+            for x in jax.tree_util.tree_leaves((tuple(dyn_pos),
+                                                dyn_kw)))
+        with cq._mu:
+            scale = cq._scale_memo.get(key, 1)
+            cache_state = ("hit" if (key, scale, use_hint, shape_sig)
+                           in cq._compiled else "miss")
+        hint = use_hint
+    name = getattr(getattr(fn, "_fn", fn), "__name__",
+                   type(fn).__name__)
+    return json_safe({
+        "query": name,
+        "compiled": cq is not None,
+        "ops": _query_ops(fn),
+        "ops_source": "static_scan",
+        "inputs": inputs,
+        "row_hint": hint,
+        "scale": scale,
+        "cache_state": cache_state,
+        "plan_cache": plan.plan_cache_stats(),
+    })
+
+
+def explain_text(plan_dict: dict) -> str:
+    """Human rendering of an :func:`explain` dict (the worked example
+    in ``docs/observability.md``)."""
+    p = plan_dict
+    lines = [f"EXPLAIN {p['query']} "
+             f"({'compiled' if p['compiled'] else 'eager'}, "
+             f"plan cache: {p['cache_state']})"]
+    if p.get("ops"):
+        lines.append("  ops: " + " -> ".join(p["ops"]))
+    for i, t in enumerate(p.get("inputs", [])):
+        lines.append(
+            f"  input[{i}]: rows={t['rows']} bucket={t['bucket']} "
+            f"capacity={t['capacity']} bytes={t['bytes']} "
+            f"{'distributed' if t['distributed'] else 'local'}")
+    lines.append(f"  row_hint={p['row_hint']} scale={p['scale']}")
+    pc = p.get("plan_cache", {})
+    lines.append(f"  plan cache: {pc.get('hits', 0)} hits / "
+                 f"{pc.get('misses', 0)} misses "
+                 f"(rate {pc.get('hit_rate', 0):.2f})")
+    return "\n".join(lines)
+
+
+def profile_text(prof: dict) -> str:
+    """Human rendering of a ``QueryTicket.profile()`` dict — the
+    ANALYZE half of the worked example."""
+    lines = [f"ANALYZE request {prof['rid']} "
+             f"(tenant {prof['tenant']}, {prof['state']}): "
+             f"wall {prof['wall_s'] * 1e3:.1f} ms, "
+             f"queue {prof['queue_wait_s'] * 1e3:.1f} ms, "
+             f"{prof['steps']} step(s), coverage "
+             f"{(prof['stage_coverage'] or 0) * 100:.0f}%"]
+    for op, d in sorted(prof.get("operators", {}).items(),
+                        key=lambda kv: -kv[1].get("wall_s", 0.0)):
+        lines.append(
+            f"  op {op}: {d.get('wall_s', 0.0) * 1e3:.1f} ms, "
+            f"rows={d.get('rows', 0)} "
+            f"bytes_true={d.get('bytes_true', 0)} "
+            f"bytes_padded={d.get('bytes_padded', 0)}")
+    for n, s in sorted(prof.get("stages", {}).items(),
+                       key=lambda kv: -kv[1]):
+        lines.append(f"    stage {n}: {s * 1e3:.1f} ms")
+    c = prof.get("compile", {})
+    lines.append(f"  compile: {c.get('compile_count', 0)} "
+                 f"program(s), dispatch {c.get('dispatch_s', 0.0) * 1e3:.1f} ms, "
+                 f"execute {c.get('execute_s', 0.0) * 1e3:.1f} ms "
+                 f"({c.get('cache_hits', 0)} hits/"
+                 f"{c.get('cache_misses', 0)} misses)")
+    m = prof.get("memory", {})
+    lines.append(f"  memory: start={m.get('live_bytes_start')} "
+                 f"peak={m.get('live_bytes_peak')} "
+                 f"end={m.get('live_bytes_end')}")
+    s = prof.get("spill", {})
+    f = prof.get("faults", {})
+    lines.append(f"  spill {s.get('read_bytes', 0)}r/"
+                 f"{s.get('write_bytes', 0)}w bytes; retries "
+                 f"{f.get('retries', 0)}, faults "
+                 f"{f.get('injected', 0)}")
+    return "\n".join(lines)

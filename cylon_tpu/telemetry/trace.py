@@ -82,6 +82,7 @@ class TraceRecorder:
         self._lock = threading.Lock()
         self._ids = itertools.count(1)
         self._appended = 0
+        self._warned = False  # first-drop warning fired?
         # wall-aligned monotonic clock: perf_counter resolution for
         # durations, wall epoch so cross-process offsets subtract
         self._epoch = time.time() - time.perf_counter()
@@ -93,9 +94,23 @@ class TraceRecorder:
         return next(self._ids)
 
     def append(self, evt: dict) -> None:
+        warn = False
         with self._lock:
+            if (not self._warned
+                    and len(self._buf) == self._buf.maxlen):
+                # this append evicts the oldest event: the recording
+                # is silently lossy from here on — say so ONCE
+                self._warned = warn = True
             self._buf.append(evt)
             self._appended += 1
+        if warn:
+            from cylon_tpu.utils.logging import get_logger
+
+            get_logger().warning(
+                "trace ring buffer full (%d events): oldest events "
+                "now dropping — raise CYLON_TPU_TRACE_EVENTS or "
+                "export/clear more often (trace.dropped() counts the "
+                "loss)", self._buf.maxlen)
 
     def events(self) -> list:
         with self._lock:
@@ -110,6 +125,7 @@ class TraceRecorder:
         with self._lock:
             self._buf.clear()
             self._appended = 0
+            self._warned = False
 
 
 _LOCK = threading.Lock()

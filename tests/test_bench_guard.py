@@ -459,6 +459,79 @@ def test_durable_mutations_maintain_catalog_snapshot():
     assert _method_calls(methods["drop_table"], "drop")
 
 
+# ------------------------------------------------- ops-plane guards
+#: mutating surfaces an introspection handler must never reach — the
+#: endpoint is read-only by contract (ISSUE 9), and this lint makes
+#: that contract survive future handlers
+_INTROSPECT_FORBIDDEN = frozenset({
+    "submit", "submit_named", "register_table", "register_query",
+    "drop_table", "drop", "remove_table", "put_table", "pin", "unpin",
+    "clear", "reset", "close", "recover", "session", "read_csv",
+    "join_tables", "sort_table", "unique_table",
+})
+
+
+def test_introspect_handlers_are_read_only():
+    """ISSUE 9 satellite: every HTTP handler in serve/introspect.py is
+    statically read-only — no call to any submission/registration/
+    drop/close surface. A future endpoint that mutated engine state
+    would turn an unauthenticated diagnostic port into a control
+    plane."""
+    path = REPO / "cylon_tpu" / "serve" / "introspect.py"
+    tree = ast.parse(path.read_text(), filename=str(path))
+    bad = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Attribute):
+            if node.func.attr in _INTROSPECT_FORBIDDEN:
+                bad.append((node.lineno, node.func.attr))
+    assert not bad, (
+        f"introspect.py reaches mutating surfaces {bad} — the ops "
+        "endpoint must stay read-only")
+    # and the only HTTP verb implemented is GET
+    verbs = {n.name for n in ast.walk(tree)
+             if isinstance(n, _FN) and n.name.startswith("do_")}
+    assert verbs == {"do_GET"}, f"non-GET handlers defined: {verbs}"
+
+
+def test_query_profile_schema_pinned():
+    """ISSUE 9 satellite: a real request's ``QueryTicket.profile()``
+    carries every REQUIRED_PROFILE_FIELDS key and survives a strict
+    JSON round trip."""
+    import json
+
+    from cylon_tpu.serve import ServeEngine, ServePolicy
+    from cylon_tpu.telemetry.profile import REQUIRED_PROFILE_FIELDS
+
+    eng = ServeEngine(policy=ServePolicy(max_queue=2))
+    tk = eng.submit(lambda: 1, tenant="schema")
+    assert tk.result(30) == 1
+    prof = tk.profile()
+    eng.close()
+    assert prof is not None
+    missing = [k for k in REQUIRED_PROFILE_FIELDS if k not in prof]
+    assert not missing, f"profile dropped pinned fields {missing}"
+    json.loads(json.dumps(prof, allow_nan=False))
+
+
+def test_serve_record_schema_pins_attribution_columns():
+    """ISSUE 9 satellite: the serve bench record must keep the slowest
+    request's profile block and the run's HBM peak watermark."""
+    from cylon_tpu.serve.bench import REQUIRED_SERVE_FIELDS
+
+    assert {"slowest_profile",
+            "peak_live_bytes"} <= REQUIRED_SERVE_FIELDS
+
+
+def test_trace_record_schema_pins_dropped_count():
+    """ISSUE 9 satellite: silent trace loss is surfaced — the --trace
+    record must carry trace_dropped so a windowed (lossy) artifact is
+    distinguishable from a complete one."""
+    import bench
+
+    assert "trace_dropped" in bench.REQUIRED_TRACE_FIELDS
+
+
 def test_checker_accepts_closures_and_comprehensions(tmp_path):
     p = tmp_path / "ok.py"
     p.write_text(

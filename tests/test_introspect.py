@@ -1,0 +1,179 @@
+"""serve.introspect — the read-only ops endpoint (ISSUE 9 tentpole
+piece 3): armed ONLY by CYLON_TPU_SERVE_HTTP_PORT, serving live
+engine state while queries are in flight."""
+
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from cylon_tpu import Table, catalog, telemetry
+from cylon_tpu.serve import ServeEngine, ServePolicy
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    catalog.clear()
+    telemetry.reset("serve.")
+    yield
+    catalog.clear()
+    telemetry.reset("serve.")
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        body = r.read()
+        return r.status, r.headers.get("Content-Type", ""), body
+
+
+def _get_json(url):
+    status, ctype, body = _get(url)
+    assert status == 200 and ctype.startswith("application/json")
+    return json.loads(body)
+
+
+def test_unarmed_engine_creates_no_socket_or_thread(monkeypatch):
+    """The fast-path contract the acceptance pins: with the env unset
+    the engine construction adds NO thread and binds NO socket."""
+    monkeypatch.delenv("CYLON_TPU_SERVE_HTTP_PORT", raising=False)
+    before = set(threading.enumerate())
+    eng = ServeEngine(policy=ServePolicy(max_queue=2))
+    assert eng._http is None and eng.http_address is None
+    assert set(threading.enumerate()) == before
+    # and no introspect thread appears even after requests run
+    assert eng.submit(lambda: 1, tenant="a").result(30) == 1
+    assert not any(t.name == "cylon-serve-introspect"
+                   for t in threading.enumerate())
+    eng.close()
+
+
+def test_endpoints_serve_live_state_during_requests(monkeypatch):
+    monkeypatch.setenv("CYLON_TPU_SERVE_HTTP_PORT", "0")
+    catalog.put_table("resident", Table.from_pydict(
+        {"k": np.arange(16, dtype=np.int64)}))
+    eng = ServeEngine(policy=ServePolicy(max_queue=8))
+    assert any(t.name == "cylon-serve-introspect"
+               for t in threading.enumerate())
+    host, port = eng.http_address
+    base = f"http://{host}:{port}"
+
+    gate = threading.Event()
+
+    def gated():
+        while not gate.is_set():
+            yield
+            time.sleep(0.001)
+        return "done"
+
+    t1 = eng.submit(gated, tenant="alice", slo=60.0,
+                    tables=["resident"])
+    t2 = eng.submit(gated, tenant="bob")
+    # wait until both are live in the schedule
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        qs = _get_json(base + "/queries")["queries"]
+        if len(qs) == 2:
+            break
+        time.sleep(0.01)
+    assert {q["tenant"] for q in qs} == {"alice", "bob"}
+    alice = next(q for q in qs if q["tenant"] == "alice")
+    assert alice["state"] in ("queued", "running")
+    assert alice["elapsed_s"] >= 0
+    assert alice["remaining_slo_s"] is not None \
+        and alice["remaining_slo_s"] <= 60.0
+    bob = next(q for q in qs if q["tenant"] == "bob")
+    assert bob["remaining_slo_s"] is None  # unbounded
+
+    h = _get_json(base + "/healthz")
+    assert h["status"] == "ok" and h["live"] == 2
+    assert h["uptime_s"] > 0
+
+    tables = _get_json(base + "/tables")
+    assert tables["resident"]["rows"] == 16
+    assert tables["resident"]["pins"] == 1  # alice's request pin
+    assert sum(tables["resident"]["bytes_by_device"].values()) \
+        == tables["resident"]["bytes"]
+
+    status, ctype, body = _get(base + "/metrics")
+    assert status == 200 and ctype.startswith("text/plain")
+    text = body.decode()
+    assert "cylon_serve_requests" in text
+    assert "# TYPE" in text
+
+    gate.set()
+    assert t1.result(30) == "done" and t2.result(30) == "done"
+
+    tenants = _get_json(base + "/tenants")
+    assert tenants["alice"]["completed"] == 1
+    assert tenants["bob"]["completed"] == 1
+
+    prof = _get_json(f"{base}/profiles/{t1.rid}")
+    assert prof["rid"] == t1.rid and prof["tenant"] == "alice"
+    assert prof["state"] == "done"
+
+    # landing page + 404s
+    assert "/metrics" in _get_json(base + "/")["endpoints"]
+    for bad in ("/profiles/999999", "/nope"):
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _get(base + bad)
+        assert ei.value.code == 404
+    eng.close()
+    # the port is released on close
+    with pytest.raises((ConnectionError, urllib.error.URLError,
+                        socket.timeout, OSError)):
+        _get(base + "/healthz", timeout=2)
+
+
+def test_startup_failure_degrades_never_kills_engine(monkeypatch):
+    """A malformed port or an already-bound one must not take down
+    engine construction (least of all recover()) — the ops plane
+    degrades to off with a loud warning."""
+    monkeypatch.setenv("CYLON_TPU_SERVE_HTTP_PORT", "not-a-port")
+    eng = ServeEngine(policy=ServePolicy(max_queue=2))
+    assert eng._http is None
+    assert eng.submit(lambda: 1, tenant="a").result(30) == 1
+    eng.close()
+
+    monkeypatch.setenv("CYLON_TPU_SERVE_HTTP_PORT", "0")
+    holder = ServeEngine(policy=ServePolicy(max_queue=2))
+    _, port = holder.http_address
+    monkeypatch.setenv("CYLON_TPU_SERVE_HTTP_PORT", str(port))
+    clashed = ServeEngine(policy=ServePolicy(max_queue=2))
+    assert clashed._http is None  # EADDRINUSE: degraded, not dead
+    assert clashed.submit(lambda: 2, tenant="b").result(30) == 2
+    clashed.close()
+    holder.close()
+
+
+def test_profiles_endpoint_respects_profile_optout(monkeypatch):
+    monkeypatch.setenv("CYLON_TPU_SERVE_HTTP_PORT", "0")
+    monkeypatch.setenv("CYLON_TPU_SERVE_PROFILE", "0")
+    eng = ServeEngine(policy=ServePolicy(max_queue=2))
+    tk = eng.submit(lambda: 1, tenant="a")
+    assert tk.result(30) == 1
+    host, port = eng.http_address
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(f"http://{host}:{port}/profiles/{tk.rid}")
+    assert ei.value.code == 404
+    eng.close()
+
+
+def test_handler_error_returns_500_not_thread_death(monkeypatch):
+    monkeypatch.setenv("CYLON_TPU_SERVE_HTTP_PORT", "0")
+    eng = ServeEngine(policy=ServePolicy(max_queue=2))
+    host, port = eng.http_address
+    base = f"http://{host}:{port}"
+    # break tenant_stats -> the handler 500s but the server survives
+    orig = eng.tenant_stats
+    eng.tenant_stats = lambda: 1 / 0
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(base + "/tenants")
+    assert ei.value.code == 500
+    eng.tenant_stats = orig
+    assert _get_json(base + "/healthz")["status"] == "ok"
+    eng.close()

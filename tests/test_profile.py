@@ -1,0 +1,277 @@
+"""telemetry.profile — EXPLAIN plans and per-request ANALYZE profiles
+(ISSUE 9 tentpole piece 1).
+
+Includes the acceptance scenario: a 1M-row dist_join submitted through
+the serve engine must yield a ``QueryTicket.profile()`` whose stage
+walls sum to >= 80% of the request wall, with non-zero exchange bytes
+and a recorded HBM peak watermark.
+"""
+
+import numpy as np
+import pytest
+
+from cylon_tpu import Table, telemetry
+from cylon_tpu.serve import ServeEngine, ServePolicy
+from cylon_tpu.telemetry import profile as prof_mod
+from cylon_tpu.telemetry.profile import (REQUIRED_PROFILE_FIELDS,
+                                         explain, explain_text,
+                                         profile_text)
+
+
+def _t(n=64):
+    return Table.from_pydict({
+        "k": (np.arange(n, dtype=np.int64) % 4),
+        "v": np.ones(n, dtype=np.float64)})
+
+
+# ----------------------------------------------------------- EXPLAIN
+def test_explain_eager_callable_lists_ops_and_inputs():
+    from cylon_tpu.ops.groupby import groupby_aggregate
+
+    def q(t):
+        return groupby_aggregate(t, ["k"], [("v", "sum", "s")])
+
+    p = explain(q, _t(64))
+    assert p["query"] == "q" and p["compiled"] is False
+    assert "groupby_aggregate" in p["ops"]
+    assert p["ops_source"] == "static_scan"
+    (inp,) = p["inputs"]
+    assert inp["rows"] == 64 and inp["bucket"] == 64
+    assert inp["capacity"] == 64 and not inp["distributed"]
+    assert inp["bytes"] == 64 * 8 * 2
+    assert p["cache_state"] == "untracked"
+    text = explain_text(p)
+    assert "groupby_aggregate" in text and "rows=64" in text
+
+
+def test_explain_compiled_reports_cache_state_transition():
+    from cylon_tpu import plan
+    from cylon_tpu.ops.groupby import groupby_aggregate
+
+    def q_explain(t):
+        return groupby_aggregate(t, ["k"], [("v", "sum", "s")])
+
+    cq = plan.compile_query(q_explain)
+    before = explain(cq, _t(64))
+    assert before["compiled"] is True
+    assert before["cache_state"] == "miss"
+    assert before["scale"] == 1
+    cq(_t(64))  # executes + compiles
+    after = explain(cq, _t(64))
+    assert after["cache_state"] == "hit"
+    # a different pow2 input bucket is a different program: miss again
+    assert explain(cq, _t(256))["cache_state"] == "miss"
+    # EXPLAIN itself never executes: plan-cache counters unmoved
+    hits = telemetry.total("plan.cache_hits")
+    explain(cq, _t(64))
+    assert telemetry.total("plan.cache_hits") == hits
+
+
+# ----------------------------------------------------------- ANALYZE
+def test_profile_schema_and_operator_attribution():
+    from cylon_tpu.ops.groupby import groupby_aggregate
+
+    def q():
+        from cylon_tpu.utils import tracing
+
+        with tracing.span("fake_op"):
+            return int(groupby_aggregate(
+                _t(64), ["k"], [("v", "sum", "s")]).num_rows)
+
+    eng = ServeEngine(policy=ServePolicy(max_queue=2))
+    tk = eng.submit(q, tenant="alice", slo=60.0)
+    assert tk.result(30) == 4
+    p = tk.profile()
+    eng.close()
+    missing = [k for k in REQUIRED_PROFILE_FIELDS if k not in p]
+    assert not missing, missing
+    assert p["rid"] == tk.rid and p["tenant"] == "alice"
+    assert p["state"] == "done" and p["steps"] == 1
+    assert p["slo_s"] == 60.0
+    assert p["wall_s"] > 0 and p["queue_wait_s"] >= 0
+    # the span recorded inside the step is attributed as an operator
+    assert "fake_op" in p["operators"]
+    assert p["operators"]["fake_op"]["wall_s"] > 0
+    assert profile_text(p).startswith("ANALYZE request")
+
+
+def test_profile_compile_vs_execute_split_on_compiled_query():
+    from cylon_tpu import plan
+    from cylon_tpu.ops.groupby import groupby_aggregate
+
+    def q_split(t):
+        return groupby_aggregate(t, ["k"], [("v", "sum", "s")])
+
+    cq = plan.shared_compiled(q_split)
+    eng = ServeEngine(policy=ServePolicy(max_queue=2))
+    tk = eng.submit(lambda: int(cq(_t(64)).num_rows), tenant="c")
+    assert tk.result(60) == 4
+    p = tk.profile()
+    # second, cache-warm request: dispatch still happens, compile does
+    # not
+    tk2 = eng.submit(lambda: int(cq(_t(64)).num_rows), tenant="c")
+    assert tk2.result(60) == 4
+    p2 = tk2.profile()
+    eng.close()
+    assert p["compile"]["cache_misses"] >= 1
+    assert p["compile"]["dispatch_s"] > 0
+    assert p["compile"]["execute_s"] > 0
+    assert "plan.dispatch" in p["stages"]
+    assert p2["compile"]["cache_hits"] >= 1
+    assert p2["compile"]["cache_misses"] == 0
+    # the warm dispatch is far cheaper than the cold (traced) one
+    assert p2["compile"]["dispatch_s"] < p["compile"]["dispatch_s"]
+    # no overlap overcount: op spans fired during the trace are
+    # folded into plan.dispatch, so coverage stays a true fraction
+    for prof in (p, p2):
+        assert prof["stage_coverage"] is None or \
+            prof["stage_coverage"] <= 1.0 + 1e-6, prof
+
+
+def test_profile_memory_block_unknown_when_sampling_off(monkeypatch):
+    """CYLON_TPU_MEMORY_SAMPLING=0 with profiling on: the memory
+    block reports None (unknown), never a fake 0-byte measurement."""
+    monkeypatch.setenv("CYLON_TPU_MEMORY_SAMPLING", "0")
+    eng = ServeEngine(policy=ServePolicy(max_queue=2))
+    tk = eng.submit(lambda: 1, tenant="nomem")
+    assert tk.result(30) == 1
+    m = tk.profile()["memory"]
+    eng.close()
+    assert m == {"live_bytes_start": None, "live_bytes_peak": None,
+                 "live_bytes_end": None}
+
+
+def test_profile_render_safe_against_concurrent_steps():
+    """A live profile() poll racing the scheduler's per-step delta
+    accumulation must never raise (the /profiles endpoint polls
+    in-flight requests)."""
+    import threading
+
+    gate = threading.Event()
+
+    def churn():
+        from cylon_tpu.utils import tracing
+
+        i = 0
+        while not gate.is_set():
+            with tracing.span(f"churn_op_{i % 97}"):
+                pass
+            telemetry.counter("exchange.rows",
+                              op=f"op{i % 53}").inc(1)
+            i += 1
+            yield
+        return i
+
+    eng = ServeEngine(policy=ServePolicy(max_queue=2))
+    tk = eng.submit(churn, tenant="race")
+    errors = []
+    t_end = __import__("time").monotonic() + 1.5
+    while __import__("time").monotonic() < t_end:
+        try:
+            tk.profile()
+        except Exception as e:  # the race under test
+            errors.append(e)
+            break
+    gate.set()
+    assert tk.result(30) >= 1
+    eng.close()
+    assert not errors, errors
+
+
+def test_profile_disabled_by_env(monkeypatch):
+    monkeypatch.setenv("CYLON_TPU_SERVE_PROFILE", "0")
+    eng = ServeEngine(policy=ServePolicy(max_queue=2))
+    tk = eng.submit(lambda: 1, tenant="off")
+    assert tk.result(30) == 1
+    assert tk.profile() is None
+    eng.close()
+
+
+def test_profile_live_while_running():
+    import threading
+
+    gate = threading.Event()
+
+    def gated():
+        while not gate.is_set():
+            yield
+        return "ok"
+
+    eng = ServeEngine(policy=ServePolicy(max_queue=2))
+    tk = eng.submit(gated, tenant="live")
+    # wait for at least one step to land, then read a LIVE profile
+    for _ in range(200):
+        p = tk.profile()
+        if p["steps"] >= 1:
+            break
+        import time
+
+        time.sleep(0.01)
+    assert p["state"] in ("queued", "running")
+    assert p["steps"] >= 1
+    gate.set()
+    assert tk.result(30) == "ok"
+    assert tk.profile()["state"] == "done"
+    eng.close()
+
+
+def test_faults_and_spill_ride_the_profile():
+    from cylon_tpu.resilience import FaultPlan, FaultRule, inject
+
+    plan = FaultPlan([FaultRule("worker", times=0)])
+
+    def q():
+        try:
+            inject("worker")
+        except Exception:
+            pass
+        return 5
+
+    eng = ServeEngine(policy=ServePolicy(max_queue=2))
+    tk = eng.submit(q, tenant="faulty", fault_plan=plan)
+    assert tk.result(30) == 5
+    p = tk.profile()
+    eng.close()
+    assert p["faults"]["injected"] >= 1
+
+
+# -------------------------------------------------------- acceptance
+def test_acceptance_1m_dist_join_profile(env8, rng):
+    """ISSUE 9 acceptance: a 1M+-row dist_join's profile stage walls
+    sum to >= 80% of the request wall, with non-zero exchange bytes
+    and a recorded HBM peak watermark."""
+    from cylon_tpu.parallel import dist_join, dtable, scatter_table
+
+    n = 1_000_000
+    lt = scatter_table(env8, Table.from_pydict({
+        "k": rng.integers(0, n, n).astype(np.int64),
+        "a": rng.normal(size=n)}))
+    rt = scatter_table(env8, Table.from_pydict({
+        "k": rng.integers(0, n, n).astype(np.int64),
+        "b": rng.normal(size=n)}))
+
+    def q():
+        out = dist_join(env8, lt, rt, on="k", how="inner")
+        return dtable.dist_num_rows(out)
+
+    eng = ServeEngine(env8, ServePolicy(max_queue=2))
+    tk = eng.submit(q, tenant="acceptance")
+    rows = tk.result(240)
+    p = tk.profile()
+    eng.close()
+    assert rows > 0
+    assert p["stage_coverage"] >= 0.8, p
+    assert p["stage_walls_s"] >= 0.8 * p["wall_s"]
+    dj = p["operators"]["dist_join"]
+    assert dj["bytes_true"] > 0 and dj["rows"] >= n
+    assert dj["wall_s"] > 0
+    assert p["memory"]["live_bytes_peak"] is not None
+    assert p["memory"]["live_bytes_peak"] > 0
+    # the tight-capacity dispatch published a headroom gauge, and the
+    # profile surfaces it (was silently None before the op-label fix)
+    assert p["headroom_ratio"] is not None and p["headroom_ratio"] > 0
+    # the per-op HBM watermark landed too
+    from cylon_tpu.telemetry import memory
+
+    assert (memory.peak_live_bytes(op="dist_join") or
+            memory.peak_live_bytes(op="serve_request") or 0) > 0

@@ -1,0 +1,190 @@
+"""Prometheus exposition-format validity (ISSUE 9 satellite): the
+text the ops endpoint serves must parse under a *strict* grammar —
+label escaping, exact-integer counters, no NaN/inf — pinning the PR 3
+formatter against a real scraper's rules instead of "it looks right".
+"""
+
+import json
+import re
+import urllib.request
+
+import pytest
+
+from cylon_tpu import telemetry
+from cylon_tpu.serve import ServeEngine, ServePolicy
+
+# ---------------------------------------------------- strict grammar
+# https://prometheus.io/docs/instrumenting/exposition_formats/
+_NAME = r"[a-zA-Z_:][a-zA-Z0-9_:]*"
+_LABEL_NAME = r"[a-zA-Z_][a-zA-Z0-9_]*"
+_TYPE_LINE = re.compile(
+    rf"^# TYPE ({_NAME}) (counter|gauge|histogram|summary|untyped)$")
+_METRIC_LINE = re.compile(
+    rf"^({_NAME})(\{{.*\}})? (\S+)( [0-9]+)?$")
+# a float the exposition format accepts — deliberately EXCLUDES
+# NaN/Inf spellings: this engine's contract is that non-finite values
+# are dropped before export, so the strict parser refuses them
+_VALUE = re.compile(
+    r"^[+-]?(?:[0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?|\.[0-9]+"
+    r"(?:[eE][+-]?[0-9]+)?)$")
+_INT = re.compile(r"^[+-]?[0-9]+$")
+
+
+def _parse_labels(block: str) -> dict:
+    """Strict label-block parser: ``{k="v",...}`` with ONLY the three
+    legal escapes (backslash, double quote, newline) inside values."""
+    assert block.startswith("{") and block.endswith("}"), block
+    body = block[1:-1]
+    out = {}
+    i = 0
+    while i < len(body):
+        m = re.match(rf"({_LABEL_NAME})=\"", body[i:])
+        assert m, f"bad label at {body[i:]!r}"
+        name = m.group(1)
+        i += m.end()
+        val = []
+        while True:
+            assert i < len(body), "unterminated label value"
+            c = body[i]
+            if c == "\\":
+                assert i + 1 < len(body), "dangling backslash"
+                esc = body[i + 1]
+                assert esc in ("\\", '"', "n"), \
+                    f"illegal escape \\{esc}"
+                val.append("\n" if esc == "n" else esc)
+                i += 2
+            elif c == '"':
+                i += 1
+                break
+            else:
+                assert c != "\n", "raw newline inside label value"
+                val.append(c)
+                i += 1
+        out[name] = "".join(val)
+        if i < len(body):
+            assert body[i] == ",", f"expected ',' at {body[i:]!r}"
+            i += 1
+    return out
+
+
+def parse_exposition(text: str) -> dict:
+    """Parse a full exposition payload strictly; returns
+    ``{metric_name: {"type": t, "samples": [(labels, raw_value)]}}``.
+    Raises AssertionError on any grammar violation."""
+    metrics: dict = {}
+    current_type: dict = {}
+    assert text == "" or text.endswith("\n"), \
+        "payload must end with a newline"
+    for line in text.splitlines():
+        if not line:
+            continue
+        if line.startswith("#"):
+            m = _TYPE_LINE.match(line)
+            assert m, f"malformed comment/type line: {line!r}"
+            current_type[m.group(1)] = m.group(2)
+            continue
+        m = _METRIC_LINE.match(line)
+        assert m, f"malformed sample line: {line!r}"
+        name, labels, value = m.group(1), m.group(2), m.group(3)
+        assert _VALUE.match(value), \
+            f"illegal value {value!r} in {line!r} (NaN/Inf or junk)"
+        lab = _parse_labels(labels) if labels else {}
+        base = re.sub(r"_(bucket|sum|count)$", "", name)
+        typed = current_type.get(name) or current_type.get(base)
+        assert typed, f"sample {name!r} missing its # TYPE line"
+        entry = metrics.setdefault(base if typed == "histogram"
+                                   else name,
+                                   {"type": typed, "samples": []})
+        entry["samples"].append((name, lab, value))
+    return metrics
+
+
+# ------------------------------------------------------------- tests
+def test_parser_rejects_bad_payloads():
+    for bad in (
+        'metric{x="a} 1\n',                 # unterminated label
+        'metric{x="a"} NaN\n',              # non-finite value
+        'metric{x="a"} +Inf\n',             # non-finite value
+        'metric{x="a\\q"} 1\n',             # illegal escape
+        '1metric 1\n',                      # bad metric name
+    ):
+        with pytest.raises(AssertionError):
+            parse_exposition("# TYPE metric gauge\n" + bad)
+
+
+def test_live_export_round_trips_strict_grammar():
+    """Adversarial series — label values with quotes, backslashes and
+    newlines, a GB-scale integer counter, a histogram, a non-finite
+    gauge — must export to a payload the strict parser accepts, with
+    counters as exact integers and the NaN gauge dropped."""
+    telemetry.reset("promtest.")
+    telemetry.counter("promtest.bytes",
+                      op='evil"quote', path="back\\slash").inc(
+                          10**12 + 7)
+    telemetry.counter("promtest.calls", op="line\nbreak").inc(3)
+    telemetry.gauge("promtest.bad").set(float("nan"))
+    telemetry.gauge("promtest.inf").set(float("inf"))
+    telemetry.timer("promtest.seconds", op="t").observe(0.25)
+    text = telemetry.to_prometheus()
+    parsed = parse_exposition(text)
+    telemetry.reset("promtest.")
+
+    byt = parsed["cylon_promtest_bytes"]
+    assert byt["type"] == "counter"
+    ((_, labels, value),) = byt["samples"]
+    assert labels == {"op": 'evil"quote', "path": "back\\slash"}
+    assert _INT.match(value), f"counter not exact-integer: {value!r}"
+    assert int(value) == 10**12 + 7
+
+    ((_, labels2, v2),) = parsed["cylon_promtest_calls"]["samples"]
+    assert labels2 == {"op": "line\nbreak"} and int(v2) == 3
+
+    # non-finite gauges are DROPPED, not serialized
+    assert "cylon_promtest_bad" not in parsed
+    assert "cylon_promtest_inf" not in parsed
+
+    hist = parsed["cylon_promtest_seconds"]
+    assert hist["type"] == "histogram"
+    names = {n for n, _, _ in hist["samples"]}
+    assert {"cylon_promtest_seconds_bucket",
+            "cylon_promtest_seconds_sum",
+            "cylon_promtest_seconds_count"} <= names
+    # bucket counts are cumulative and end at the total count
+    buckets = [(lab, v) for n, lab, v in hist["samples"]
+               if n.endswith("_bucket")]
+    counts = [int(v) for _, v in buckets]
+    assert counts == sorted(counts), "bucket counts not cumulative"
+    assert buckets[-1][0]["le"] == "+inf"
+    (total,) = [int(v) for n, _, v in hist["samples"]
+                if n.endswith("_count")]
+    assert counts[-1] == total == 1
+
+
+def test_http_metrics_payload_is_strictly_valid(monkeypatch):
+    """The round trip the satellite names: the LIVE ``/metrics``
+    payload — served by the ops endpoint mid-engine-lifetime, gnarly
+    series included — parses under the strict grammar."""
+    monkeypatch.setenv("CYLON_TPU_SERVE_HTTP_PORT", "0")
+    telemetry.counter("promtest.http", tenant='t"x\\y').inc(2**40)
+    eng = ServeEngine(policy=ServePolicy(max_queue=2))
+    assert eng.submit(lambda: 1, tenant="prom").result(30) == 1
+    host, port = eng.http_address
+    with urllib.request.urlopen(
+            f"http://{host}:{port}/metrics", timeout=10) as r:
+        assert r.status == 200
+        assert r.headers["Content-Type"].startswith("text/plain")
+        text = r.read().decode("utf-8")
+    eng.close()
+    telemetry.reset("promtest.")
+    parsed = parse_exposition(text)
+    # the serving run's own series are present and typed
+    assert parsed["cylon_serve_requests"]["type"] == "counter"
+    ((_, lab, v),) = parsed["cylon_promtest_http"]["samples"]
+    assert lab == {"tenant": 't"x\\y'} and int(v) == 2**40
+    # every counter sample in the whole payload is an exact integer
+    for mname, entry in parsed.items():
+        if entry["type"] == "counter":
+            for _, _, value in entry["samples"]:
+                assert _INT.match(value), (mname, value)
+    # strict JSON sanity of the parse result (no stray bytes)
+    json.dumps({k: v["type"] for k, v in parsed.items()})

@@ -111,15 +111,10 @@ def test_queue_cap_rejects_fast_with_resource_exhausted():
     eng.close()
 
 
-def _gated_worker(gate, log, name, steps):
-    """A query that idles (cheaply) until ``gate`` is set, then takes
-    ``steps`` logged steps — so both queries are guaranteed live in the
-    schedule before the measured interleave begins."""
+def _logged_worker(log, name, steps):
+    """A query that takes ``steps`` logged steps."""
 
     def run():
-        while not gate.is_set():
-            yield
-            time.sleep(0.001)
         for _ in range(steps):
             log.append(name)
             yield
@@ -131,10 +126,15 @@ def _gated_worker(gate, log, name, steps):
 def test_roundrobin_interleaves_concurrent_queries():
     eng = ServeEngine(policy=ServePolicy(max_queue=8))
     log = []
-    gate = threading.Event()
-    ta = eng.submit(_gated_worker(gate, log, "a", 3), tenant="a")
-    tb = eng.submit(_gated_worker(gate, log, "b", 3), tenant="b")
-    gate.set()
+    # both requests enter the execution set ATOMICALLY (the engine's
+    # condition is an RLock, so the submitting thread may hold it
+    # across both dispatches while the scheduler waits): every sweep
+    # from the first sees both ops, making the alternation check
+    # deterministic instead of racing a gate flip against a mid-sweep
+    # step boundary
+    with eng._cond:
+        ta = eng.submit(_logged_worker(log, "a", 3), tenant="a")
+        tb = eng.submit(_logged_worker(log, "b", 3), tenant="b")
     assert ta.result(10) == "a" and tb.result(10) == "b"
     # fair share: one step each per sweep — strict alternation, never
     # one query draining while the other starves
@@ -148,12 +148,11 @@ def test_priority_schedule_weights_tenant_steps():
     eng = ServeEngine(policy=ServePolicy(max_queue=8,
                                          schedule="priority"))
     log = []
-    gate = threading.Event()
-    th = eng.submit(_gated_worker(gate, log, "heavy", 6),
-                    tenant="heavy", priority=2)
-    tl = eng.submit(_gated_worker(gate, log, "light", 6),
-                    tenant="light", priority=1)
-    gate.set()
+    with eng._cond:  # atomic double admit (see the roundrobin test)
+        th = eng.submit(_logged_worker(log, "heavy", 6),
+                        tenant="heavy", priority=2)
+        tl = eng.submit(_logged_worker(log, "light", 6),
+                        tenant="light", priority=1)
     assert th.result(10) == "heavy" and tl.result(10) == "light"
     hl = [x for x in log if x in ("heavy", "light")]
     assert len(hl) == 12

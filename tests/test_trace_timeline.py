@@ -393,3 +393,40 @@ def test_dist_join_stage_coverage_at_least_80pct(env8, rng, armed):
     shards = xs[-1]["args"]["rows_shards"]
     assert shards is not None and len(shards) == env8.world_size
     assert sum(shards) == 2 * n
+
+
+def test_first_ring_drop_logs_one_warning(monkeypatch):
+    """ISSUE 9 satellite: silent trace loss gets ONE warning line at
+    the first eviction (and dropped() counts it); clear() re-arms."""
+    import io
+    import logging
+
+    monkeypatch.setenv("CYLON_TPU_TRACE", "1")
+    monkeypatch.setenv("CYLON_TPU_TRACE_EVENTS", "16")
+    # a fresh recorder so the tiny capacity takes effect
+    monkeypatch.setattr(trace, "_RECORDER", None)
+    buf = io.StringIO()
+    h = logging.StreamHandler(buf)
+    logger = logging.getLogger("cylon_tpu")
+    logger.addHandler(h)
+    try:
+        for i in range(40):
+            trace.instant(f"evt{i}")
+    finally:
+        logger.removeHandler(h)
+    assert trace.dropped() == 40 - 16
+    out = buf.getvalue()
+    assert out.count("trace ring buffer full") == 1, out
+    # clear() resets both the loss counter and the one-shot warning
+    trace.clear()
+    assert trace.dropped() == 0
+    buf2 = io.StringIO()
+    h2 = logging.StreamHandler(buf2)
+    logger.addHandler(h2)
+    try:
+        for i in range(20):
+            trace.instant(f"again{i}")
+    finally:
+        logger.removeHandler(h2)
+    assert "trace ring buffer full" in buf2.getvalue()
+    trace.clear()
