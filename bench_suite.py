@@ -250,6 +250,43 @@ def main():
               "child killed at CYLON_BENCH_SUBPROC_TIMEOUT")
 
 
+def _fallback_parts() -> int:
+    """Partition count for the suite's spill completions:
+    CYLON_BENCH_FALLBACK_PARTS (clamped >= 1, typos degrade to the
+    library default) — unset defers to
+    ``cylon_tpu.fallback.default_partitions``."""
+    from cylon_tpu.fallback import default_partitions
+
+    v = os.environ.get("CYLON_BENCH_FALLBACK_PARTS")
+    if not v:
+        return default_partitions()
+    try:
+        return max(int(v), 1)
+    except ValueError:  # a typo'd knob must not DNF the completions
+        return default_partitions()
+
+
+def _fallback_resume_dir(name: str) -> "str | None":
+    """``CYLON_BENCH_FALLBACK_DIR/<name>`` when the checkpoint-root
+    knob is set (a killed at-scale completion resumes instead of
+    restarting); None — no checkpointing — otherwise. The ONE place
+    the suite derives fallback resume locations."""
+    root = os.environ.get("CYLON_BENCH_FALLBACK_DIR")
+    return os.path.join(root, name) if root else None
+
+
+def _fallback_ok(qname: str) -> bool:
+    """Can this query complete out-of-core after an OOM? The two
+    hand-written streaming paths (q1/q5) plus every query with a
+    usable generic spill plan in ``tpch.manifest.FALLBACK``
+    (``cylon_tpu.fallback.supports``)."""
+    if qname in ("q1", "q5"):
+        return True
+    from cylon_tpu.fallback import supports
+
+    return supports(qname)
+
+
 def _is_oom(e: Exception) -> bool:
     """Device-memory exhaustion at a shape is a RESULT (the single-chip
     ceiling); anything else is a regression and must fail the bench."""
@@ -401,7 +438,11 @@ def _run_tpch(sf, reps, tag_hbm: bool = False, ooc_report=None):
             else:
                 t = _timeit(lambda: res.__setitem__("r", qfn(dfs)),
                             lambda: res["r"].table.nrows, reps)
-            _emit(f"tpch_{qname}_sf{sf}_wall", t * 1e3, "ms")
+            # path column: the suite's per-query walls are auditable —
+            # in_core here, ooc_fallback on the completion records
+            _emit_record({"metric": f"tpch_{qname}_sf{sf}_wall",
+                          "value": round(t * 1e3, 1), "unit": "ms",
+                          "path": "in_core"})
         except Exception as e:
             if _is_crash(e):
                 # the TPU WORKER died (observed at SF10: q1's over-
@@ -416,7 +457,7 @@ def _run_tpch(sf, reps, tag_hbm: bool = False, ooc_report=None):
                       type(e).__name__)
                 attempted.append(qname)
                 crashed.append(qname)
-                if qname in ("q1", "q5"):
+                if _fallback_ok(qname):
                     ooc_pending.append(qname)
                 if ooc_report is not None:
                     ooc_report.extend(ooc_pending)
@@ -433,8 +474,16 @@ def _run_tpch(sf, reps, tag_hbm: bool = False, ooc_report=None):
                 raise
             _emit(f"tpch_{qname}_sf{sf}_oom", 1, type(e).__name__)
             res.clear()
-            if qname in ("q1", "q5"):
+            if _fallback_ok(qname):
                 ooc_pending.append(qname)
+            else:
+                # recorded DNF with the reason, never a silent one:
+                # the manifest names why no spill decomposition exists
+                from cylon_tpu.tpch.manifest import FALLBACK
+
+                _emit(f"tpch_{qname}_sf{sf}_fallback_unsupported", 1,
+                      FALLBACK.get(qname, {}).get(
+                          "why", "no spill decomposition"))
         attempted.append(qname)
         _checkpoint()
     # regrow events: CompiledQuery memoizes the scale each (query,
@@ -466,17 +515,39 @@ def _run_tpch(sf, reps, tag_hbm: bool = False, ooc_report=None):
 
 
 def _tpch_ooc(data, qnames, sf):
-    """Run the streaming out-of-core TPC-H variants for ``qnames``."""
+    """Out-of-core completion for ``qnames``: the hand-written
+    streaming variants for q1/q5, the generic manifest-driven
+    partition fallback (:mod:`cylon_tpu.fallback`) for every other
+    supported query. One wall record per query, ``path=ooc_fallback``
+    — with a checkpoint dir (CYLON_BENCH_FALLBACK_DIR) a killed
+    at-scale completion resumes instead of restarting."""
+    from cylon_tpu import fallback, telemetry
     from cylon_tpu.tpch import streaming
 
+    nparts = _fallback_parts()
     for qname in qnames:
-        ofn = streaming.q1_ooc if qname == "q1" else streaming.q5_ooc
         try:
+            # every query here was routed to the spill path by the
+            # bench harness after an in-core failure (a clean OOM or a
+            # device crash — the sentinel merge loses the distinction,
+            # so the label claims neither) — count it on the pinned
+            # trajectory counter (run_with_fallback is bypassed here)
+            telemetry.counter("ooc.fallbacks", op=qname,
+                              reason="bench").inc()
             t0 = time.perf_counter()
-            out = ofn(data)
-            out.table.num_rows
+            if qname in ("q1", "q5"):
+                ofn = (streaming.q1_ooc if qname == "q1"
+                       else streaming.q5_ooc)
+                out = ofn(data, resume_dir=_fallback_resume_dir(qname))
+                out.table.num_rows
+            else:
+                out = fallback.tpch_fallback(
+                    qname, data, n_partitions=nparts,
+                    resume_dir=_fallback_resume_dir(qname))
             t = time.perf_counter() - t0
-            _emit(f"tpch_{qname}_sf{sf}_ooc_wall", t * 1e3, "ms")
+            _emit_record({"metric": f"tpch_{qname}_sf{sf}_ooc_wall",
+                          "value": round(t * 1e3, 1), "unit": "ms",
+                          "path": "ooc_fallback"})
             del out
         except Exception as e:
             if not _is_oom(e):
@@ -684,7 +755,8 @@ def scale_main():
 
         t0 = time.perf_counter()
         total = ooc_join(lsrc, rsrc, on="k", n_partitions=nparts,
-                         sink=_spill)
+                         sink=_spill,
+                         resume_dir=_fallback_resume_dir("join"))
         t = time.perf_counter() - t0
         assert total > 0
         _emit(f"local_inner_merge_{n}_ooc_rows_per_sec", n / t,
@@ -709,7 +781,8 @@ def scale_main():
         t0 = time.perf_counter()
         total = ooc_sort(src, "k",
                          n_partitions=max(8, n // 12_500_000),
-                         sink=_ssink)
+                         sink=_ssink,
+                         resume_dir=_fallback_resume_dir("sort"))
         t = time.perf_counter() - t0
         assert total == n
         _emit(f"sort_{n}_ooc_rows_per_sec", n / t, "rows/s")
@@ -759,27 +832,50 @@ def scale_incore_main(leg: str):
     report = {}
 
     if leg == "join":
-        try:
-            left = Table.from_pydict(
-                {"k": rng.integers(0, n, n).astype(np.int64),
-                 "a": rng.normal(size=n)})
-            right = Table.from_pydict(
-                {"k": rng.integers(0, n, n).astype(np.int64),
-                 "b": rng.normal(size=n)})
-            _hbm_stats(f"join_{n}_ingest")
-            f1 = jax.jit(lambda l, r: join(l, r, on="k", how="inner",
-                                           out_capacity=2 * n))
-            t = _timeit(lambda: out.__setitem__("r", f1(left, right)),
-                        lambda: out["r"].nrows, reps)
-            _emit(f"local_inner_merge_{n}_rows_per_sec", n / t, "rows/s",
-                  1e9 / 4.0 / 64)
-            _hbm_stats(f"join_{n}_end")
-            report["join_oom"] = False
-        except Exception as e:
-            if not _is_oom(e):  # only allocation failures are results
-                raise
-            _emit(f"local_inner_merge_{n}_oom", 1, type(e).__name__)
+        # pre-flight (ROADMAP item 1 / the 1B-row config): a join whose
+        # predicted working set cannot fit free HBM routes STRAIGHT to
+        # the parent's out-of-core completion — no doomed multi-minute
+        # ingest+dispatch, no allocator churn. 16 bytes/row/table
+        # (int64 key + float64 payload) × the transient-expansion knob.
+        from cylon_tpu import fallback as _fb
+        from cylon_tpu import telemetry as _tm
+
+        est = int(2 * 16 * n * _fb.expansion_factor())
+        free = _fb.free_hbm_bytes()
+        if free is not None and est > free:
+            _tm.counter("ooc.fallbacks", op="join",
+                        reason="preflight").inc()
+            _emit_record({
+                "metric": f"local_inner_merge_{n}_preflight_spill",
+                "value": 1, "unit": "routed to ooc_join",
+                "predicted_bytes": est, "free_hbm_bytes": free,
+                "path": "ooc_fallback"})
             report["join_oom"] = True
+        if not report.get("join_oom"):
+            try:
+                left = Table.from_pydict(
+                    {"k": rng.integers(0, n, n).astype(np.int64),
+                     "a": rng.normal(size=n)})
+                right = Table.from_pydict(
+                    {"k": rng.integers(0, n, n).astype(np.int64),
+                     "b": rng.normal(size=n)})
+                _hbm_stats(f"join_{n}_ingest")
+                f1 = jax.jit(lambda l, r: join(l, r, on="k",
+                                               how="inner",
+                                               out_capacity=2 * n))
+                t = _timeit(lambda: out.__setitem__("r",
+                                                    f1(left, right)),
+                            lambda: out["r"].nrows, reps)
+                _emit(f"local_inner_merge_{n}_rows_per_sec", n / t,
+                      "rows/s", 1e9 / 4.0 / 64)
+                _hbm_stats(f"join_{n}_end")
+                report["join_oom"] = False
+            except Exception as e:
+                if not _is_oom(e):  # only allocation failures are
+                    raise           # results
+                _emit(f"local_inner_merge_{n}_oom", 1,
+                      type(e).__name__)
+                report["join_oom"] = True
     elif leg == "sort":
         try:
             st = Table.from_pydict(

@@ -147,6 +147,7 @@ from cylon_tpu.errors import (
 )
 from cylon_tpu.config import DeadlinePolicy, RetryPolicy
 from cylon_tpu import telemetry
+from cylon_tpu import fallback
 from cylon_tpu.resilience import FaultPlan, FaultRule
 from cylon_tpu.watchdog import deadline
 from cylon_tpu.table import Table

@@ -501,6 +501,14 @@ class CompiledQuery:
             # forensics dump (telemetry.memory) before it propagates.
             with _span("plan.dispatch", cat="stage", cache_hit=hit), \
                     _memory.forensics("plan.dispatch"):
+                # seeded-fault hook (the "plan" injection point): the
+                # OOM→spill fallback layer injects deterministic
+                # allocation failures exactly where a real
+                # RESOURCE_EXHAUSTED would surface
+                from cylon_tpu import resilience
+
+                resilience.inject(
+                    "plan", getattr(self._fn, "__name__", "?"))
                 raw, bad = self._jitted(scale, hint, static_pos,
                                         static_kw, tuple(dyn_pos),
                                         **dyn_kw)

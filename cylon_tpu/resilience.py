@@ -76,9 +76,14 @@ KILL_EXIT_CODE = 43
 #: spill store; ``chunk_source`` — every chunk an out-of-core pass pulls
 #: (``outofcore._as_chunks``); ``io_read`` — the CSV/Parquet readers;
 #: ``exchange`` — the mesh shuffle dispatch; ``worker`` — worker
-#: preemption (exercised by the multihost bootstrap).
+#: preemption (exercised by the multihost bootstrap); ``plan`` — the
+#: compiled-query dispatch (``plan.CompiledQuery.__call__`` and the
+#: fallback executor's in-core attempt), where a seeded
+#: ``MemoryError`` is the deterministic twin of a device
+#: RESOURCE_EXHAUSTED — the injection the OOM→spill fallback tests
+#: drive.
 INJECTION_POINTS = ("spill_write", "spill_read", "chunk_source",
-                    "io_read", "exchange", "worker")
+                    "io_read", "exchange", "worker", "plan")
 
 
 # ------------------------------------------------------------ fault plans

@@ -46,6 +46,8 @@ env                                meaning                      default
                                    disables)                    ``5``
 ``CYLON_TPU_SERVE_BREAKER_WINDOW`` failure-counting window (s)  ``30``
 ``CYLON_TPU_SERVE_BREAKER_COOLDOWN`` open→half-open delay (s)   ``5``
+``CYLON_TPU_SERVE_MEMORY_BUDGET``  predicted-bytes admission
+                                   cap (bytes; ``0`` disables)  ``0``
 ================================== ============================ =========
 """
 
@@ -74,6 +76,11 @@ class ServePolicy:
     breaker_fails: int = 5
     breaker_window: float = 30.0
     breaker_cooldown: float = 5.0
+    #: memory-aware admission (bytes; None/0 disables): a submit whose
+    #: ``predicted_bytes`` exceeds this budget sheds immediately with
+    #: ``serve.shed{reason="memory"}`` — the front-door twin of the
+    #: OOM→spill fallback's pre-flight (``CYLON_TPU_SERVE_MEMORY_BUDGET``)
+    memory_budget: "int | None" = None
 
     def __post_init__(self):
         if self.max_queue < 1:
@@ -94,6 +101,10 @@ class ServePolicy:
         if self.breaker_window <= 0 or self.breaker_cooldown <= 0:
             raise InvalidArgument(
                 "breaker_window/breaker_cooldown must be > 0 seconds")
+        if self.memory_budget is not None and self.memory_budget < 0:
+            raise InvalidArgument(
+                f"memory_budget must be >= 0 bytes (0/None disables), "
+                f"got {self.memory_budget}")
 
 
 def default_policy() -> ServePolicy:
@@ -101,6 +112,7 @@ def default_policy() -> ServePolicy:
     overrides (read per call so tests can flip them)."""
     e = os.environ
     slo = float(e.get("CYLON_TPU_SERVE_SLO", "0"))
+    mem = int(e.get("CYLON_TPU_SERVE_MEMORY_BUDGET", "0"))
     return ServePolicy(
         max_queue=int(e.get("CYLON_TPU_SERVE_MAX_QUEUE", "64")),
         default_slo=slo if slo > 0 else None,
@@ -110,6 +122,7 @@ def default_policy() -> ServePolicy:
             e.get("CYLON_TPU_SERVE_BREAKER_WINDOW", "30")),
         breaker_cooldown=float(
             e.get("CYLON_TPU_SERVE_BREAKER_COOLDOWN", "5")),
+        memory_budget=mem if mem > 0 else None,
     )
 
 
@@ -211,7 +224,24 @@ class AdmissionController:
         with self._mu:
             return self._live
 
-    def admit(self, tenant: str) -> None:
+    def admit(self, tenant: str,
+              predicted_bytes: "int | None" = None) -> None:
+        budget = self.policy.memory_budget
+        if (budget and predicted_bytes is not None
+                and predicted_bytes > budget):
+            # memory-aware shed: a request PREDICTED not to fit is
+            # refused at the front door (microseconds) instead of
+            # dying minutes later in an HBM cascade — the admission
+            # twin of the fallback executor's pre-flight
+            telemetry.counter("serve.shed", reason="memory",
+                              tenant=tenant).inc()
+            telemetry.counter("serve.rejected", tenant=tenant).inc()
+            raise ResourceExhausted(
+                f"predicted memory {predicted_bytes} bytes exceeds "
+                f"the serve memory budget {budget} (tenant "
+                f"{tenant!r}); shed — submit with a fallback= spill "
+                "path, reduce the working set, or raise "
+                "CYLON_TPU_SERVE_MEMORY_BUDGET")
         if not self.breaker.allow():
             # open breaker: shed BEFORE taking a slot — in-flight work
             # keeps draining, new work is refused in microseconds

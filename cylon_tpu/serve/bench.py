@@ -46,7 +46,7 @@ REQUIRED_SERVE_FIELDS = frozenset({
     "metric", "clients", "requests_total", "tenants", "schedule",
     "p50_s", "p99_s", "qps", "cache_hit_rate", "rejected", "errors",
     "expired", "oracle_mismatches", "shed", "journal_replayed",
-    "recoveries",
+    "recoveries", "degraded",
     # attribution columns (ISSUE 9): every serve artifact carries the
     # slowest request's ANALYZE profile and the run's HBM high-water
     # mark, not just p50/p99
@@ -255,6 +255,10 @@ def run_bench(clients: int = 8, requests: int = 2, sf: float = 0.002,
         "shed": telemetry.total("serve.shed"),
         "journal_replayed": telemetry.total("serve.journal_replayed"),
         "recoveries": telemetry.total("serve.recoveries"),
+        # graceful degradation (ISSUE 10): requests that completed
+        # through the OOM→spill fallback — 0 on a healthy replay,
+        # pinned so degraded completions ride the trajectory
+        "degraded": telemetry.total("serve.degraded"),
         "cache_hit_rate": round(cache["hit_rate"], 4),
         "cache_hits": cache["hits"],
         "cache_misses": cache["misses"],

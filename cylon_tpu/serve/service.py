@@ -68,10 +68,22 @@ Design — an assembly of the subsystems the previous PRs built:
   circuit breaker (:class:`~cylon_tpu.serve.admission.CircuitBreaker`)
   instead of wedging the engine: new work sheds fast, in-flight work
   drains.
+
+* **Graceful degradation** (:mod:`cylon_tpu.fallback`): a request
+  submitted with a ``fallback=`` spill path whose step dies with an
+  allocation failure re-runs ONCE through that path instead of
+  erroring — it retires DONE with ``degraded=true`` (+ the OOM
+  forensics report) in its ANALYZE profile, counts
+  ``serve.degraded{tenant}``, and NEVER feeds the circuit breaker
+  (only a fallback that *also* fails retires as an error). Memory-
+  aware admission (``ServePolicy.memory_budget``) sheds requests whose
+  ``predicted_bytes`` cannot fit, counted
+  ``serve.shed{reason="memory"}``.
 """
 
 import collections
 import contextlib
+import functools
 import itertools
 import os
 import threading
@@ -115,6 +127,9 @@ class QueryTicket:
         self.state = QUEUED
         self.value = None
         self.error: "BaseException | None" = None
+        #: did this request complete through the OOM→spill fallback?
+        #: (set by the scheduler's degrade path; rides ``profile()``)
+        self.degraded = False
         self._event = threading.Event()
         #: ANALYZE profiler (telemetry.profile.RequestProfiler), set
         #: at admission unless CYLON_TPU_SERVE_PROFILE=0
@@ -173,7 +188,7 @@ class _QueryOp(Op):
 
     def __init__(self, op_id: int, engine: "ServeEngine",
                  ticket: QueryTicket, fn, args, kwargs,
-                 fault_plan, pins: "list[str]"):
+                 fault_plan, pins: "list[str]", fallback=None):
         super().__init__(op_id, name=f"QueryOp[{ticket.tenant}]")
         self._engine = engine
         self.ticket = ticket
@@ -184,6 +199,10 @@ class _QueryOp(Op):
         self._pins = pins
         self._gen = None
         self._step = 0
+        #: the request's spill path (zero-arg callable or generator
+        #: fn): armed by submit(fallback=); consumed at most once
+        self._fallback = fallback
+        self._degraded = False
 
     def done(self) -> bool:
         return self.ticket.done
@@ -203,7 +222,8 @@ class _QueryOp(Op):
                     elapsed=time.monotonic() - t.submitted)
             self._run_step(rem)
         except BaseException as e:  # noqa: BLE001 - isolate per request
-            self._engine._retire(self, error=e)
+            if not self._maybe_degrade(e):
+                self._engine._retire(self, error=e)
         finally:
             # the client-visible completion signal fires only AFTER
             # the step's profiler/forensics scopes have fully unwound:
@@ -211,6 +231,40 @@ class _QueryOp(Op):
             # complete, not racing the scheduler's bookkeeping
             if t.state in (DONE, FAILED):
                 t._event.set()
+        return True
+
+    def _maybe_degrade(self, e: BaseException) -> bool:
+        """An OOM'd step with an armed ``fallback=`` degrades instead
+        of erroring: the op swaps its query fn for the spill callable
+        and stays LIVE — the next schedule sweep re-runs it through
+        the degraded path under the same tenant scope, remaining SLO
+        and profiler. Consumed at most once: a fallback that ALSO
+        fails retires as a normal error (and only then can feed the
+        circuit breaker — an OOM that ends in a successful degraded
+        completion never does)."""
+        t = self.ticket
+        if (self._fallback is None or self._degraded
+                or not _memory.is_oom(e)):
+            return False
+        self._degraded = True
+        # NOTE: ticket.degraded + serve.degraded{tenant} are recorded
+        # at SUCCESSFUL retirement (_retire), not here — "degraded"
+        # means COMPLETED through the spill path; a fallback that also
+        # fails retires as a plain error. The routing counter fires
+        # now: the query IS being routed to the spill path, whether or
+        # not its fallback callable goes through run_with_fallback.
+        telemetry.counter("ooc.fallbacks", op="serve",
+                          reason="oom").inc()
+        _trace.instant("serve.degrade", cat="serve", tenant=t.tenant,
+                       rid=t.rid, error=type(e).__name__)
+        from cylon_tpu.utils.logging import get_logger
+
+        get_logger().warning(
+            "request %d (tenant %r) exhausted memory (%s) — "
+            "degrading through its spill fallback", t.rid, t.tenant,
+            type(e).__name__)
+        self._gen = None
+        self._fn, self._args, self._kwargs = self._fallback, (), {}
         return True
 
     def _run_step(self, rem: "float | None") -> None:
@@ -337,12 +391,19 @@ class ServeEngine:
         if self._snapshot is not None:
             self._snapshot.drop(table_id)
 
-    def register_query(self, name: str, fn) -> None:
+    def register_query(self, name: str, fn, fallback=None) -> None:
         """Name a query function for :meth:`submit_named` — the
         REPLAYABLE submission surface: only named queries (with
         JSON-able args) can be re-run by :meth:`recover`, because the
-        journal can name them where it cannot serialize a closure."""
-        self._queries[str(name)] = fn
+        journal can name them where it cannot serialize a closure.
+
+        ``fallback`` (same signature as ``fn``) registers the query's
+        spill path alongside it: every :meth:`submit_named` —
+        INCLUDING a journal replay after :meth:`recover` — arms it
+        automatically, so graceful degradation survives a crash (the
+        journal can name the query but could never serialize a
+        per-submit fallback closure)."""
+        self._queries[str(name)] = (fn, fallback)
 
     def table_stats(self) -> dict:
         """Per-table rows/bytes/pins of the resident catalog."""
@@ -360,6 +421,7 @@ class ServeEngine:
                priority: int = 1, slo: "float | None" = None,
                tables=(), fault_plan=None,
                idempotency_key: "str | None" = None,
+               fallback=None, predicted_bytes: "int | None" = None,
                _journal_name: "str | None" = None,
                **kwargs) -> QueryTicket:
         """Admit one query for scheduled execution.
@@ -375,9 +437,18 @@ class ServeEngine:
         the engine has already seen (live or retired) returns the
         EXISTING ticket — the same request is never executed twice, so
         a client retrying after a lost answer (or a recovery replaying
-        the journal) is safe. Raises
+        the journal) is safe. ``fallback`` (a zero-arg callable or
+        generator fn — e.g. ``lambda:
+        cylon_tpu.fallback.tpch_fallback("q3", data)``) arms the
+        degrade path: a step that dies with an allocation failure
+        re-runs ONCE through it instead of erroring (``degraded=true``
+        in the profile, ``serve.degraded{tenant}``, breaker untouched).
+        ``predicted_bytes`` feeds memory-aware admission: when it
+        exceeds the policy's ``memory_budget`` the submit sheds
+        immediately (``serve.shed{reason="memory"}``). Raises
         :class:`~cylon_tpu.errors.ResourceExhausted` immediately when
-        the live-request cap is hit or the circuit breaker is open."""
+        the live-request cap is hit, the memory budget is exceeded, or
+        the circuit breaker is open."""
         if self._closed:
             raise InvalidArgument("engine is closed")
         key = idempotency_key
@@ -396,7 +467,9 @@ class ServeEngine:
             slo = self._policy.default_slo
         elif slo <= 0:
             slo = None
-        self._admission.admit(tenant)  # may raise ResourceExhausted
+        # may raise ResourceExhausted (queue cap, breaker, or the
+        # memory-aware predicted-bytes shed)
+        self._admission.admit(tenant, predicted_bytes=predicted_bytes)
         ticket = QueryTicket(next(self._ids), str(tenant),
                              int(priority), slo)
         if _profile.profiling_enabled():
@@ -413,7 +486,7 @@ class ServeEngine:
             self._admission.release()
             raise
         op = _QueryOp(next(self._op_ids), self, ticket, fn, args,
-                      kwargs, fault_plan, pinned)
+                      kwargs, fault_plan, pinned, fallback=fallback)
         op._holder = holder
         op._idem_key = key
         if key is not None:
@@ -492,6 +565,13 @@ class ServeEngine:
                 break
             del self._idem[k]
 
+    #: submit()'s control keywords — everything else in a
+    #: submit_named(**kwargs) belongs to the query function itself
+    #: (and therefore to its registered fallback's signature too)
+    _CONTROL_KW = frozenset({
+        "tenant", "priority", "slo", "tables", "fault_plan",
+        "idempotency_key", "fallback", "predicted_bytes"})
+
     def submit_named(self, name: str, *args,
                      idempotency_key: "str | None" = None,
                      **kwargs) -> QueryTicket:
@@ -499,13 +579,24 @@ class ServeEngine:
         durable submission surface: the journal records the NAME plus
         JSON-able args, so :meth:`recover` can re-run the request in a
         fresh process. Accepts every :meth:`submit` keyword
-        (tenant/priority/slo/tables/fault_plan)."""
-        fn = self._queries.get(str(name))
-        if fn is None:
+        (tenant/priority/slo/tables/fault_plan/fallback/
+        predicted_bytes); when the registry carries a fallback for
+        ``name`` and the caller passes none, it is armed with this
+        request's query arguments — so a journal REPLAY keeps the
+        degrade path its original submit had."""
+        entry = self._queries.get(str(name))
+        if entry is None:
             raise InvalidArgument(
                 f"no query registered under {name!r}; "
                 f"register_query() it first (known: "
                 f"{sorted(self._queries)})")
+        fn, reg_fb = entry
+        # "fallback" ABSENT arms the registry's; an explicit
+        # fallback=None is a per-request opt-out of degradation
+        if reg_fb is not None and "fallback" not in kwargs:
+            qkw = {k: v for k, v in kwargs.items()
+                   if k not in self._CONTROL_KW}
+            kwargs["fallback"] = functools.partial(reg_fb, *args, **qkw)
         return self.submit(fn, *args, idempotency_key=idempotency_key,
                            _journal_name=str(name), **kwargs)
 
@@ -575,6 +666,12 @@ class ServeEngine:
         wall = t.finished - t.submitted
         if error is None:
             t.state, t.value = DONE, value
+            if getattr(op, "_degraded", False):
+                # the degrade COMPLETED: this is the moment the
+                # request earns degraded=true and the tenant counter
+                t.degraded = True
+                telemetry.counter("serve.degraded",
+                                  tenant=t.tenant).inc()
             telemetry.counter("serve.completed", tenant=t.tenant).inc()
             self._admission.breaker.record_success()
         else:
@@ -723,7 +820,12 @@ class ServeEngine:
             env = ct.CylonEnv(ct.TPUConfig())
         engine = cls(env, policy, durable_dir=durable_dir)
         for name, fn in (queries or {}).items():
-            engine.register_query(name, fn)
+            # a (fn, fallback) pair re-registers the degrade path too,
+            # so replayed requests keep their graceful degradation
+            if isinstance(fn, tuple):
+                engine.register_query(name, *fn)
+            else:
+                engine.register_query(name, fn)
         telemetry.counter("serve.recoveries").inc()
         _trace.instant("serve.recover", cat="serve", dir=durable_dir)
         restored = engine._snapshot.restore()

@@ -430,6 +430,7 @@ REQUIRED_BENCH_KEYS = (
     "resilience.faults_injected",
     "spill.read_bytes",
     "spill.write_bytes",
+    "ooc.fallbacks",
     "watchdog.sections_expired",
 )
 

@@ -354,21 +354,50 @@ def format_oom_report(rep: "dict | None" = None) -> str:
 @contextlib.contextmanager
 def forensics(point: str):
     """Wrap an allocation path: an exception :func:`is_oom` recognises
-    increments ``memory.oom_events{point=}`` and logs ONE warning with
-    the :func:`format_oom_report` dump before re-raising — the error
-    finally names its crowd, not just its size. Non-OOM errors pass
-    through untouched."""
+    increments ``memory.oom_events{point=}``, logs ONE warning with
+    the :func:`format_oom_report` dump, ATTACHES the report to the
+    exception (``e.oom_report`` dict + the rendered text appended to
+    the message — so a raised ResourceExhausted names its crowd, not
+    just its size, and the serve profile can embed it), then
+    re-raises. Nested scopes count per point but attach/log only once
+    (the innermost scope wins). Non-OOM errors pass through
+    untouched."""
     try:
         yield
     except BaseException as e:
         if is_oom(e):
             _r.counter("memory.oom_events", point=point).inc()
-            try:
-                from cylon_tpu.utils.logging import get_logger
+            if getattr(e, "oom_report", None) is None:
+                try:
+                    rep = oom_report()
+                    text = format_oom_report(rep)
+                except Exception:  # forensics must never mask the OOM
+                    rep = text = None
+                if text is not None:
+                    # the log and the attach fail INDEPENDENTLY: a
+                    # closed stream must not cost the attachment, an
+                    # attr-refusing exception class must not cost the
+                    # dump
+                    try:
+                        from cylon_tpu.utils.logging import get_logger
 
-                get_logger().warning(
-                    "allocation failure in %s (%s: %s)\n%s", point,
-                    type(e).__name__, e, format_oom_report())
-            except Exception:  # forensics must never mask the OOM
-                pass
+                        get_logger().warning(
+                            "allocation failure in %s (%s: %s)\n%s",
+                            point, type(e).__name__, e, text)
+                    except Exception:
+                        pass
+                if rep is not None:
+                    try:
+                        e.oom_report = rep
+                        # append the dump to the MESSAGE too: whoever
+                        # logs str(e) — a bench record, a client
+                        # traceback — sees the consumers without
+                        # knowing the attribute
+                        if e.args and isinstance(e.args[0], str):
+                            e.args = (e.args[0] + "\n" + text,) \
+                                + e.args[1:]
+                        elif not e.args:
+                            e.args = (text,)
+                    except Exception:
+                        pass
         raise

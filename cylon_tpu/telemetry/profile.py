@@ -54,7 +54,7 @@ REQUIRED_PROFILE_FIELDS = (
     "rid", "tenant", "state", "slo_s", "queue_wait_s", "wall_s",
     "steps", "stages", "operators", "compile", "memory", "spill",
     "faults", "plan_cache", "headroom_ratio", "stage_walls_s",
-    "stage_coverage",
+    "stage_coverage", "degraded", "fallback",
 )
 
 
@@ -77,6 +77,7 @@ _COUNTERS = (
     "plan.capacity_rescales", "plan.prefetch_bytes",
     "spill.read_bytes", "spill.write_bytes", "resilience.retries",
     "resilience.faults_injected", "ooc.chunks", "ooc.rows_out",
+    "ooc.fallbacks", "ooc.fallback_partitions", "ooc.units_resumed",
 )
 
 _SPAN_METRIC = "tracing.span_seconds"
@@ -144,6 +145,10 @@ class RequestProfiler:
         self.mem_start: "int | None" = None
         self.mem_peak: "int | None" = None
         self.mem_end: "int | None" = None
+        #: the resident-consumer dump of the step that OOM'd (set when
+        #: a step raises something memory.is_oom recognises) — rides
+        #: the profile so a degraded request is self-explaining
+        self.oom_report: "dict | None" = None
 
     @contextlib.contextmanager
     def step(self):
@@ -159,6 +164,16 @@ class RequestProfiler:
         t0 = time.perf_counter()
         try:
             yield
+        except BaseException as e:
+            if memory.is_oom(e):
+                # the forensics scope (innermost) attached the report;
+                # keep it on the profile so the degraded rerun's
+                # profile explains WHY it degraded
+                rep = getattr(e, "oom_report", None)
+                with self._mu:
+                    self.oom_report = rep if rep is not None \
+                        else memory.oom_report()
+            raise
         finally:
             dt = time.perf_counter() - t0
             c1, s1, w1 = _grab()
@@ -208,6 +223,7 @@ class RequestProfiler:
             mem_start, mem_peak, mem_end = (self.mem_start,
                                             self.mem_peak,
                                             self.mem_end)
+            oom_rep = self.oom_report
         stages = {n: s for n, s in spans.items() if "." in n}
         stages.update({f"section:{n}": s
                        for n, s in sections.items()
@@ -290,6 +306,24 @@ class RequestProfiler:
             "stage_walls_s": stage_walls,
             "stage_coverage": (stage_walls / wall if wall > 0
                                else None),
+            # graceful-degradation attribution: did this request
+            # complete through the OOM→spill fallback, over how many
+            # partitions, and what crowded it out of HBM
+            "degraded": bool(getattr(ticket, "degraded", False)),
+            "fallback": {
+                # the engine's degrade fires OUTSIDE the step bracket
+                # (in the scheduler's except path), so the per-step
+                # counter delta can read 0 for a degraded request —
+                # the ticket flag is the floor
+                "fallbacks": max(
+                    self._counter(counters, "ooc.fallbacks"),
+                    1 if getattr(ticket, "degraded", False) else 0),
+                "partitions": self._counter(
+                    counters, "ooc.fallback_partitions"),
+                "units_resumed": self._counter(
+                    counters, "ooc.units_resumed"),
+                "oom_report": oom_rep,
+            },
         }
         return json_safe(prof)
 
@@ -446,6 +480,12 @@ def profile_text(prof: dict) -> str:
              f"queue {prof['queue_wait_s'] * 1e3:.1f} ms, "
              f"{prof['steps']} step(s), coverage "
              f"{(prof['stage_coverage'] or 0) * 100:.0f}%"]
+    if prof.get("degraded"):
+        fb = prof.get("fallback") or {}
+        lines.append(
+            f"  DEGRADED: completed via the OOM→spill fallback "
+            f"({fb.get('partitions', 0)} partition(s), "
+            f"{fb.get('units_resumed', 0)} resumed)")
     for op, d in sorted(prof.get("operators", {}).items(),
                         key=lambda kv: -kv[1].get("wall_s", 0.0)):
         lines.append(

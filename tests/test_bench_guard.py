@@ -532,6 +532,80 @@ def test_trace_record_schema_pins_dropped_count():
     assert "trace_dropped" in bench.REQUIRED_TRACE_FIELDS
 
 
+# ------------------------------------------- spill-fallback guards
+def test_fallback_manifest_covers_every_query():
+    """ISSUE 10 satellite: every TPC-H query has a FALLBACK entry whose
+    partition plan is consistent with the projection manifest — the
+    partitioned tables are tables the query reads, and every partition
+    key survives the manifest-pruned ingest (a dropped key would make
+    the spill path KeyError at scale, invisibly at test SF)."""
+    from cylon_tpu.tpch.manifest import FALLBACK, MANIFEST
+
+    assert set(FALLBACK) == set(MANIFEST), (
+        "FALLBACK and MANIFEST must cover the same 22 queries")
+    kinds = {"concat", "groupby", "sum", None}
+    for q, spec in FALLBACK.items():
+        assert spec.get("merge") in kinds, (q, spec.get("merge"))
+        assert spec.get("partition"), f"{q}: no partition plan"
+        for table, key in spec["partition"].items():
+            assert table in MANIFEST[q], (
+                f"{q}: partitions {table}, which it never reads")
+            if key is not None:
+                assert key in MANIFEST[q][table], (
+                    f"{q}: partition key {key} not in the projection "
+                    f"manifest for {table} — pruned ingest would drop "
+                    "it")
+        if spec["merge"] == "groupby":
+            assert spec.get("by") and spec.get("aggs"), q
+            for col, how in spec["aggs"].items():
+                if isinstance(how, tuple):
+                    kind, weight = how
+                    assert kind == "wmean" and weight in spec["aggs"]
+                else:
+                    assert how in ("sum", "min", "max"), (q, col, how)
+        if spec["merge"] is None:
+            assert spec.get("why"), (
+                f"{q}: an unsupported plan must name its blocker")
+        if spec.get("sort"):
+            asc = spec.get("ascending")
+            assert asc is None or len(asc) == len(spec["sort"]), q
+
+
+def test_serve_replay_queries_have_usable_fallback():
+    """ISSUE 10 satellite (CI lint): every query the serve bench
+    replays must have a USABLE spill plan — a served query without one
+    could only fail under memory pressure, never degrade."""
+    from cylon_tpu.fallback import supports
+    from cylon_tpu.serve.bench import DEFAULT_MIX
+
+    bare = [q for q in DEFAULT_MIX if not supports(q)]
+    assert not bare, (
+        f"serve-replay queries without a fallback plan: {bare} — add "
+        "a tpch.manifest.FALLBACK entry with a non-None merge")
+
+
+def test_required_bench_keys_pin_fallback_counter():
+    """ISSUE 10 satellite: ooc.fallbacks rides every bench record's
+    metrics block, so the trajectory shows WHICH runs degraded."""
+    from cylon_tpu.telemetry import REQUIRED_BENCH_KEYS
+
+    assert "ooc.fallbacks" in REQUIRED_BENCH_KEYS
+
+
+def test_profile_schema_pins_degradation_columns():
+    """A degraded request must be self-explaining: the profile schema
+    pins degraded + the fallback attribution block."""
+    from cylon_tpu.telemetry.profile import REQUIRED_PROFILE_FIELDS
+
+    assert {"degraded", "fallback"} <= set(REQUIRED_PROFILE_FIELDS)
+
+
+def test_serve_record_schema_pins_degraded_column():
+    from cylon_tpu.serve.bench import REQUIRED_SERVE_FIELDS
+
+    assert "degraded" in REQUIRED_SERVE_FIELDS
+
+
 def test_checker_accepts_closures_and_comprehensions(tmp_path):
     p = tmp_path / "ok.py"
     p.write_text(

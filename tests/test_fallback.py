@@ -1,0 +1,620 @@
+"""OOM→spill fallback executor (ISSUE 10): pre-flight routing,
+injected-OOM retry-once, manifest-driven TPC-H partition fallback
+oracles, kill-mid-fallback resume, and the serve degrade path.
+
+Float caveat, stated where it matters: a partitioned rerun adds the
+same values in a different association order, so float aggregates
+compare at the repo-standard ``rtol=1e-9`` (exactly like every other
+TPC-H oracle test); group keys, counts and row sets compare exactly.
+"""
+
+import json
+import os
+import pathlib
+import subprocess
+import sys
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from cylon_tpu import fallback, resilience, telemetry
+from cylon_tpu.errors import InvalidArgument, ResourceExhausted
+from cylon_tpu.resilience import (FaultPlan, FaultRule,
+                                  KILL_EXIT_CODE)
+from cylon_tpu.telemetry import memory
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+
+#: small enough for tier-1, big enough that every partition of every
+#: partitioned table is non-trivial at n_partitions=3
+SF = 0.005
+
+
+@pytest.fixture(scope="module")
+def tpch_data():
+    from cylon_tpu.tpch import dbgen
+
+    return dbgen.generate(sf=SF, seed=0)
+
+
+def _assert_matches(got, want):
+    if isinstance(want, float):
+        assert np.isclose(float(got), want, rtol=1e-9)
+        return
+    assert list(got.columns) == list(want.columns)
+    assert len(got) == len(want)
+    for c in want.columns:
+        if np.issubdtype(want[c].dtype, np.floating):
+            np.testing.assert_allclose(
+                got[c].to_numpy(), want[c].to_numpy(), rtol=1e-9)
+        else:
+            assert list(got[c]) == list(want[c])
+
+
+def _sorted_all(df):
+    return df.sort_values(list(df.columns), kind="stable",
+                          ignore_index=True)
+
+
+def _mk_inputs(n=4000):
+    rng = np.random.default_rng(11)
+    left = {"k": rng.integers(0, n, n).astype(np.int64),
+            "a": rng.normal(size=n)}
+    right = {"k": rng.integers(0, n, n).astype(np.int64),
+             "b": rng.normal(size=n)}
+    return left, right
+
+
+# ------------------------------------------------------ routing core
+def test_preflight_routes_to_spill_without_attempting():
+    calls = []
+
+    def attempt():
+        raise AssertionError("pre-flight must not dispatch in-core")
+
+    before = telemetry.total("ooc.fallbacks")
+    out = fallback.run_with_fallback(
+        attempt, lambda: calls.append("spill") or 42, op="probe",
+        predicted_bytes=1000, budget_bytes=100)
+    assert out == 42 and calls == ["spill"]
+    assert telemetry.total("ooc.fallbacks") == before + 1
+    assert telemetry.counter("ooc.fallbacks", op="probe",
+                             reason="preflight").value >= 1
+
+
+def test_fitting_query_runs_in_core():
+    out = fallback.run_with_fallback(
+        lambda: "in_core",
+        lambda: pytest.fail("must not spill when it fits"),
+        op="probe2", predicted_bytes=10, budget_bytes=1000)
+    assert out == "in_core"
+
+
+def test_injected_oom_retries_once_through_spill():
+    before = telemetry.total("ooc.fallbacks")
+    with resilience.active(FaultPlan(
+            [FaultRule("plan", nth=1,
+                       error=MemoryError("injected device OOM"))])):
+        out = fallback.run_with_fallback(
+            lambda: "in_core", lambda: "spilled", op="probe3")
+    assert out == "spilled"
+    assert telemetry.total("ooc.fallbacks") == before + 1
+    assert telemetry.counter("ooc.fallbacks", op="probe3",
+                             reason="oom").value >= 1
+
+
+def test_non_oom_error_propagates_without_fallback():
+    before = telemetry.total("ooc.fallbacks")
+
+    def attempt():
+        raise ValueError("a query bug, not an OOM")
+
+    with pytest.raises(ValueError, match="query bug"):
+        fallback.run_with_fallback(
+            attempt, lambda: pytest.fail("must not spill"), op="p4")
+    assert telemetry.total("ooc.fallbacks") == before
+
+
+def test_fallback_failure_chains_the_original_oom():
+    def spill():
+        raise RuntimeError("spill path broke too")
+
+    with resilience.active(FaultPlan(
+            [FaultRule("plan", nth=1, error=MemoryError("oom"))])):
+        with pytest.raises(RuntimeError, match="spill path") as ei:
+            fallback.run_with_fallback(lambda: 1, spill, op="p5")
+    assert isinstance(ei.value.__cause__, MemoryError)
+
+
+def test_free_hbm_budget_knob(monkeypatch):
+    monkeypatch.setenv("CYLON_TPU_HBM_BUDGET_BYTES", "123456789")
+    free = fallback.free_hbm_bytes()
+    assert free is not None and 0 <= free <= 123456789
+    monkeypatch.delenv("CYLON_TPU_HBM_BUDGET_BYTES")
+    # plain CPU keeps no allocator limits: pre-flight stands down
+    assert fallback.free_hbm_bytes() is None
+
+
+def test_oom_report_attached_to_exception():
+    with pytest.raises(MemoryError) as ei:
+        with memory.forensics("fallback_test"):
+            raise MemoryError("Unable to allocate 99 GiB")
+    assert isinstance(ei.value.oom_report, dict)
+    assert "devices" in ei.value.oom_report
+    assert "resident-memory forensics" in str(ei.value)
+
+
+# --------------------------------------------------- plain relational
+def test_plain_join_spill_matches_incore():
+    left, right = _mk_inputs()
+    want = fallback.join(left, right, on="k")          # fits: in-core
+    before = telemetry.total("ooc.fallbacks")
+    got = fallback.join(left, right, on="k", n_partitions=4,
+                        budget_bytes=0)                # forced spill
+    assert telemetry.total("ooc.fallbacks") == before + 1
+    pd.testing.assert_frame_equal(_sorted_all(got), _sorted_all(want),
+                                  check_dtype=False)
+
+
+def test_plain_groupby_spill_matches_incore():
+    rng = np.random.default_rng(5)
+    src = {"g": rng.integers(0, 50, 3000).astype(np.int64),
+           "v": rng.normal(size=3000)}
+    aggs = [("v", "sum", "s"), ("v", "count", "c")]
+    want = fallback.groupby(src, ["g"], aggs)
+    got = fallback.groupby(src, ["g"], aggs, chunk_rows=500,
+                           budget_bytes=0)
+    pd.testing.assert_frame_equal(
+        _sorted_all(got), _sorted_all(want), check_dtype=False,
+        check_exact=False, rtol=1e-9)
+
+
+def test_plain_sort_spill_matches_incore():
+    rng = np.random.default_rng(6)
+    src = {"k": rng.integers(0, 200, 3000).astype(np.int64),
+           "v": rng.normal(size=3000)}
+    want = fallback.sort(src, ["k", "v"])
+    got = fallback.sort(src, ["k", "v"], n_partitions=4,
+                        chunk_rows=700, budget_bytes=0)
+    pd.testing.assert_frame_equal(got, want, check_dtype=False)
+
+
+def test_plain_join_injected_oom_degrades():
+    left, right = _mk_inputs(2000)
+    want = fallback.join(left, right, on="k")
+    with resilience.active(FaultPlan(
+            [FaultRule("plan", nth=1,
+                       error=MemoryError("injected OOM"))])):
+        got = fallback.join(left, right, on="k", n_partitions=4)
+    pd.testing.assert_frame_equal(_sorted_all(got), _sorted_all(want),
+                                  check_dtype=False)
+
+
+# ------------------------------------------------- TPC-H decomposition
+#: one query per merge kind + the degenerate no-join chunking: concat
+#: top-k (q3), groupby re-aggregation incl. weighted means (q1, q5),
+#: scalar sum (q6) — the >=4-query oracle bar of the ISSUE (and the
+#: serve-replay mix); two more merge shapes ride the slow tier
+ORACLE_QUERIES = ("q1", "q3", "q5", "q6")
+
+
+def _oracle_scenario(tpch_data, qname):
+    from cylon_tpu import tpch
+
+    want = fallback._materialize(getattr(tpch, qname)(tpch_data))
+    got = fallback.tpch_fallback(qname, tpch_data, n_partitions=3,
+                                 compiled=False)
+    _assert_matches(got, want)
+
+
+@pytest.mark.parametrize("qname", ORACLE_QUERIES)
+def test_tpch_fallback_matches_incore_oracle(tpch_data, qname):
+    _oracle_scenario(tpch_data, qname)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("qname", ("q12", "q18"))
+def test_tpch_fallback_more_merge_shapes(tpch_data, qname):
+    """q12 (indicator-sum re-aggregation) and q18 (concat top-k over a
+    HAVING groupby) — same oracle proof, heavier budget. All 16
+    supported plans were oracle-verified at sf=0.01 during
+    development; tier-1 keeps the serve-mix four."""
+    _oracle_scenario(tpch_data, qname)
+
+
+def test_tpch_fallback_counts_partitions(tpch_data):
+    before = telemetry.total("ooc.fallback_partitions")
+    fallback.tpch_fallback("q6", tpch_data, n_partitions=3,
+                           compiled=False)
+    assert telemetry.total("ooc.fallback_partitions") == before + 3
+
+
+def test_tpch_fallback_unsupported_query_raises(tpch_data):
+    with pytest.raises(InvalidArgument, match="percentage"):
+        fallback.tpch_fallback("q14", tpch_data)
+    assert not fallback.supports("q14")
+    assert fallback.supports("q3")
+
+
+def test_run_query_unsupported_oom_keeps_original_error(tpch_data):
+    """A query WITHOUT a usable plan keeps in-core-or-raise semantics:
+    an OOM surfaces as the original memory error (with forensics
+    attached), never masked by the spill path's InvalidArgument, and
+    ooc.fallbacks does not count a route that does not exist."""
+    before = telemetry.total("ooc.fallbacks")
+    with resilience.active(FaultPlan(
+            [FaultRule("plan", nth=1,
+                       error=MemoryError("injected OOM"))])):
+        with pytest.raises(MemoryError) as ei:
+            fallback.run_query("q14", tpch_data, compiled=False)
+    assert ei.value.oom_report is not None
+    assert telemetry.total("ooc.fallbacks") == before
+
+
+def test_tpch_fallback_rejects_nonpositive_partitions(tpch_data):
+    """n_partitions < 1 would run NOTHING and merge an empty answer —
+    refused up front instead of returned as a wrong result."""
+    with pytest.raises(InvalidArgument, match="n_partitions"):
+        fallback.tpch_fallback("q6", tpch_data, n_partitions=0,
+                               compiled=False)
+
+
+def test_resume_discards_checkpoint_when_broadcast_changes(tmp_path):
+    """A changed BROADCAST table (invisible to per-partition row-count
+    meta) changes the checkpoint fingerprint: the stale units are
+    discarded and recomputed against the new data — generations are
+    never mixed."""
+    from cylon_tpu.tpch import dbgen
+
+    data = dbgen.generate(sf=0.002, seed=0)
+    first = fallback.tpch_fallback("q3", data, n_partitions=2,
+                                   compiled=False,
+                                   resume_dir=str(tmp_path))
+    # shrink the broadcast side (customer): fewer qualifying orders
+    data2 = dict(data)
+    data2["customer"] = {k: np.asarray(v)[: len(v) // 2]
+                         for k, v in data["customer"].items()}
+    resumed_before = telemetry.total("ooc.units_resumed")
+    second = fallback.tpch_fallback("q3", data2, n_partitions=2,
+                                    compiled=False,
+                                    resume_dir=str(tmp_path))
+    # nothing replayed from the stale generation...
+    assert telemetry.total("ooc.units_resumed") == resumed_before
+    # ...and the answer reflects the NEW broadcast data
+    from cylon_tpu import tpch
+
+    want = fallback._materialize(tpch.q3(data2))
+    _assert_matches(second, want)
+    assert not second.equals(first)
+
+
+def test_resume_of_all_empty_output_keeps_schema(tmp_path):
+    """A query whose output is empty in EVERY partition (no matching
+    segment) must resume to the same schema'd empty frame the first
+    run returned — 0-row units keep their schema in the checkpoint
+    meta even though no spill file exists."""
+    from cylon_tpu.tpch import dbgen
+
+    data = dbgen.generate(sf=0.002, seed=0)
+    first = fallback.tpch_fallback("q3", data, n_partitions=2,
+                                   compiled=False,
+                                   segment="NO-SUCH-SEGMENT",
+                                   resume_dir=str(tmp_path))
+    assert len(first) == 0 and list(first.columns) == [
+        "l_orderkey", "revenue", "o_orderdate", "o_shippriority"]
+    second = fallback.tpch_fallback("q3", data, n_partitions=2,
+                                    compiled=False,
+                                    segment="NO-SUCH-SEGMENT",
+                                    resume_dir=str(tmp_path))
+    pd.testing.assert_frame_equal(second, first)
+
+
+def test_merge_sum_tolerates_empty_partitions():
+    """Empty partitions (nothing of the partitioned tables landed
+    there) contribute None partials — a scalar-sum merge adds 0 for
+    them instead of dying on float(None)."""
+    assert fallback._merge_partials(
+        [None, 1.5, None, 2.5], {"merge": "sum"}, None) == 4.0
+
+
+def test_run_query_preflight_tiny_budget_spills(tpch_data, monkeypatch):
+    """Forced-tiny memory budget: the EXPLAIN-style pre-flight routes
+    the query straight to the spill path — nothing in-core runs."""
+    from cylon_tpu import tpch
+
+    monkeypatch.setenv("CYLON_TPU_HBM_BUDGET_BYTES", "4096")
+    before = telemetry.counter("ooc.fallbacks", op="q6",
+                               reason="preflight").value or 0
+    got = fallback.run_query("q6", tpch_data, n_partitions=3,
+                             compiled=False)
+    assert telemetry.counter("ooc.fallbacks", op="q6",
+                             reason="preflight").value == before + 1
+    want = fallback._materialize(tpch.q6(tpch_data))
+    _assert_matches(got, want)
+
+
+def test_run_query_injected_oom_on_q3_completes_via_fallback(tpch_data):
+    """THE acceptance scenario: an injected OOM on a previously
+    in-core-only query (q3, whole-query compiled) completes through
+    the spill fallback with the oracle's answer and ``ooc.fallbacks``
+    >= 1."""
+    from cylon_tpu import tpch
+
+    want = fallback._materialize(tpch.q3(tpch_data))
+    before = telemetry.total("ooc.fallbacks")
+    with resilience.active(FaultPlan(
+            [FaultRule("plan", nth=1,
+                       error=MemoryError(
+                           "RESOURCE_EXHAUSTED: injected"))])):
+        got = fallback.run_query("q3", tpch_data, n_partitions=3)
+    assert telemetry.total("ooc.fallbacks") == before + 1
+    assert telemetry.counter("ooc.fallbacks", op="q3",
+                             reason="oom").value >= 1
+    _assert_matches(got, want)
+
+
+@pytest.mark.slow
+def test_tpch_fallback_resume_replays_partitions(tpch_data, tmp_path):
+    """A second run over the same resume_dir replays every partition
+    from the durable checkpoint (units_resumed covers them all) and
+    returns the identical frame."""
+    first = fallback.tpch_fallback("q3", tpch_data, n_partitions=3,
+                                   compiled=False,
+                                   resume_dir=str(tmp_path))
+    before = telemetry.total("ooc.units_resumed")
+    second = fallback.tpch_fallback("q3", tpch_data, n_partitions=3,
+                                    compiled=False,
+                                    resume_dir=str(tmp_path))
+    assert telemetry.total("ooc.units_resumed") == before + 3
+    pd.testing.assert_frame_equal(second, first)
+
+
+# ------------------------------------------------ kill-mid-fallback
+#: shared driver (the chaos-test pattern): the parent exec()s it for
+#: the oracle, the child script embeds it verbatim
+DRIVER = '''
+def run(resume_dir, out_path):
+    from cylon_tpu import fallback
+    from cylon_tpu.tpch import dbgen
+
+    data = dbgen.generate(sf=0.002, seed=0)
+    got = fallback.tpch_fallback("q3", data, n_partitions=4,
+                                 compiled=False,
+                                 resume_dir=resume_dir)
+    text = got.to_csv(index=False, float_format="%.17g")
+    if out_path:
+        with open(out_path, "w") as f:
+            f.write(text)
+    return text
+'''
+
+CHILD = DRIVER + '''
+
+if __name__ == "__main__":
+    import os
+    import sys
+
+    import cylon_tpu  # noqa: F401  (x64, matching the test process)
+    from cylon_tpu import resilience, telemetry
+
+    rdir, out_path = sys.argv[1:3]
+    kill = os.environ.get("FALLBACK_KILL")
+    if kill:
+        point, nth = kill.rsplit(":", 1)
+        resilience.install(resilience.FaultPlan(
+            [resilience.FaultRule.kill(point, nth=int(nth))]))
+    run(rdir or None, out_path or None)
+    print(f"RESUMED={telemetry.total('ooc.units_resumed')}")
+'''
+
+
+def _child_env(**extra):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["PYTHONPATH"] = str(REPO) + os.pathsep + env.get("PYTHONPATH",
+                                                         "")
+    env.pop("FALLBACK_KILL", None)
+    env.update(extra)
+    return env
+
+
+def test_kill_mid_fallback_resumes_byte_identical(tmp_path):
+    """``FaultRule.kill`` mid-fallback: the child dies rc 43 at the
+    second partition's checkpoint write, the durable manifest holds
+    only complete units, and a fresh child resumes (>=1 unit replayed)
+    to output byte-identical to a fault-free run."""
+    ns: dict = {}
+    exec(DRIVER, ns)
+    want = ns["run"](None, None)
+
+    script = tmp_path / "fallback_child.py"
+    script.write_text(CHILD)
+    rdir, out = tmp_path / "ckpt", tmp_path / "out.csv"
+    p1 = subprocess.run(
+        [sys.executable, str(script), str(rdir), str(out)],
+        env=_child_env(FALLBACK_KILL="spill_write:2"), cwd=str(REPO),
+        capture_output=True, text=True, timeout=240)
+    assert p1.returncode == KILL_EXIT_CODE, (
+        f"kill child survived: rc={p1.returncode}\n{p1.stderr[-2000:]}")
+    assert "injected HARD KILL" in p1.stderr
+    manifest = json.loads((rdir / "manifest.json").read_text())
+    assert 0 < len(manifest["completed"]) < 4
+    assert not out.exists()
+
+    p2 = subprocess.run(
+        [sys.executable, str(script), str(rdir), str(out)],
+        env=_child_env(), cwd=str(REPO), capture_output=True,
+        text=True, timeout=240)
+    assert p2.returncode == 0, p2.stderr[-2000:]
+    resumed = int(p2.stdout.split("RESUMED=")[1].split()[0])
+    assert resumed >= 1, "resume recomputed everything from scratch"
+    assert out.read_text() == want
+
+
+# ----------------------------------------------------- serve degrade
+def _mk_engine(**policy_kw):
+    from cylon_tpu.serve import ServeEngine
+    from cylon_tpu.serve.admission import ServePolicy
+
+    return ServeEngine(policy=ServePolicy(max_queue=4, **policy_kw))
+
+
+def _oom_plan():
+    return FaultPlan([FaultRule(
+        "plan", nth=1, error=MemoryError("injected serve OOM"))])
+
+
+def _oom_query():
+    resilience.inject("plan", "serve-degrade-test")
+    return "in_core"
+
+
+def test_serve_degraded_completion_and_breaker_accounting():
+    """An OOM'd request with an armed fallback retires DONE (degraded,
+    counted ``serve.degraded{tenant}``), its profile says so, and the
+    breaker stays closed — the OOM never feeds the failure streak."""
+    eng = _mk_engine(breaker_fails=1)
+    errors_before = telemetry.total("serve.errors")
+    degraded_before = telemetry.total("serve.degraded")
+    fallbacks_before = telemetry.total("ooc.fallbacks")
+    try:
+        tk = eng.submit(_oom_query, tenant="deg",
+                        fault_plan=_oom_plan(),
+                        fallback=lambda: "degraded-answer")
+        assert tk.result(60) == "degraded-answer"
+        assert tk.state == "done" and tk.degraded
+        assert telemetry.total("serve.degraded") == degraded_before + 1
+        # the pinned trajectory counter counts serve degrades too
+        assert telemetry.total("ooc.fallbacks") == fallbacks_before + 1
+        assert telemetry.total("serve.errors") == errors_before
+        assert eng._admission.breaker.state == "closed"
+        prof = tk.profile()
+        assert prof["degraded"] is True
+        assert prof["fallback"]["fallbacks"] >= 1
+        assert prof["fallback"]["oom_report"] is not None
+        # a later submit still admits: nothing tripped
+        assert eng.submit(lambda: 1, tenant="deg").result(60) == 1
+    finally:
+        eng.close()
+
+
+def test_serve_fallback_that_also_fails_retires_as_error():
+    """Only a fallback that ALSO fails retires as an error — and that
+    failure (a breaking kind) feeds the breaker normally."""
+
+    def bad_fallback():
+        raise ResourceExhausted("spill path exhausted too")
+
+    eng = _mk_engine(breaker_fails=1, breaker_cooldown=30.0)
+    degraded_before = telemetry.total("serve.degraded")
+    try:
+        tk = eng.submit(_oom_query, tenant="deg2",
+                        fault_plan=_oom_plan(), fallback=bad_fallback)
+        with pytest.raises(ResourceExhausted, match="spill path"):
+            tk.result(60)
+        # degraded means COMPLETED through the spill path: a failed
+        # fallback is a plain error — not degraded, not counted
+        assert tk.state == "failed" and not tk.degraded
+        assert telemetry.total("serve.degraded") == degraded_before
+        assert eng._admission.breaker.state == "open"
+        with pytest.raises(ResourceExhausted, match="breaker"):
+            eng.submit(lambda: 1, tenant="deg2")
+    finally:
+        eng.close()
+
+
+def test_serve_oom_without_fallback_errors_as_before():
+    eng = _mk_engine()
+    try:
+        tk = eng.submit(_oom_query, tenant="nofb",
+                        fault_plan=_oom_plan())
+        with pytest.raises(MemoryError):
+            tk.result(60)
+        assert tk.state == "failed" and not tk.degraded
+    finally:
+        eng.close()
+
+
+def test_registered_fallback_survives_submit_named():
+    """register_query(name, fn, fallback=...) arms the degrade path on
+    EVERY submit_named — the same path a journal replay takes after
+    recover(), so degradation survives a crash instead of the replayed
+    request dying on the same OOM and feeding the breaker."""
+
+    def q(x, scale=1):
+        resilience.inject("plan", "named")
+        return x * scale
+
+    def q_spill(x, scale=1):
+        return ("spilled", x * scale)
+
+    eng = _mk_engine(breaker_fails=1)
+    try:
+        eng.register_query("scaled", q, fallback=q_spill)
+        tk = eng.submit_named("scaled", 7, scale=3, tenant="named",
+                              fault_plan=_oom_plan())
+        assert tk.result(60) == ("spilled", 21)
+        assert tk.state == "done" and tk.degraded
+        assert eng._admission.breaker.state == "closed"
+        # without an injected OOM the registered fallback stays idle
+        tk2 = eng.submit_named("scaled", 7, scale=3, tenant="named")
+        assert tk2.result(60) == 21 and not tk2.degraded
+        # explicit fallback=None is a per-request OPT-OUT: strict
+        # in-core-or-error semantics even with a registered fallback
+        tk3 = eng.submit_named("scaled", 7, tenant="named",
+                               fault_plan=_oom_plan(), fallback=None)
+        with pytest.raises(MemoryError):
+            tk3.result(60)
+        assert not tk3.degraded
+    finally:
+        eng.close()
+
+
+def test_serve_memory_admission_sheds():
+    """Predicted bytes over the memory budget shed at the front door:
+    ``serve.shed{reason=memory}``, no slot taken, fast
+    ResourceExhausted."""
+    eng = _mk_engine(memory_budget=1000)
+    shed_before = telemetry.total("serve.shed")
+    try:
+        with pytest.raises(ResourceExhausted, match="memory budget"):
+            eng.submit(lambda: 1, tenant="mem", predicted_bytes=10_000)
+        assert telemetry.counter("serve.shed", reason="memory",
+                                 tenant="mem").value == 1
+        assert telemetry.total("serve.shed") == shed_before + 1
+        assert eng.live == 0  # no slot leaked
+        # under budget admits normally
+        assert eng.submit(lambda: 2, tenant="mem",
+                          predicted_bytes=500).result(60) == 2
+    finally:
+        eng.close()
+
+
+def test_serve_tpch_degraded_request_oracle_exact(tpch_data):
+    """Serve-layer acceptance: a q3 request that OOMs degrades through
+    the manifest fallback and retires successfully with the oracle's
+    frame, ``degraded=true`` + partition count in its profile, breaker
+    closed."""
+    from cylon_tpu import tpch
+
+    want = fallback._materialize(tpch.q3(tpch_data))
+
+    def q3_query():
+        resilience.inject("plan", "q3")
+        return fallback._materialize(tpch.q3(tpch_data))
+
+    eng = _mk_engine(breaker_fails=1)
+    try:
+        tk = eng.submit(
+            q3_query, tenant="tpch", fault_plan=_oom_plan(),
+            fallback=lambda: fallback.tpch_fallback(
+                "q3", tpch_data, n_partitions=3, compiled=False))
+        got = tk.result(300)
+        assert tk.state == "done" and tk.degraded
+        prof = tk.profile()
+        assert prof["degraded"] is True
+        assert prof["fallback"]["partitions"] == 3
+        assert eng._admission.breaker.state == "closed"
+    finally:
+        eng.close()
+    _assert_matches(got, want)

@@ -447,8 +447,11 @@ def test_run_tpch_crash_branch_accounting(bench_suite_mod, monkeypatch,
 
     monkeypatch.setattr(tpch, "q3", dead_q3)
     acct = bench_suite_mod._run_tpch(0.01, 1)
+    # q3 has a generic spill plan since ISSUE 10, so a crashed q3 now
+    # OWES an out-of-core completion (dead backend here → recorded as
+    # ooc_dropped, returned as pending)
     assert acct == {"attempted": ["q3"], "crashed": ["q3"],
-                    "skipped": ["q6"], "ooc_pending": []}
+                    "skipped": ["q6"], "ooc_pending": ["q3"]}
     lines = [json.loads(ln) for ln in
              capsys.readouterr().out.splitlines() if ln.startswith("{")]
     by_metric = {ln["metric"]: ln["value"] for ln in lines}
@@ -456,6 +459,7 @@ def test_run_tpch_crash_branch_accounting(bench_suite_mod, monkeypatch,
     assert by_metric["tpch_sf0.01_crashed"] == 1
     assert by_metric["tpch_sf0.01_skipped"] == 1
     assert by_metric["tpch_q3_sf0.01_device_crash"] == 1
+    assert by_metric["tpch_q3_sf0.01_ooc_dropped"] == 1
 
 
 def test_tpch_respawn_loop_until_complete(bench_suite_mod, monkeypatch):
