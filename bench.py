@@ -43,6 +43,13 @@ Perfetto / ``chrome://tracing``) and pins its path + event count +
 rank-track count + per-stage wall coverage into the JSON record
 (:data:`REQUIRED_TRACE_FIELDS`, schema enforced by
 ``tests/test_bench_guard.py``).
+
+``--join-ab`` races the sort join against the bucketed O(n) hash join
+(``ops/hash_join.py``) at ``CYLON_BENCH_JOIN_AB_ROWS`` sizes x
+``CYLON_BENCH_JOIN_AB_DISTS`` key distributions, with staged
+build/probe walls under ``join.build``/``join.probe`` spans; one
+:data:`REQUIRED_JOIN_AB_FIELDS` record per config (the A/B verdict
+artifact ``docs/joins.md`` cites).
 """
 
 import json
@@ -214,6 +221,135 @@ REQUIRED_TRACE_FIELDS = frozenset({
     "trace_stage_coverage", "trace_dropped",
 })
 
+#: fields every ``--join-ab`` record must pin (ISSUE 12) — the A/B
+#: verdict is only reproducible if each record names the config, both
+#: walls, the winner, and whether the bucketed path's overflow
+#: fallback fired (``tests/test_bench_guard.py`` pins this set).
+REQUIRED_JOIN_AB_FIELDS = frozenset({
+    "rows", "distribution", "sort_wall", "hash_wall", "winner",
+    "overflow_fallbacks",
+})
+
+
+def _join_ab_keys(n, dist, rng):
+    """Left-side key distribution per config; the right side is always
+    ~unique (uniform over [0, n)) so the OUTPUT stays ~n rows while the
+    left side's duplication drives the bucket-chain pressure (bucket
+    load depends on key multiplicity, not value skew — the murmur hash
+    randomises values)."""
+    if dist == "uniform":
+        lk = rng.integers(0, n, n)          # ~Poisson(1) duplication
+    elif dist == "zipf":
+        # heavy-head key frequencies: a few keys carry huge chains
+        # (straddling the bucket width), the tail is near-unique
+        lk = np.minimum(rng.zipf(1.5, n), n) - 1
+    elif dist == "dup64":
+        # every key ~64x duplicated: every chain exceeds the default
+        # width-16 budget, so the bucketed hash path MUST take its
+        # overflow fallback — this config measures that path's cost
+        lk = rng.integers(0, max(n // 64, 1), n)
+    else:
+        raise ValueError(f"unknown distribution {dist!r}")
+    rk = rng.integers(0, n, n)
+    return lk.astype(np.int64), rk.astype(np.int64)
+
+
+def _bench_join_ab(rows_list, dists, reps):
+    """A/B race: the sort join vs the shipped ``algorithm="hash"``
+    bucketed build/probe, per size x key distribution, plus staged
+    build/probe walls (``join.build`` / ``join.probe`` spans — the
+    same series ``RequestProfiler`` attributes serve-request stages
+    from). Emits one :data:`REQUIRED_JOIN_AB_FIELDS` record per config
+    and returns the list."""
+    import time as _time
+
+    import jax
+
+    from cylon_tpu import Table, telemetry
+    from cylon_tpu.ops import hash_join
+    from cylon_tpu.ops.join import join
+    from cylon_tpu.utils import tracing
+
+    # the race is sort vs the BUCKETED kernel: pin the impl so the
+    # record is reproducible from its own command line regardless of
+    # the shipped DEFAULT_HASH_IMPL verdict (override to taste)
+    os.environ.setdefault("CYLON_TPU_JOIN_HASH_IMPL", "bucketed")
+
+    rng = np.random.default_rng(11)
+    records = []
+    for n in rows_list:
+        n_reps = max(1, reps if n < 50_000_000 else 1)
+        for dist in dists:
+            lk, rk = _join_ab_keys(n, dist, rng)
+            lt = Table.from_pydict({"k": lk, "a": rng.normal(size=n)})
+            rt = Table.from_pydict({"k": rk, "b": rng.normal(size=n)})
+            out_cap = 4 * n
+            walls, out_rows = {}, {}
+            ovf0 = telemetry.counter("join.overflow_fallbacks").value
+            for alg in ("sort", "hash"):
+                times = []
+                for rep in range(n_reps + 1):  # rep 0 = compile
+                    t0 = _time.perf_counter()
+                    res = join(lt, rt, on="k", how="inner",
+                               algorithm=alg, out_capacity=out_cap,
+                               ordered=False)
+                    nr = int(res.nrows)  # full program sync
+                    if rep:
+                        times.append(_time.perf_counter() - t0)
+                assert 0 < nr <= out_cap, f"bad A/B join {nr}"
+                walls[alg] = min(times)
+                out_rows[alg] = nr
+            overflowed = (telemetry.counter(
+                "join.overflow_fallbacks").value - ovf0)
+            assert out_rows["sort"] == out_rows["hash"], \
+                f"A/B row-set mismatch {out_rows}"
+            # staged walls: build and probe as separate dispatches
+            # under the join.build/join.probe spans (stage attribution)
+            bj = jax.jit(lambda kd, nr_: hash_join.build_phase(
+                [kd], [None], nr_))
+            pj = jax.jit(lambda kd, nr_, tbl, bw: hash_join.probe_phase(
+                [kd], [None], nr_, tbl, bw))
+            kd_b, kd_p = lt.column("k").data, rt.column("k").data
+            table = bwords = None
+            build_s = probe_s = None
+            for rep in range(2):  # rep 0 = compile
+                t0 = _time.perf_counter()
+                with tracing.span("join.build"):
+                    table, ovf, _, bwords = jax.block_until_ready(
+                        bj(kd_b, lt.nrows))
+                if rep:
+                    build_s = _time.perf_counter() - t0
+                t0 = _time.perf_counter()
+                with tracing.span("join.probe"):
+                    mask, _ = jax.block_until_ready(
+                        pj(kd_p, rt.nrows, table, bwords))
+                if rep:
+                    probe_s = _time.perf_counter() - t0
+            record = {
+                "metric": "join_ab",
+                "rows": n,
+                "distribution": dist,
+                "sort_wall": round(walls["sort"], 4),
+                "hash_wall": round(walls["hash"], 4),
+                "winner": ("hash" if walls["hash"] < walls["sort"]
+                           else "sort"),
+                "overflow_fallbacks": int(overflowed),
+                "out_rows": out_rows["sort"],
+                "build_s": round(build_s, 4),
+                "probe_s": round(probe_s, 4),
+                "build_overflow_rows": int(ovf),
+                "reps": n_reps,
+                "hash_impl": hash_join.hash_impl(),
+                "bucket_width": hash_join.bucket_width(),
+                "platform": jax.default_backend(),
+            }
+            missing = REQUIRED_JOIN_AB_FIELDS - record.keys()
+            assert not missing, f"join-ab record dropped {missing}"
+            _emit_record(record)
+            records.append(record)
+            del lt, rt
+    return records
+
 
 def _traced_headline_join(n: int, rng) -> dict:
     """One eager ``dist_join`` over every visible device with the
@@ -293,6 +429,15 @@ def _emit_record(line: dict):
 
 
 def main():
+    if "--join-ab" in sys.argv[1:]:
+        rows_list = [int(x) for x in os.environ.get(
+            "CYLON_BENCH_JOIN_AB_ROWS",
+            "1000000,10000000,100000000").split(",")]
+        dists = os.environ.get("CYLON_BENCH_JOIN_AB_DISTS",
+                               "uniform,zipf,dup64").split(",")
+        reps = int(os.environ.get("CYLON_BENCH_JOIN_AB_REPS", 3))
+        _bench_join_ab(rows_list, dists, reps)
+        return
     do_trace = "--trace" in sys.argv[1:] or os.environ.get(
         "CYLON_BENCH_TRACE", "") not in ("", "0", "off")
     n = int(os.environ.get("CYLON_BENCH_ROWS", 1_000_000))

@@ -585,7 +585,7 @@ def _as_cols(src) -> "dict[str, np.ndarray]":
 def join(left: Mapping, right: Mapping, on, how: str = "inner", *,
          n_partitions: "int | None" = None, chunk_rows: int = 1 << 22,
          suffixes=("_x", "_y"), resume_dir: "str | None" = None,
-         budget_bytes: "int | None" = None):
+         budget_bytes: "int | None" = None, algorithm: str = "sort"):
     """Spill-aware equi-join over host column mappings: in-core device
     join when it fits, :func:`cylon_tpu.outofcore.ooc_join`
     (hash-partitioned by ``on`` — the plain-op dominant key) when it
@@ -615,7 +615,8 @@ def join(left: Mapping, right: Mapping, on, how: str = "inner", *,
                 res = dev_join(lt, rt,
                                on=keys if len(keys) > 1 else keys[0],
                                how=how, suffixes=suffixes,
-                               out_capacity=cap, ordered=False)
+                               out_capacity=cap, ordered=False,
+                               algorithm=algorithm)
                 if int(res.nrows) <= cap:
                     return res.to_pandas().reset_index(drop=True)
             except OutOfCapacity:
@@ -637,7 +638,7 @@ def join(left: Mapping, right: Mapping, on, how: str = "inner", *,
         ooc_join(lcols, rcols, on=on, how=how,
                  n_partitions=n_partitions, chunk_rows=chunk_rows,
                  sink=frames.append, suffixes=suffixes,
-                 resume_dir=resume_dir)
+                 resume_dir=resume_dir, algorithm=algorithm)
         return (pd.concat(frames, ignore_index=True) if frames
                 else pd.DataFrame())
 

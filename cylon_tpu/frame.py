@@ -263,8 +263,9 @@ class DataFrame:
               out_capacity: int | None = None,
               algorithm: str = "sort") -> "DataFrame":
         """Parity: ``DataFrame.merge`` (frame.py:1516). ``algorithm``
-        mirrors pycylon's sort/hash choice ("hash" = murmur-bucket
-        grouping, see ``ops.join.join``)."""
+        mirrors pycylon's sort/hash choice ("hash" = the bucketed O(n)
+        build/probe with sort fallback, see ``ops.join.join`` and
+        ``docs/joins.md``; ``CYLON_TPU_JOIN_ALGORITHM`` overrides)."""
         if env is not None:
             t = dist_join(env, self._table, right._table, on=on,
                           left_on=left_on, right_on=right_on, how=how,

@@ -17,9 +17,17 @@ segment-sum or gather — all static-shape XLA ops that tile onto the TPU.
 
 Cost: O((|L|+|R|) log(|L|+|R|)) like the reference's sort join, but with
 no per-row control flow, so the whole join stays inside one jit.
+
+``algorithm="hash"`` routes to the true O(n) bucketed build/probe
+(:mod:`cylon_tpu.ops.hash_join` — power-of-2 bucket table, exact key
+words as collision tiebreakers, sort fallback when a bucket chain
+exceeds the budget). Routing is observable: every call counts
+``join.algorithm{kind="requested->chosen"}`` and eager overflow
+fallbacks count ``join.overflow_fallbacks`` (see ``docs/joins.md``).
 """
 
 import functools
+import os
 from typing import Sequence
 
 import jax
@@ -33,6 +41,72 @@ from cylon_tpu.ops.dictenc import unify_dictionaries
 from cylon_tpu.ops.selection import take_columns
 from cylon_tpu.platform import platform_jit
 from cylon_tpu.table import Table
+from cylon_tpu.utils.logging import get_logger
+
+#: one-shot flags for routing downgrades that used to be silent (or,
+#: historically, errors): warn the first time, count every time.
+_warned: set = set()
+
+
+def _env_algorithm() -> "str | None":
+    """``CYLON_TPU_JOIN_ALGORITHM``: process-wide override of the
+    per-call ``algorithm`` hint ("sort" | "hash"; unset/other = respect
+    the caller)."""
+    v = os.environ.get("CYLON_TPU_JOIN_ALGORITHM", "").lower()
+    return v if v in ("sort", "hash") else None
+
+
+def _warn_once(key: str, msg: str) -> None:
+    if key not in _warned:
+        _warned.add(key)
+        get_logger().warning(msg)
+
+
+def _route_algorithm(requested: str, how: str,
+                     tracing: bool) -> str:
+    """Resolve the user-facing ``algorithm`` hint to the kernel
+    ``_join_compiled`` dispatches on, and count the decision.
+
+    Returns one of:
+
+    * ``"sort"`` — key-rank sort join (also every fallback target);
+    * ``"hash_sort"`` — the legacy murmur-bucket-first sort join
+      (``group_sort(hash_first=True)``), the pre-bucketed rendition of
+      HASH kept selectable via ``CYLON_TPU_JOIN_HASH_IMPL=sort``;
+    * ``"hash_bucketed"`` — bucketed build/probe, no overflow guard
+      (the EAGER caller pre-checked chains host-side);
+    * ``"hash_guarded"`` — bucketed build/probe with the in-graph
+      ``lax.cond`` sort fallback (traced callers cannot sync).
+
+    ``algorithm="hash"`` is a HINT, never a crash: unsupported ``how``
+    downgrades to the sort path with a one-shot warning.
+    """
+    from cylon_tpu import telemetry
+    from cylon_tpu.ops import hash_join
+
+    chosen = requested
+    if requested == "hash":
+        if not hash_join.supported(how):
+            # fullouter emits the sorted key union — bucket emission
+            # cannot reproduce it; the old code errored/silently
+            # downgraded depending on `ordered`, now it is always the
+            # documented sort fallback with a one-shot warning
+            _warn_once(f"hash-{how}",
+                       f'join(algorithm="hash", how="{how}"): bucketed '
+                       "hash join does not support this variant; "
+                       "taking the sort path (the hint is honored "
+                       "where supported, never an error)")
+            chosen = "sort"
+        elif hash_join.hash_impl() == "sort":
+            chosen = "hash_sort"
+        else:
+            chosen = "hash_guarded" if tracing else "hash_bucketed"
+    if chosen != "hash_bucketed":
+        # the eager bucketed path counts AFTER its host-side overflow
+        # pre-check so a fallback is recorded as exactly one decision
+        telemetry.counter("join.algorithm",
+                          kind=f"{requested}->{chosen}").inc()
+    return chosen
 
 
 def join(left: Table, right: Table, config: JoinConfig | None = None, *,
@@ -60,10 +134,15 @@ def join(left: Table, right: Table, config: JoinConfig | None = None, *,
 
     ``algorithm`` (parity: ``JoinAlgorithm`` {SORT, HASH},
     ``join_config.hpp:25-31``): "sort" groups rows by lexicographic key
-    rank; "hash" by murmur bucket with the keys as collision tiebreakers
-    (``kernels.group_sort(hash_first=True)``) — the TPU rendition of the
-    reference's flat_hash_map build/probe. Both are exact; output row
-    sets are identical.
+    rank; "hash" is the true O(n) bucketed build/probe
+    (:mod:`cylon_tpu.ops.hash_join` — the reference's flat_hash_map
+    build/probe, ``hash_join.cpp:22-31``), falling back to the sort
+    path for unsupported variants (fullouter) and over-budget bucket
+    chains. Both are exact; output row sets are identical (and for
+    ``ordered=True`` the outputs are byte-identical).
+    ``CYLON_TPU_JOIN_ALGORITHM`` overrides the hint process-wide;
+    ``CYLON_TPU_JOIN_HASH_IMPL=sort`` pins "hash" to the legacy
+    murmur-bucket-first sort ordering. See ``docs/joins.md``.
     """
     if config is not None:
         left_on = list(config.left_on)
@@ -90,12 +169,9 @@ def join(left: Table, right: Table, config: JoinConfig | None = None, *,
                                    suffixes)
     if how not in ("inner", "left", "fullouter"):
         raise InvalidArgument(f"unknown join type {how!r}")
+    algorithm = _env_algorithm() or algorithm
     if algorithm not in ("sort", "hash"):
         raise InvalidArgument(f"unknown join algorithm {algorithm!r}")
-    if how == "fullouter" and ordered and algorithm == "hash":
-        # pandas sorts the key union for outer joins; hash-bucket group
-        # order cannot reproduce that — use key-ordered grouping
-        algorithm = "sort"
 
     cl, cr = left.capacity, right.capacity
     if out_capacity is not None:
@@ -109,7 +185,34 @@ def join(left: Table, right: Table, config: JoinConfig | None = None, *,
 
     # host-side: dictionary unification (string keys) happens before the
     # traced core — device code only sees codes
-    left, right, _, _, _, _ = _aligned_keys(left, right, left_on, right_on)
+    left, right, lkeys, rkeys, lvals, rvals = _aligned_keys(
+        left, right, left_on, right_on)
+
+    # algorithm routing (observable: join.algorithm counter, see
+    # _route_algorithm). Under a trace (shard_map / whole-query plans)
+    # the overflow decision must live in-graph; eager callers pre-check
+    # the build side's chains host-side and route statically instead —
+    # no dual-branch program, and the fallback is counted exactly.
+    tracing = any(isinstance(x, jax.core.Tracer)
+                  for x in (*lkeys, *rkeys, left.nrows, right.nrows))
+    kernel = _route_algorithm(algorithm, how, tracing)
+    if kernel == "hash_bucketed":
+        from cylon_tpu import telemetry
+        from cylon_tpu.ops import hash_join
+        from cylon_tpu.utils import tracing as _tr
+
+        if how == "inner" and cl <= cr:
+            bkeys, bvals, brows = lkeys, lvals, left.nrows
+        else:
+            bkeys, bvals, brows = rkeys, rvals, right.nrows
+        with _tr.span("join.route"):
+            if hash_join.chain_overflow(bkeys, bvals, brows):
+                telemetry.counter("join.overflow_fallbacks").inc()
+                kernel = "sort"
+        telemetry.counter(
+            "join.algorithm",
+            kind=("hash->sort_overflow" if kernel == "sort"
+                  else "hash->hash_bucketed")).inc()
 
     # one compiled program for match + expansion + assembly: the eager
     # op-by-op path pays a per-primitive dispatch round trip (~ms on a
@@ -117,7 +220,7 @@ def join(left: Table, right: Table, config: JoinConfig | None = None, *,
     return _join_compiled(left, right, left_on=tuple(left_on),
                           right_on=tuple(right_on), how=how,
                           suffixes=tuple(suffixes), out_cap=int(out_cap),
-                          algorithm=algorithm, ordered=ordered)
+                          algorithm=kernel, ordered=ordered)
 
 
 @functools.partial(platform_jit, static_argnames=("left_on", "right_on",
@@ -131,9 +234,23 @@ def _join_compiled(left: Table, right: Table, *, left_on, right_on, how,
     rkeys = [right.column(n).data for n in right_on]
     lvals = [left.column(n).validity for n in left_on]
     rvals = [right.column(n).validity for n in right_on]
-    left_idx, right_idx, total = _join_indices(
-        lkeys, lvals, left.nrows, rkeys, rvals, right.nrows, how, out_cap,
-        hash_first=algorithm == "hash", ordered=ordered)
+    if algorithm in ("hash_bucketed", "hash_guarded"):
+        from cylon_tpu.ops import hash_join
+
+        sort_fb = None
+        if algorithm == "hash_guarded":
+            def sort_fb():
+                return _join_indices(lkeys, lvals, left.nrows, rkeys,
+                                     rvals, right.nrows, how, out_cap,
+                                     hash_first=False, ordered=ordered)
+        left_idx, right_idx, total = hash_join.bucketed_join_indices(
+            lkeys, lvals, left.nrows, rkeys, rvals, right.nrows, how,
+            out_cap, ordered, sort_fallback=sort_fb)
+    else:
+        left_idx, right_idx, total = _join_indices(
+            lkeys, lvals, left.nrows, rkeys, rvals, right.nrows, how,
+            out_cap, hash_first=algorithm == "hash_sort",
+            ordered=ordered)
     res = _assemble(left, right, list(left_on), list(right_on),
                     suffixes, left_idx, right_idx, total, how)
     return kernels.carry_overflow(res, left, right)

@@ -332,3 +332,208 @@ def pair_max_scan(hi: jax.Array, lo: jax.Array):
     before any nonzero pair read (0, 0), matching the u64 encoding's
     semantics. Callers gate on :func:`scan32_ok` for both operands."""
     return _pair_max_impl(hi, lo, _interpret())
+
+
+# ------------------------------------------------- bucketed hash join
+# The build/probe pair for the O(n) bucketed hash join
+# (``ops/hash_join.py``): the reference's flat_hash_map build/probe
+# (``join/hash_join.cpp:22-31``) rendered as a power-of-2 bucket table
+# of fixed-width chains, VMEM-resident for the whole build and probe.
+# Insertion and lookup are data-dependent per row, which Mosaic cannot
+# vectorise — both kernels run a sequential per-element loop over each
+# tile with the table pinned in VMEM, trading vector throughput for a
+# single pass over HBM (the jnp twins in ``ops/hash_join.py`` pay
+# ~width scatter/gather passes instead; both are bit-identical).
+
+_JOIN_LANES = 128      # lanes per build/probe tile (8 x 128 elements)
+
+
+def _32bit_trace(interpret: bool):
+    """x64-off trace scope for Mosaic compiles only: interpret mode
+    must trace under the ambient setting (see the call sites)."""
+    import contextlib
+
+    return contextlib.nullcontext() if interpret \
+        else jax.enable_x64(False)
+
+
+def _bucket_build_kernel(width: int, rows: int, lanes: int,
+                         bid_ref, table_ref, ovf_ref):
+    """Sequential first-free-entry insertion, ascending row order.
+
+    ``bid_ref``: [rows, lanes] int32 bucket ids (-1 = skip: padding or
+    invalid row). ``table_ref``: [width, nb] int32 bucket table —
+    entry e of bucket b ends up holding the (e+1)-th inserted row id
+    (ascending), -1 when empty; the ENTRY-major layout keeps the lane
+    dimension at nb (pow-2, lane-aligned), not the tiny chain width.
+    ``ovf_ref``: [1, 1] SMEM count of rows whose chain was full — any
+    nonzero means the caller must take the sort fallback (the table is
+    then missing rows and MUST not be probed for real results).
+    """
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        table_ref[...] = jnp.full_like(table_ref, -1)
+        ovf_ref[0, 0] = jnp.int32(0)
+
+    base = (i * rows * lanes).astype(jnp.int32)
+
+    def row_body(k, carry):
+        b = bid_ref[k // lanes, k % lanes]
+
+        @pl.when(b >= 0)
+        def _insert():
+            def entry(e, placed):
+                cur = table_ref[e, b]
+                take = jnp.logical_and(jnp.logical_not(placed), cur < 0)
+
+                @pl.when(take)
+                def _write():
+                    # explicit i32: the interpret-mode state discharge
+                    # re-evaluates stores under the AMBIENT x64 setting,
+                    # where a weakly-typed sum would widen and mismatch
+                    # the i32 table
+                    table_ref[e, b] = (base + k).astype(jnp.int32)
+
+                return jnp.logical_or(placed, take)
+
+            placed = jax.lax.fori_loop(0, width, entry, jnp.bool_(False))
+
+            @pl.when(jnp.logical_not(placed))
+            def _overflow():
+                ovf_ref[0, 0] = (ovf_ref[0, 0] + 1).astype(jnp.int32)
+
+        return carry
+
+    jax.lax.fori_loop(0, rows * lanes, row_body, jnp.int32(0))
+
+
+@functools.partial(jax.jit, static_argnames=("nb", "width", "interpret"))
+def _bucket_build_impl(bids, nb: int, width: int, interpret: bool):
+    cap = bids.shape[0]
+    r, b = _SUBLANES, _JOIN_LANES
+    tile = r * b
+    capp = max(-(-cap // tile) * tile, tile)
+    bids2 = _pad_to(bids, capp, -1).reshape(capp // b, b)
+    # Mosaic rejects the i64 constants x64 puts into BlockSpec index
+    # maps — trace 32-bit for the real-TPU compile. The interpret-mode
+    # evaluator is the opposite: its state discharge re-evaluates
+    # stores under the AMBIENT x64 setting, so an x64-off trace there
+    # manufactures i32/i64 mixes inside the loop bodies.
+    with _32bit_trace(interpret):
+        table, ovf = pl.pallas_call(
+            functools.partial(_bucket_build_kernel, width, r, b),
+            grid=(capp // tile,),
+            in_specs=[pl.BlockSpec((r, b), lambda i: (i, 0))],
+            out_specs=[pl.BlockSpec((width, nb), lambda i: (0, 0)),
+                       pl.BlockSpec(memory_space=pltpu.SMEM)],
+            out_shape=[_out_struct((width, nb), jnp.int32, bids2),
+                       _out_struct((1, 1), jnp.int32, bids2)],
+            interpret=interpret,
+        )(bids2)
+    return table, ovf[0, 0]
+
+
+def bucket_build(bids: jax.Array, nb: int, width: int):
+    """Build the [width, nb] bucket table from [cap] int32 bucket ids
+    (-1 = skip). Returns ``(table, overflow_count)``; bit-identical to
+    ``hash_join._build_jnp`` (first-free-entry, ascending row id)."""
+    return _bucket_build_impl(bids, nb, width, _interpret())
+
+
+def _bucket_probe_kernel(width: int, nwords: int, lanes: int, *refs):
+    """Per-element bucket lookup + exact key compare.
+
+    refs: pbid [rows, lanes] i32 (-1 = invalid probe row), then
+    ``nwords`` probe word tiles [rows, lanes] u32, the full
+    [width, nb] table, the full [nwords, bcapp] build word matrix, and
+    the [rows, lanes] i32 output mask (bit e set <=> table[e, bucket]
+    holds a row whose canonical key words all equal the probe row's).
+    """
+    pbid_ref = refs[0]
+    pword_refs = refs[1:1 + nwords]
+    table_ref = refs[1 + nwords]
+    bwords_ref = refs[2 + nwords]
+    mask_ref = refs[-1]
+    rows = pbid_ref.shape[0]
+
+    def body(k, carry):
+        r = k // lanes
+        c = k % lanes
+        b = pbid_ref[r, c]
+        bsafe = jnp.maximum(b, 0)
+        m = jnp.int32(0)
+        for e in range(width):
+            rr = table_ref[e, bsafe]
+            rsafe = jnp.maximum(rr, 0)
+            eq = rr >= 0
+            for w in range(nwords):
+                eq = jnp.logical_and(
+                    eq, pword_refs[w][r, c] == bwords_ref[w, rsafe])
+            m = m | jnp.where(eq, jnp.int32(1 << e), jnp.int32(0))
+        mask_ref[r, c] = jnp.where(b >= 0, m, jnp.int32(0)
+                                   ).astype(jnp.int32)
+        return carry
+
+    jax.lax.fori_loop(0, rows * lanes, body, jnp.int32(0))
+
+
+@functools.partial(jax.jit, static_argnames=("width", "interpret"))
+def _bucket_probe_impl(pbids, pwords, table, bwords, width: int,
+                       interpret: bool):
+    cap = pbids.shape[0]
+    nwords = len(pwords)
+    nb = table.shape[1]
+    r, b = _SUBLANES, _JOIN_LANES
+    tile = r * b
+    capp = max(-(-cap // tile) * tile, tile)
+    pbids2 = _pad_to(pbids, capp, -1).reshape(capp // b, b)
+    pwords2 = [_pad_to(w, capp, 0).reshape(capp // b, b) for w in pwords]
+    bcap = bwords[0].shape[0]
+    bcapp = max(-(-bcap // b) * b, b)
+    bw = jnp.stack([_pad_to(w, bcapp, 0) for w in bwords])
+    with _32bit_trace(interpret):
+        out = pl.pallas_call(
+            functools.partial(_bucket_probe_kernel, width, nwords, b),
+            grid=(capp // tile,),
+            in_specs=[pl.BlockSpec((r, b), lambda i: (i, 0))]
+                     * (1 + nwords)
+                     + [pl.BlockSpec((width, nb), lambda i: (0, 0)),
+                        pl.BlockSpec((nwords, bcapp), lambda i: (0, 0))],
+            out_specs=pl.BlockSpec((r, b), lambda i: (i, 0)),
+            out_shape=_out_struct((capp // b, b), jnp.int32, pbids2),
+            interpret=interpret,
+        )(pbids2, *pwords2, table, bw)
+    return out.reshape(capp)[:cap]
+
+
+def bucket_probe(pbids: jax.Array, pwords, table: jax.Array, bwords):
+    """Probe the bucket table: [cap] int32 match bitmasks (bit e set
+    <=> ``table[e, pbids]`` matched exactly). ``pwords``/``bwords`` are
+    the canonical u32 word streams (``hash._row_words``) of the probe /
+    build rows. Bit-identical to ``hash_join._probe_jnp``."""
+    return _bucket_probe_impl(pbids, tuple(pwords), table, tuple(bwords),
+                              table.shape[0], _interpret())
+
+
+#: VMEM budget for the resident bucket table + build key words — above
+#: this the Pallas path loses its "table stays on-chip" premise and the
+#: jnp twins (HBM scatters/gathers) take over.
+JOIN_VMEM_BUDGET = 4 << 20
+
+
+def bucket_join_ok(x, nb: int, width: int, nwords: int,
+                   build_cap: int) -> bool:
+    """Can the Pallas bucket kernels run for this operand here? Gated
+    like every kernel on :func:`usable_for`, plus the table + build
+    words must fit the VMEM budget."""
+    import os as _os
+
+    try:
+        budget = int(_os.environ.get("CYLON_TPU_JOIN_VMEM_BUDGET",
+                                     JOIN_VMEM_BUDGET))
+    except ValueError:
+        budget = JOIN_VMEM_BUDGET
+    resident = (nb * width + nwords * max(build_cap, _JOIN_LANES)) * 4
+    return usable_for(x) and resident <= budget

@@ -154,7 +154,8 @@ def ooc_join(left, right, on, how: str = "inner",
              n_partitions: int = 8, chunk_rows: int = 1 << 22,
              sink: Callable | None = None,
              suffixes=("_x", "_y"),
-             resume_dir: str | None = None) -> int:
+             resume_dir: str | None = None,
+             algorithm: str = "sort") -> int:
     """Out-of-core equi-join. ``left``/``right``: host column dicts,
     re-iterables of chunks, or zero-arg callables returning fresh
     chunk iterators (one-shot iterators are rejected — see
@@ -190,9 +191,22 @@ def ooc_join(left, right, on, how: str = "inner",
     rchunks = _resolve_source(right, "ooc_join", chunk_rows)
     ckpt = None
     if resume_dir is not None:
+        # the local-join kernel (sort vs bucketed hash) changes the
+        # ordered=False row ORDER, so it is part of the partition plan:
+        # a resume under a different EFFECTIVE kernel must recompute,
+        # not mix — and the effective kernel is decided by the env
+        # overrides (CYLON_TPU_JOIN_ALGORITHM / _HASH_IMPL / the chain
+        # budget), not just the param, so fingerprint those
+        from cylon_tpu.ops import hash_join
+        from cylon_tpu.ops.join import _env_algorithm
+
+        eff = _env_algorithm() or algorithm
+        fp_alg = () if eff == "sort" else (
+            (eff, hash_join.hash_impl(), hash_join.bucket_width()),)
         ckpt = resilience.CheckpointedRun(
             resume_dir, "join",
-            (tuple(keys), how, int(n_partitions), tuple(suffixes)))
+            (tuple(keys), how, int(n_partitions), tuple(suffixes))
+            + fp_alg)
     lparts = host_partition_chunks(lchunks(), keys, n_partitions)
     rparts = host_partition_chunks(rchunks(), keys, n_partitions)
 
@@ -262,7 +276,8 @@ def ooc_join(left, right, on, how: str = "inner",
                     res = dev_join(lt, rt, on=keys if len(keys) > 1
                                    else keys[0], how=how,
                                    suffixes=suffixes,
-                                   out_capacity=cap, ordered=False)
+                                   out_capacity=cap, ordered=False,
+                                   algorithm=algorithm)
                     nrows = int(res.nrows)
                 except OutOfCapacity:
                     nrows = cap + 1

@@ -54,7 +54,7 @@ REQUIRED_PROFILE_FIELDS = (
     "rid", "tenant", "state", "slo_s", "queue_wait_s", "wall_s",
     "steps", "stages", "operators", "compile", "memory", "spill",
     "faults", "plan_cache", "headroom_ratio", "stage_walls_s",
-    "stage_coverage", "degraded", "fallback",
+    "stage_coverage", "degraded", "fallback", "join",
 )
 
 
@@ -78,6 +78,7 @@ _COUNTERS = (
     "spill.read_bytes", "spill.write_bytes", "resilience.retries",
     "resilience.faults_injected", "ooc.chunks", "ooc.rows_out",
     "ooc.fallbacks", "ooc.fallback_partitions", "ooc.units_resumed",
+    "join.algorithm", "join.overflow_fallbacks",
 )
 
 _SPAN_METRIC = "tracing.span_seconds"
@@ -238,6 +239,15 @@ class RequestProfiler:
             d = operators.setdefault(op, {})
             d[name.split(".", 1)[1]] = d.get(
                 name.split(".", 1)[1], 0) + v
+        # which join kernel actually ran for THIS request's steps
+        # ("requested->chosen" routing decisions, ops/join.py) — on the
+        # join operator rows and as the top-level "join" block
+        join_algos = {lab: v for (n, lab), v in counters.items()
+                      if n == "join.algorithm" and lab}
+        if join_algos:
+            for op, d in operators.items():
+                if "join" in op:
+                    d["algorithms"] = join_algos
         top_walls = sum(d.get("wall_s", 0.0)
                         for d in operators.values())
         dispatch_s = spans.get("plan.dispatch", 0.0)
@@ -323,6 +333,14 @@ class RequestProfiler:
                 "units_resumed": self._counter(
                     counters, "ooc.units_resumed"),
                 "oom_report": oom_rep,
+            },
+            # join-kernel routing observability (ISSUE 12): every
+            # requested->chosen decision this request's steps made,
+            # including the bucketed path's overflow fallbacks
+            "join": {
+                "algorithms": join_algos,
+                "overflow_fallbacks": self._counter(
+                    counters, "join.overflow_fallbacks"),
             },
         }
         return json_safe(prof)
@@ -436,16 +454,24 @@ def explain(fn, *args, **kwargs) -> dict:
         hint = use_hint
     name = getattr(getattr(fn, "_fn", fn), "__name__",
                    type(fn).__name__)
+    from cylon_tpu.ops import hash_join
+
+    ops = _query_ops(fn)
     return json_safe({
         "query": name,
         "compiled": cq is not None,
-        "ops": _query_ops(fn),
+        "ops": ops,
         "ops_source": "static_scan",
         "inputs": inputs,
         "row_hint": hint,
         "scale": scale,
         "cache_state": cache_state,
         "plan_cache": plan.plan_cache_stats(),
+        # static join-kernel routing (which implementation an
+        # algorithm="hash" join in this plan would take right now —
+        # env overrides + chain-overflow fallback rules included)
+        "join_routing": (hash_join.describe_routing()
+                         if any("join" in o for o in ops) else None),
     })
 
 
@@ -464,6 +490,14 @@ def explain_text(plan_dict: dict) -> str:
             f"capacity={t['capacity']} bytes={t['bytes']} "
             f"{'distributed' if t['distributed'] else 'local'}")
     lines.append(f"  row_hint={p['row_hint']} scale={p['scale']}")
+    jr = p.get("join_routing")
+    if jr:
+        lines.append(
+            f"  join: hash->{jr['hash_impl']} "
+            f"(width {jr['bucket_width']}, overflow->"
+            f"{jr['overflow_fallback']}"
+            + (f", env={jr['algorithm_env']}" if jr.get("algorithm_env")
+               else "") + ")")
     pc = p.get("plan_cache", {})
     lines.append(f"  plan cache: {pc.get('hits', 0)} hits / "
                  f"{pc.get('misses', 0)} misses "

@@ -622,3 +622,83 @@ def test_checker_accepts_closures_and_comprehensions(tmp_path):
         "    return inner, vals, f, fh\n"
     )
     assert undefined_names(p) == []
+
+
+# ------------------------------------------- hash-join A/B guards
+def test_join_ab_record_schema_pinned():
+    """ISSUE 12 satellite: the A/B verdict is only reproducible if
+    every --join-ab record pins the config, both walls, the winner and
+    the overflow-fallback count."""
+    import bench
+
+    assert bench.REQUIRED_JOIN_AB_FIELDS == frozenset({
+        "rows", "distribution", "sort_wall", "hash_wall", "winner",
+        "overflow_fallbacks"})
+    # and the harness asserts the schema before emitting
+    src = (REPO / "bench.py").read_text()
+    assert "REQUIRED_JOIN_AB_FIELDS - record.keys()" in src
+
+
+def _pallas_entry_points():
+    """Public functions of ops/pallas_kernels.py that (directly or via
+    their one-hop private impl) invoke ``pl.pallas_call`` — the kernel
+    entry points the interpret-mode test contract covers."""
+    src = (REPO / "cylon_tpu/ops/pallas_kernels.py").read_text()
+    tree = ast.parse(src)
+    fns = {n.name: n for n in tree.body
+           if isinstance(n, ast.FunctionDef)}
+
+    def has_pallas_call(fn):
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Attribute) \
+                    and node.attr == "pallas_call":
+                return True
+        return False
+
+    def calls(fn):
+        out = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Name):
+                out.add(node.func.id)
+        return out
+
+    entry = []
+    for name, fn in fns.items():
+        if name.startswith("_"):
+            continue
+        if has_pallas_call(fn) or any(
+                c in fns and has_pallas_call(fns[c])
+                for c in calls(fn)):
+            entry.append(name)
+    return entry
+
+
+def test_every_pallas_kernel_has_an_interpret_mode_test():
+    """ISSUE 12 satellite (CI lint): every Pallas kernel entry point
+    must be referenced from a test file that forces interpret mode —
+    otherwise the kernel code path only ever executes on real TPUs and
+    a regression ships invisibly past tier-1."""
+    entries = _pallas_entry_points()
+    assert {"row_hash", "scan32", "pair_max_scan", "bucket_build",
+            "bucket_probe"} <= set(entries), entries
+    tests = {p: p.read_text() for p in (REPO / "tests").glob("test_*.py")}
+    interpret_tests = {p: t for p, t in tests.items()
+                       if 'setenv("CYLON_PALLAS", "interpret")' in t}
+    assert interpret_tests, "no interpret-mode test files found"
+    blob = "\n".join(interpret_tests.values())
+    missing = [e for e in entries if e not in blob]
+    assert not missing, (
+        f"Pallas kernel entry points with no interpret-mode test "
+        f"reference: {missing}")
+
+
+def test_profile_schema_pins_join_routing():
+    """ISSUE 12 satellite: the ANALYZE profile must keep the join
+    routing block (which kernel actually ran)."""
+    from cylon_tpu.telemetry.profile import (REQUIRED_PROFILE_FIELDS,
+                                             _COUNTERS)
+
+    assert "join" in REQUIRED_PROFILE_FIELDS
+    assert "join.algorithm" in _COUNTERS
+    assert "join.overflow_fallbacks" in _COUNTERS

@@ -1,0 +1,355 @@
+"""Bucketed O(n) hash join (ISSUE 12): hash-vs-sort oracle fuzz suite.
+
+The oracle is the unchanged sort join — ``algorithm="hash"`` must be
+byte-identical for ``ordered=True`` (both restore pandas order) across
+every supported ``how`` x dtype (incl. bytescol 2-D keys) x null
+pattern x size (empty / all-duplicate) x capacities straddling the
+overflow threshold. The Pallas kernels run in interpret mode here
+(``CYLON_PALLAS=interpret``) so the exact kernel code paths are
+exercised under the tier-1 gate without TPU hardware; the jnp twins
+are pinned bit-identical to them.
+"""
+
+import numpy as np
+import jax.numpy as jnp
+import pandas as pd
+import pytest
+
+from cylon_tpu import Table, telemetry
+from cylon_tpu.ops import hash_join as hj
+from cylon_tpu.ops import pallas_kernels as pk
+from cylon_tpu.ops.join import join
+
+
+@pytest.fixture
+def pallas_interpret(monkeypatch):
+    monkeypatch.setenv("CYLON_PALLAS", "interpret")
+
+
+@pytest.fixture
+def force_bucketed(monkeypatch):
+    monkeypatch.setenv("CYLON_TPU_JOIN_HASH_IMPL", "bucketed")
+
+
+def _mk(rng, n, dtype, nulls):
+    if dtype == "bytes":
+        col = pd.Series(np.array(
+            [f"k{v}" for v in rng.integers(0, 40, max(n, 1))])[:n],
+            dtype=object)
+    elif dtype == "f64":
+        col = pd.Series(rng.integers(0, 40, n).astype(np.float64),
+                        dtype="Float64" if nulls else np.float64)
+    else:
+        col = pd.Series(rng.integers(0, 40, n),
+                        dtype="Int64" if nulls else np.int64)
+    if nulls and n:
+        col = col.mask(rng.random(n) < 0.25)
+    return col
+
+
+def _tables(rng, n, m, dtype, nulls, cap=256):
+    lt = pd.DataFrame({"k": _mk(rng, n, dtype, nulls),
+                       "a": rng.normal(size=n)})
+    rt = pd.DataFrame({"k": _mk(rng, m, dtype, nulls),
+                       "b": rng.normal(size=m)})
+    return (Table.from_pandas(lt, capacity=max(cap, n, 1)),
+            Table.from_pandas(rt, capacity=max(cap, m, 1)))
+
+
+def _assert_oracle(lt, rt, how, out_cap=4096, on="k"):
+    want = join(lt, rt, on=on, how=how, algorithm="sort",
+                out_capacity=out_cap).to_pandas()
+    got = join(lt, rt, on=on, how=how, algorithm="hash",
+               out_capacity=out_cap).to_pandas()
+    pd.testing.assert_frame_equal(got.reset_index(drop=True),
+                                  want.reset_index(drop=True))
+    return len(got)
+
+
+# ------------------------------------------------------------- fuzz core
+
+@pytest.mark.parametrize("how", ["inner", "left", "right"])
+@pytest.mark.parametrize("dtype", ["i64", "f64", "bytes"])
+def test_fuzz_oracle(rng, force_bucketed, how, dtype):
+    # nulls always on: null == null key identity is the hard case and
+    # subsumes the non-null compare path (most rows stay valid).
+    # Shared 256-row capacities keep the compile count bounded.
+    lt, rt = _tables(rng, 173, 240, dtype, True)
+    assert _assert_oracle(lt, rt, how) > 0
+
+
+@pytest.mark.parametrize("how", ["inner", "left"])
+def test_fuzz_oracle_empty_and_tiny(rng, force_bucketed, how):
+    for n, m in ((0, 9), (9, 0), (1, 1)):
+        lt, rt = _tables(rng, n, m, "i64", True, cap=16)
+        _assert_oracle(lt, rt, how, out_cap=64)
+
+
+@pytest.mark.parametrize("how", ["inner", "left"])
+def test_fuzz_oracle_interpret_kernels(rng, pallas_interpret,
+                                       force_bucketed, how):
+    """Same oracle through the ACTUAL Pallas bucket_build/bucket_probe
+    kernels (interpret mode executes the kernel bodies)."""
+    lt, rt = _tables(rng, 210, 150, "i64", True)
+    n = _assert_oracle(lt, rt, how)
+    assert n > 0
+
+
+def test_all_duplicate_keys_overflow_identical(rng, force_bucketed):
+    """Every chain exceeds the width budget -> the shipped path MUST
+    fall back to the sort join and stay byte-identical, and the
+    fallback must be observable."""
+    n = 64
+    lt = Table.from_pydict({"k": np.zeros(n, np.int64),
+                            "a": rng.normal(size=n)})
+    # build side (smaller capacity) holds a 40-long chain > width 16
+    rt = Table.from_pydict({"k": np.zeros(40, np.int64),
+                            "b": rng.normal(size=40)})
+    before = telemetry.counter("join.overflow_fallbacks").value
+    _assert_oracle(lt, rt, "inner")
+    assert telemetry.counter("join.overflow_fallbacks").value > before
+
+
+@pytest.mark.parametrize("dups", [1, 2])
+def test_capacity_straddles_overflow_threshold(rng, force_bucketed,
+                                               monkeypatch, dups):
+    """Chains exactly AT the width fit (no fallback); one past it
+    falls back — both byte-identical to the oracle."""
+    monkeypatch.setenv("CYLON_TPU_JOIN_BUCKET_WIDTH", "2")
+    n = 40
+    k = np.repeat(np.arange(n // dups), dups)[:n].astype(np.int64)
+    lt = Table.from_pydict({"k": k, "a": rng.normal(size=n)})
+    rt = Table.from_pydict({"k": rng.integers(0, n, n).astype(np.int64),
+                            "b": rng.normal(size=n)})
+    before = telemetry.counter("join.overflow_fallbacks").value
+    _assert_oracle(lt, rt, "inner")
+    overflowed = telemetry.counter(
+        "join.overflow_fallbacks").value - before
+    # dups == 2 == width fits every chain UNLESS two keys collide into
+    # one bucket; dups beyond width would force it. Either way the
+    # output matched — here we only pin that the fast path is actually
+    # reachable at width 2 with unique keys
+    if dups == 1 and hj.table_slots(n) >= n:
+        assert overflowed in (0, 1)
+
+
+def test_multi_key_and_mixed_dtypes(rng, force_bucketed):
+    n, m = 120, 90
+    lt = Table.from_pydict({
+        "k1": rng.integers(0, 6, n).astype(np.int64),
+        "k2": rng.integers(0, 6, n).astype(np.float64),
+        "a": rng.normal(size=n)})
+    rt = Table.from_pydict({
+        "k1": rng.integers(0, 6, m).astype(np.int64),
+        "k2": rng.integers(0, 6, m).astype(np.float64),
+        "b": rng.normal(size=m)})
+    _assert_oracle(lt, rt, "inner", on=["k1", "k2"])
+
+
+def test_fullouter_hash_downgrades_with_warning(rng, caplog):
+    """`algorithm="hash"` is a HINT: fullouter takes the documented
+    sort fallback with a one-shot warning — never an error."""
+    import importlib
+    import logging
+
+    from cylon_tpu.utils.logging import get_logger
+
+    join_mod = importlib.import_module("cylon_tpu.ops.join")
+    join_mod._warned.discard("hash-fullouter")
+    logger = get_logger()
+    logger.propagate = True  # the package handler sets propagate=False
+    lt, rt = _tables(rng, 30, 40, "i64", True)
+    with caplog.at_level(logging.WARNING, logger="cylon_tpu"):
+        for _ in range(2):
+            got = join(lt, rt, on="k", how="fullouter",
+                       algorithm="hash", out_capacity=512).to_pandas()
+    want = join(lt, rt, on="k", how="fullouter", algorithm="sort",
+                out_capacity=512).to_pandas()
+    pd.testing.assert_frame_equal(got, want)
+    logger.propagate = False
+    warns = [r for r in caplog.records
+             if "bucketed hash join" in r.getMessage()]
+    assert len(warns) == 1  # one-shot
+
+
+def test_env_algorithm_override(rng, monkeypatch, force_bucketed):
+    """CYLON_TPU_JOIN_ALGORITHM forces the hint process-wide."""
+    lt, rt = _tables(rng, 50, 50, "i64", False)
+    want = join(lt, rt, on="k", how="inner", out_capacity=512
+                ).to_pandas()
+    monkeypatch.setenv("CYLON_TPU_JOIN_ALGORITHM", "hash")
+    before = telemetry.counter("join.algorithm",
+                               kind="hash->hash_bucketed").value
+    got = join(lt, rt, on="k", how="inner", algorithm="sort",
+               out_capacity=512).to_pandas()
+    pd.testing.assert_frame_equal(got, want)
+    assert telemetry.counter("join.algorithm",
+                             kind="hash->hash_bucketed").value > before
+
+
+def test_hash_impl_sort_keeps_legacy_path(rng, monkeypatch):
+    """CYLON_TPU_JOIN_HASH_IMPL=sort pins algorithm="hash" to the
+    legacy murmur-bucket-first sort ordering (the pre-bucketed HASH)."""
+    monkeypatch.setenv("CYLON_TPU_JOIN_HASH_IMPL", "sort")
+    lt, rt = _tables(rng, 64, 64, "i64", False)
+    before = telemetry.counter("join.algorithm",
+                               kind="hash->hash_sort").value
+    got = join(lt, rt, on="k", how="inner", algorithm="hash",
+               out_capacity=512).to_pandas()
+    want = join(lt, rt, on="k", how="inner", algorithm="sort",
+                out_capacity=512).to_pandas()
+    pd.testing.assert_frame_equal(got, want)
+    assert telemetry.counter("join.algorithm",
+                             kind="hash->hash_sort").value > before
+
+
+# --------------------------------------------------- kernel twin parity
+
+def test_build_twins_bit_identical(rng, pallas_interpret):
+    bids = jnp.asarray(rng.integers(-1, 64, 700), jnp.int32)
+    t1, o1 = pk.bucket_build(bids, 64, 4)
+    t2, o2 = hj._build_jnp(bids, 64, 4)
+    np.testing.assert_array_equal(np.asarray(t1), np.asarray(t2))
+    assert int(o1) == int(o2) > 0
+
+
+def test_probe_twins_bit_identical(rng, pallas_interpret):
+    nb, width = 32, 3
+    bkeys = jnp.asarray(rng.integers(0, 20, 90), jnp.uint32)
+    pkeys = jnp.asarray(rng.integers(0, 20, 400), jnp.uint32)
+    bbids = (bkeys % nb).astype(jnp.int32)
+    pbids = (pkeys % nb).astype(jnp.int32)
+    pbids = jnp.where(jnp.arange(400) < 350, pbids, -1)  # invalid rows
+    table, _ = pk.bucket_build(bbids, nb, width)
+    m1 = pk.bucket_probe(pbids, [pkeys], table, [bkeys])
+    m2 = hj._probe_jnp(pbids, [pkeys], table, [bkeys])
+    np.testing.assert_array_equal(np.asarray(m1), np.asarray(m2))
+    assert int(np.asarray(m1).max()) > 0
+
+
+def test_build_entries_ascending_rowid(rng):
+    """The within-bucket entry order IS ascending row id — the invariant
+    pandas right-frame match order rests on."""
+    bids = jnp.asarray(rng.integers(0, 8, 200), jnp.int32)
+    table, _ = hj._build_jnp(bids, 8, 8)
+    t = np.asarray(table)
+    for b in range(8):
+        chain = t[:, b][t[:, b] >= 0]
+        assert (np.diff(chain) > 0).all()
+
+
+def test_chain_overflow_precheck(rng):
+    k = [jnp.asarray(np.zeros(40, np.int64))]
+    assert hj.chain_overflow(k, [None], jnp.int32(40), width=8)
+    k2 = [jnp.asarray(np.arange(40, dtype=np.int64))]
+    assert not hj.chain_overflow(k2, [None], jnp.int32(40), width=8)
+
+
+# ------------------------------------------------------- observability
+
+def test_routing_counters_and_describe(rng):
+    d = hj.describe_routing()
+    assert d["overflow_fallback"] == "sort"
+    assert set(d["supported_how"]) == {"inner", "left"}
+    assert d["hash_impl"] in ("bucketed", "sort")
+
+
+def test_explain_carries_join_routing(rng):
+    from cylon_tpu.telemetry.profile import explain, explain_text
+
+    lt, rt = _tables(rng, 16, 16, "i64", False)
+
+    def q(l, r):
+        return join(l, r, on="k", how="inner", out_capacity=64)
+
+    plan = explain(q, lt, rt)
+    assert plan["join_routing"]["bucket_width"] == hj.bucket_width()
+    assert "join:" in explain_text(plan)
+
+
+def test_ordered_false_row_set_matches(rng, force_bucketed):
+    """The dist-op contract: ordered=False must produce the same row
+    SET as the sort join (order implementation-defined)."""
+    lt, rt = _tables(rng, 150, 170, "i64", True)
+    key = ["k", "a", "b"]
+    want = join(lt, rt, on="k", how="inner", algorithm="sort",
+                out_capacity=4096, ordered=False).to_pandas()
+    got = join(lt, rt, on="k", how="inner", algorithm="hash",
+               out_capacity=4096, ordered=False).to_pandas()
+    pd.testing.assert_frame_equal(
+        got.sort_values(key).reset_index(drop=True),
+        want.sort_values(key).reset_index(drop=True))
+
+
+def test_dist_join_hash_guarded(env8, rng, force_bucketed):
+    """Under shard_map the overflow guard is in-graph (lax.cond) —
+    both a clean and an overflowing key set must match the oracle."""
+    from cylon_tpu.parallel import dist_join, dtable
+
+    for lo, hi in ((0, 1000), (0, 3)):  # clean / all-overflow
+        n = 160
+        lt = Table.from_pydict(
+            {"k": rng.integers(lo, hi, n).astype(np.int64),
+             "a": rng.normal(size=n)})
+        rt = Table.from_pydict(
+            {"k": rng.integers(0, 1000, n).astype(np.int64),
+             "b": rng.normal(size=n)})
+        got = dtable.gather_table(
+            env8, dist_join(env8, lt, rt, on="k", how="inner",
+                            algorithm="hash")).to_pandas()
+        want = lt.to_pandas().merge(rt.to_pandas(), on="k")
+        key = ["k", "a", "b"]
+        pd.testing.assert_frame_equal(
+            got.sort_values(key).reset_index(drop=True),
+            want.sort_values(key).reset_index(drop=True))
+
+
+def test_ooc_join_threads_algorithm(rng, tmp_path, force_bucketed):
+    """The fallback executor's per-partition joins honor the algorithm
+    thread-through (and the checkpoint fingerprint covers it)."""
+    from cylon_tpu.outofcore import ooc_join
+
+    n = 300
+    lcols = {"k": rng.integers(0, 50, n).astype(np.int64),
+             "a": rng.normal(size=n)}
+    rcols = {"k": rng.integers(0, 50, n).astype(np.int64),
+             "b": rng.normal(size=n)}
+    frames = []
+    total = ooc_join(lcols, rcols, on="k", n_partitions=4,
+                     sink=frames.append, algorithm="hash")
+    want = pd.DataFrame(lcols).merge(pd.DataFrame(rcols), on="k")
+    assert total == len(want)
+    got = pd.concat(frames, ignore_index=True)
+    key = ["k", "a", "b"]
+    pd.testing.assert_frame_equal(
+        got.sort_values(key).reset_index(drop=True),
+        want.sort_values(key).reset_index(drop=True))
+
+
+def test_bytescol_2d_keys_oracle(rng, force_bucketed):
+    """Device-bytes string keys ([cap, words] u32 columns) ride the
+    bucketed path: every word is an exact-compare operand."""
+    n, m = 120, 100
+    lk = np.array([f"key-{v:03d}" for v in rng.integers(0, 30, n)])
+    rk = np.array([f"key-{v:03d}" for v in rng.integers(0, 30, m)])
+    lt = Table.from_pandas(
+        pd.DataFrame({"k": lk, "a": rng.normal(size=n)}),
+        capacity=256, string_storage="bytes")
+    rt = Table.from_pandas(
+        pd.DataFrame({"k": rk, "b": rng.normal(size=m)}),
+        capacity=256, string_storage="bytes")
+    assert lt.column("k").data.ndim == 2  # really the 2-D layout
+    assert _assert_oracle(lt, rt, "inner") > 0
+
+
+def test_bytescol_2d_keys_interpret_kernels(rng, pallas_interpret,
+                                            force_bucketed):
+    n = 90
+    lk = np.array([f"s{v}" for v in rng.integers(0, 25, n)])
+    lt = Table.from_pandas(
+        pd.DataFrame({"k": lk, "a": rng.normal(size=n)}),
+        capacity=128, string_storage="bytes")
+    rt = Table.from_pandas(
+        pd.DataFrame({"k": lk[::-1].copy(), "b": rng.normal(size=n)}),
+        capacity=128, string_storage="bytes")
+    assert _assert_oracle(lt, rt, "inner") > 0
