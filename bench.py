@@ -50,6 +50,16 @@ rank-track count + per-stage wall coverage into the JSON record
 build/probe walls under ``join.build``/``join.probe`` spans; one
 :data:`REQUIRED_JOIN_AB_FIELDS` record per config (the A/B verdict
 artifact ``docs/joins.md`` cites).
+
+``--ooc-overlap`` races the pipelined OOC executor
+(:mod:`cylon_tpu.pipeline`: bounded prefetch + async checkpointed
+spill) against the ``CYLON_TPU_OOC_PREFETCH_DEPTH=0`` sequential
+control, per op (``CYLON_BENCH_OOC_OPS``) x chunk-source model
+(``disk`` | ``tunneled_model`` — see :func:`_bench_ooc_overlap`); one
+:data:`REQUIRED_OOC_OVERLAP_FIELDS` record per config with per-stage
+idle fractions and a Chrome-trace artifact showing ``ooc.prefetch``
+overlapping ``ooc.compute`` (``docs/outofcore.md`` "Pipelined
+execution" cites the verdict).
 """
 
 import json
@@ -229,6 +239,256 @@ REQUIRED_JOIN_AB_FIELDS = frozenset({
     "rows", "distribution", "sort_wall", "hash_wall", "winner",
     "overflow_fallbacks",
 })
+
+#: fields every ``--ooc-overlap`` record must pin (ISSUE 13) — the
+#: overlap verdict is only auditable if each record names the op, the
+#: source model, BOTH walls (overlap on vs the
+#: ``CYLON_TPU_OOC_PREFETCH_DEPTH=0`` sequential control), the
+#: prefetch hit/miss counters, the hidden-IO seconds, the per-stage
+#: idle fractions from the trace, and the trace artifact path
+#: (``tests/test_bench_guard.py`` pins this set).
+REQUIRED_OOC_OVERLAP_FIELDS = frozenset({
+    "op", "rows", "source", "sequential_wall", "overlap_wall",
+    "overlap_speedup", "rows_per_sec_sequential",
+    "rows_per_sec_overlap", "prefetch_hits", "prefetch_misses",
+    "overlap_seconds", "prefetch_compute_overlap_s",
+    "idle_fractions_sequential", "idle_fractions_overlap",
+    "platform", "trace_path",
+})
+
+#: pipeline stages the --ooc-overlap idle-fraction audit reads from
+#: the trace (idle fraction = 1 - stage busy seconds / wall)
+_OOC_STAGES = ("ooc.prefetch", "ooc.compute", "spill.write_async",
+               "spill.write")
+
+
+def _ooc_stage_stats(evts, wall):
+    """Per-stage busy seconds + idle fractions from one run's trace,
+    plus the cross-thread seconds where an ``ooc.prefetch`` span
+    overlapped an ``ooc.compute`` span — the timeline proof that the
+    ingest actually ran DURING compute (0 in the sequential arm by
+    construction: both stages share one thread there)."""
+    spans, open_spans = [], {}
+    for e in evts:
+        if e["kind"] == "begin":
+            open_spans[e["id"]] = e
+        elif e["kind"] == "end":
+            b = open_spans.pop(e.get("id"), None)
+            if b is not None:
+                spans.append((b["name"], b.get("tid"), b["ts"],
+                              e["ts"]))
+        elif e["kind"] == "complete":
+            spans.append((e["name"], e.get("tid"), e["ts"],
+                          e["ts"] + e["dur"]))
+    busy: dict = {}
+    for name, _, t0, t1 in spans:
+        busy[name] = busy.get(name, 0.0) + max(t1 - t0, 0.0)
+    idle = {s: round(max(1.0 - busy.get(s, 0.0) / wall, 0.0), 4)
+            for s in _OOC_STAGES if s in busy}
+    pre = [(t0, t1, tid) for n, tid, t0, t1 in spans
+           if n == "ooc.prefetch"]
+    cmp_ = [(t0, t1, tid) for n, tid, t0, t1 in spans
+            if n == "ooc.compute"]
+    ov = 0.0
+    for p0, p1, ptid in pre:
+        for c0, c1, ctid in cmp_:
+            if ctid == ptid:
+                continue
+            lo, hi = max(p0, c0), min(p1, c1)
+            if hi > lo:
+                ov += hi - lo
+    return busy, idle, ov
+
+
+def _bench_ooc_overlap():
+    """ISSUE 13 A/B: pipelined OOC execution (bounded prefetch + async
+    checkpointed spill) vs the ``CYLON_TPU_OOC_PREFETCH_DEPTH=0``
+    sequential control, per op x chunk-source model.
+
+    Sources: ``disk`` — a real uncompressed-parquet file, page cache
+    evicted (``posix_fadvise DONTNEED``) before every pass so reads
+    hit the device; ``tunneled_model`` — the same file with each chunk
+    pull additionally paying ``CYLON_BENCH_OOC_RPC_MS`` (default 110
+    ms: the MEASURED per-dispatch RPC of the tunneled v5e this repo's
+    headline runs on — see the module docstring; a tunneled/remote
+    chunk source pays exactly that class of round trip per pull, and
+    this container has no tunnel to measure live). Each record labels
+    its source; CPU-host walls throughout — on this 1-core container
+    host "device" compute and host ingest share the core, so the
+    ``disk`` legs bound what local-NVMe fsync/read waits alone can
+    hide, while ``tunneled_model`` shows the gap the overlap exists to
+    close in the recorded deployment."""
+    import shutil
+    import tempfile
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    if os.environ.get("CYLON_TPU_TRACE", "") in ("", "0", "off"):
+        os.environ["CYLON_TPU_TRACE"] = "1"
+
+    import jax
+
+    from cylon_tpu import telemetry
+    from cylon_tpu.outofcore import ooc_groupby, ooc_sort
+    from cylon_tpu.telemetry import trace
+
+    ops = os.environ.get("CYLON_BENCH_OOC_OPS", "sort,groupby").split(",")
+    sources = os.environ.get("CYLON_BENCH_OOC_SOURCES",
+                             "disk,tunneled_model").split(",")
+    n = int(os.environ.get("CYLON_BENCH_OOC_ROWS", 1_000_000))
+    chunk = int(os.environ.get("CYLON_BENCH_OOC_CHUNK", 1 << 16))
+    ncols = int(os.environ.get("CYLON_BENCH_OOC_VALUE_COLS", 6))
+    reps = int(os.environ.get("CYLON_BENCH_OOC_REPS", 2))
+    depth = os.environ.get("CYLON_BENCH_OOC_DEPTH", "2")
+    rpc_ms = float(os.environ.get("CYLON_BENCH_OOC_RPC_MS", "110"))
+    nparts = 8
+
+    tmp = tempfile.mkdtemp(prefix="cylon_ooc_overlap_")
+    rng = np.random.default_rng(7)
+    cols = {"k": rng.integers(0, n, n).astype(np.int64),
+            "g": rng.integers(0, 64, n).astype(np.int64)}
+    for i in range(ncols):
+        cols[f"v{i}"] = rng.normal(size=n)
+    path = os.path.join(tmp, "src.parquet")
+    pq.write_table(pa.table(cols), path, compression="none")
+    del cols
+
+    def _evict():
+        # cold-ish reads both arms: evict the source from page cache so
+        # every pass reads the device, like an SF100 source would
+        fd = os.open(path, os.O_RDONLY)
+        try:
+            os.posix_fadvise(fd, 0, 0, os.POSIX_FADV_DONTNEED)
+        except (AttributeError, OSError):
+            pass
+        finally:
+            os.close(fd)
+
+    def _chunks(source):
+        _evict()
+        pf = pq.ParquetFile(path)
+        for b in pf.iter_batches(batch_size=chunk):
+            if source == "tunneled_model":
+                time.sleep(rpc_ms / 1000.0)
+            yield {c: b.column(c).to_numpy(zero_copy_only=False)
+                   for c in b.schema.names}
+
+    def _run(op, source, depth_env, seq):
+        os.environ["CYLON_TPU_OOC_PREFETCH_DEPTH"] = depth_env
+        rdir = os.path.join(tmp, f"ck_{op}_{source}_{depth_env}_{seq}")
+        odir = os.path.join(tmp, f"out_{op}_{source}_{depth_env}_{seq}")
+        os.makedirs(odir)
+        nsink = [0]
+
+        def sink(pdf):
+            # durable output: the sorted table is PERSISTED (what an
+            # at-scale OOC sort is for) — rides the async writer
+            p = os.path.join(odir, f"part{nsink[0]:05d}.npz")
+            nsink[0] += 1
+            with open(p, "wb") as f:
+                np.savez(f, **{c: pdf[c].to_numpy()
+                               for c in pdf.columns})
+                f.flush()
+                os.fsync(f.fileno())
+
+        trace.clear()
+        c0 = {k: telemetry.total(k) for k in
+              ("ooc.prefetch_hits", "ooc.prefetch_misses",
+               "ooc.overlap_seconds")}
+        t0 = time.perf_counter()
+        if op == "sort":
+            ooc_sort(lambda: _chunks(source), ["k"],
+                     n_partitions=nparts, chunk_rows=chunk,
+                     resume_dir=rdir, sink=sink)
+        elif op == "groupby":
+            # Q1-shaped pre-combine: sum+min+max per value column plus
+            # a count — the chunked streaming-aggregation workload
+            # (tpch q1_ooc) whose per-chunk device compute the
+            # prefetcher hides chunk pulls behind
+            aggs = [("v0", "count", "cnt")]
+            for i in range(ncols):
+                aggs += [(f"v{i}", "sum", f"s{i}"),
+                         (f"v{i}", "min", f"mn{i}"),
+                         (f"v{i}", "max", f"mx{i}")]
+            ooc_groupby(lambda: _chunks(source), ["g"], aggs,
+                        chunk_rows=chunk, resume_dir=rdir)
+        else:
+            raise ValueError(f"unknown --ooc-overlap op {op!r}")
+        wall = time.perf_counter() - t0
+        evts = trace.events()
+        deltas = {k: telemetry.total(k) - v for k, v in c0.items()}
+        shutil.rmtree(rdir, ignore_errors=True)
+        shutil.rmtree(odir, ignore_errors=True)
+        return wall, evts, deltas
+
+    records = []
+    try:
+        for op in ops:
+            for source in sources:
+                arms = {}
+                for label, d in (("sequential", "0"),
+                                 ("overlap", depth)):
+                    best = None
+                    for rep in range(max(reps, 1)):
+                        wall, evts, deltas = _run(op, source, d,
+                                                  f"{label}{rep}")
+                        if best is None or wall < best[0]:
+                            best = (wall, evts, deltas)
+                    arms[label] = best
+                seq_wall, seq_evts, _ = arms["sequential"]
+                ov_wall, ov_evts, ov_deltas = arms["overlap"]
+                _, seq_idle, _ = _ooc_stage_stats(seq_evts, seq_wall)
+                _, ov_idle, xov = _ooc_stage_stats(ov_evts, ov_wall)
+                tpath = os.path.abspath(
+                    f"ooc_overlap.{op}.{source}.trace.json")
+                telemetry.write_chrome_trace(
+                    tpath, telemetry.to_chrome_trace(
+                        [{"rank": 0, "clock_offset": 0.0,
+                          "events": ov_evts}]))
+                record = {
+                    "metric": "ooc_overlap_ab",
+                    "op": op,
+                    "rows": n,
+                    "source": source,
+                    "rpc_ms": (rpc_ms if source == "tunneled_model"
+                               else 0.0),
+                    "value_cols": ncols,
+                    "chunk_rows": chunk,
+                    "n_partitions": nparts,
+                    "prefetch_depth": int(depth),
+                    "sequential_wall": round(seq_wall, 4),
+                    "overlap_wall": round(ov_wall, 4),
+                    "overlap_speedup": round(seq_wall / ov_wall, 4),
+                    "rows_per_sec_sequential": round(n / seq_wall, 1),
+                    "rows_per_sec_overlap": round(n / ov_wall, 1),
+                    "prefetch_hits": int(
+                        ov_deltas["ooc.prefetch_hits"]),
+                    "prefetch_misses": int(
+                        ov_deltas["ooc.prefetch_misses"]),
+                    "overlap_seconds": round(
+                        float(ov_deltas["ooc.overlap_seconds"]), 4),
+                    "prefetch_compute_overlap_s": round(xov, 4),
+                    "idle_fractions_sequential": seq_idle,
+                    "idle_fractions_overlap": ov_idle,
+                    "reps": reps,
+                    "platform": jax.default_backend(),
+                    "host_note": ("1-core CPU host: device compute "
+                                  "and host ingest share the core, so "
+                                  "only true IO waits overlap; "
+                                  "tunneled_model replays the "
+                                  "recorded ~110 ms/RPC tunnel "
+                                  "latency per chunk pull"),
+                    "trace_path": tpath,
+                }
+                missing = REQUIRED_OOC_OVERLAP_FIELDS - record.keys()
+                assert not missing, \
+                    f"ooc-overlap record dropped {missing}"
+                _emit_record(record)
+                records.append(record)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return records
 
 
 def _join_ab_keys(n, dist, rng):
@@ -429,6 +689,9 @@ def _emit_record(line: dict):
 
 
 def main():
+    if "--ooc-overlap" in sys.argv[1:]:
+        _bench_ooc_overlap()
+        return
     if "--join-ab" in sys.argv[1:]:
         rows_list = [int(x) for x in os.environ.get(
             "CYLON_BENCH_JOIN_AB_ROWS",

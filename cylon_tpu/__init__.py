@@ -148,6 +148,7 @@ from cylon_tpu.errors import (
 from cylon_tpu.config import DeadlinePolicy, RetryPolicy
 from cylon_tpu import telemetry
 from cylon_tpu import fallback
+from cylon_tpu import pipeline
 from cylon_tpu.resilience import FaultPlan, FaultRule
 from cylon_tpu.watchdog import deadline
 from cylon_tpu.table import Table
@@ -200,6 +201,7 @@ __all__ = [
     "read_csv",
     "read_csv_chunks",
     "read_csv_sharded",
+    "pipeline",
     "read_parquet_chunks",
     "telemetry",
     "write_csv_sharded",

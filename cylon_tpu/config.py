@@ -111,6 +111,7 @@ DEADLINE_SECTIONS: "dict[str, float | None]" = {
     "overflow_fetch": None,  # plan._check_overflow batched device_get
     "spill_io": None,        # SpillStore bucket write/read
     "ooc_pass": None,        # out-of-core join/groupby/sort passes
+    "ooc_prefetch": None,    # one pipelined-ingest unit (cylon_tpu.pipeline)
     "exchange": None,        # shuffle/repartition/dist_join dispatch
     "serve_request": None,   # one serve-layer query step (cylon_tpu.serve)
 }

@@ -74,7 +74,7 @@ from typing import Mapping
 
 import numpy as np
 
-from cylon_tpu import resilience, telemetry
+from cylon_tpu import pipeline, resilience, telemetry
 from cylon_tpu.errors import InvalidArgument
 from cylon_tpu.telemetry import memory as _memory
 from cylon_tpu.telemetry import trace as _trace
@@ -250,7 +250,13 @@ def run_with_fallback(attempt, spill, *, op: str,
         # module docstring caveat)
         gc.collect()
         try:
-            return spill()
+            # the retry runs the pipeline SEQUENTIALLY: prefetch
+            # lookahead would hold two partitions' device tables in an
+            # allocator that just exhausted — the preflight route
+            # above keeps the pipeline, its partitions being sized
+            # against free HBM with headroom
+            with pipeline.sequential():
+                return spill()
         except Exception as e2:
             raise e2 from e
 
@@ -479,59 +485,84 @@ def tpch_fallback(query: str, data: Mapping, *, env=None,
     runner = tpch.compiled(query) if compiled else eager_fn
     telemetry.counter("ooc.fallback_partitions",
                       op=query).inc(n_partitions)
-    partials: list = []
-    for p in range(n_partitions):
+    done_map = ckpt.completed if ckpt is not None else {}
+
+    def _ingest(p):
+        """Pipelined ingest of partition p (prefetch worker): the
+        per-table row-count meta + the partition's input mapping
+        (broadcast tables shared, partitioned slices attached) —
+        assembled while partition p-1's query runs."""
         meta = {t: (len(next(iter(part_tables[t][p].values())))
                     if part_tables[t][p] else 0) for t in part_tables}
-        done = ckpt.completed_rows(p) if ckpt is not None else None
-        if done is not None:
-            # completed partition: re-verify the re-split source still
-            # matches, then replay the durable partial — no recompute
-            ckpt.verify_meta(p, f"tpch_fallback[{query}]", **meta)
-            got = _decode_partial(ckpt.resume_unit(p))
-            if got is None:
-                # a 0-row FRAME partial keeps no spill file — its
-                # schema rides the unit meta so a resumed all-empty
-                # query still returns the schema'd empty frame the
-                # first run did (byte-identical resume)
-                schema = (ckpt.unit_meta(p) or {}).get("__schema__")
-                if schema:
-                    import pandas as pd
-
-                    got = pd.DataFrame(
-                        {c: np.empty(0, np.dtype(d))
-                         for c, d in schema})
-            partials.append(got)
-            continue
-        if all(v == 0 for v in meta.values()):
-            if ckpt is not None:
-                ckpt.complete(p, {}, 0, meta=meta)
-            partials.append(None)
-            continue
-        with _span("fallback.partition", cat="stage", query=query,
-                   partition=p, **{f"rows_{t}": n
-                                   for t, n in meta.items()}):
-            _memory.sample(op="fallback")
+        data_p = None
+        if p not in done_map and any(meta.values()):
             data_p = dict(bcast)
             for t in part_tables:
                 data_p[t] = part_tables[t][p]
-            partial = _materialize(runner(data_p, env=env,
-                                          **part_params))
-            if ckpt is not None:
-                cols, rows = _encode_partial(partial)
-                unit_meta = dict(meta)
-                if not isinstance(partial, float):
-                    # frame partials record their schema: a 0-row unit
-                    # writes no spill file, and the resume must still
-                    # reconstruct the schema'd empty frame
-                    unit_meta["__schema__"] = [
-                        [c, str(partial[c].dtype)]
-                        for c in partial.columns]
-                # checkpoint BEFORE the partial joins the merge set: a
-                # kill from here on resumes it from the durable spill
-                ckpt.complete(p, cols, rows, meta=unit_meta)
-            partials.append(partial)
-            del data_p
+        return meta, data_p
+
+    partials: list = []
+    # per-partition checkpoint commits ride the async writer (ONE FIFO
+    # thread — the manifest is never written concurrently and units
+    # land in partition order), overlapping the next partition's query
+    with pipeline.committer(f"fallback.{query}") as com:
+        for p, (meta, data_p) in pipeline.prefetch_map(
+                range(n_partitions), _ingest, op="fallback"):
+            done = done_map.get(p)
+            if done is not None:
+                # completed partition: re-verify the re-split source
+                # still matches, then replay the durable partial — no
+                # recompute
+                ckpt.verify_meta(p, f"tpch_fallback[{query}]", **meta)
+                got = _decode_partial(ckpt.resume_unit(p))
+                if got is None:
+                    # a 0-row FRAME partial keeps no spill file — its
+                    # schema rides the unit meta so a resumed
+                    # all-empty query still returns the schema'd empty
+                    # frame the first run did (byte-identical resume)
+                    schema = (ckpt.unit_meta(p) or {}).get("__schema__")
+                    if schema:
+                        import pandas as pd
+
+                        got = pd.DataFrame(
+                            {c: np.empty(0, np.dtype(d))
+                             for c, d in schema})
+                partials.append(got)
+                continue
+            if all(v == 0 for v in meta.values()):
+                if ckpt is not None:
+                    com.submit(lambda p=p, meta=meta:
+                               ckpt.complete(p, {}, 0, meta=meta))
+                partials.append(None)
+                continue
+            with _span("fallback.partition", cat="stage", query=query,
+                       partition=p, **{f"rows_{t}": n
+                                       for t, n in meta.items()}):
+                _memory.sample(op="fallback")
+                with _span("ooc.compute", cat="stage", op="fallback",
+                           unit=p):
+                    partial = _materialize(runner(data_p, env=env,
+                                                  **part_params))
+                if ckpt is not None:
+                    cols, rows = _encode_partial(partial)
+                    unit_meta = dict(meta)
+                    if not isinstance(partial, float):
+                        # frame partials record their schema: a 0-row
+                        # unit writes no spill file, and the resume
+                        # must still reconstruct the schema'd empty
+                        # frame
+                        unit_meta["__schema__"] = [
+                            [c, str(partial[c].dtype)]
+                            for c in partial.columns]
+                    # checkpoint BEFORE the partial joins the merge
+                    # set (com.drain() on scope exit is the barrier
+                    # before _merge_partials): a kill from here on
+                    # resumes it from the durable spill
+                    com.submit(lambda p=p, cols=cols, rows=rows,
+                               unit_meta=unit_meta: ckpt.complete(
+                                   p, cols, rows, meta=unit_meta))
+                partials.append(partial)
+                del data_p
     return _merge_partials(partials, spec, limit)
 
 

@@ -30,7 +30,7 @@ from typing import Callable, Iterable, Mapping, Sequence
 
 import numpy as np
 
-from cylon_tpu import resilience, telemetry, watchdog
+from cylon_tpu import pipeline, resilience, telemetry, watchdog
 from cylon_tpu.telemetry import memory as _memory
 from cylon_tpu.errors import DataLossError, InvalidArgument
 from cylon_tpu.utils.tracing import span as _span
@@ -94,11 +94,21 @@ def _resolve_source(src, op: str, chunk_rows: int):
     iterators/generators are REJECTED up front: a second iteration
     would silently see 0 rows and the pass would produce short output
     (``ooc_sort`` has had this guard since PR 1; ``ooc_join``/
-    ``ooc_groupby`` route through it now too)."""
+    ``ooc_groupby`` route through it now too).
+
+    Every factory routes its chunk stream through the SHARED prefetcher
+    (:func:`cylon_tpu.pipeline.prefetched`): chunk k+1's pull — IO
+    read, parquet decode, ``Table.to_pandas`` — runs on a
+    watchdog-abandonable worker while chunk k is scattered/computed
+    (``CYLON_TPU_OOC_PREFETCH_DEPTH``; 0 = sequential). The bench
+    guard lints that all ``ooc_*`` entrypoints ingest through here —
+    no sequential side-doors."""
     if isinstance(src, Mapping):
-        return lambda: _as_chunks(src, chunk_rows)
+        return lambda: pipeline.prefetched(
+            _as_chunks(src, chunk_rows), op=op)
     if callable(src):
-        return lambda: _as_chunks(src(), chunk_rows)
+        return lambda: pipeline.prefetched(
+            _as_chunks(src(), chunk_rows), op=op)
     try:
         probe = iter(src)
     except TypeError:
@@ -114,7 +124,8 @@ def _resolve_source(src, op: str, chunk_rows: int):
             "silently yield 0 rows and produce short output. Wrap it "
             "in a zero-arg callable returning a fresh iterator, e.g. "
             "lambda: read_parquet_chunks(path, chunk_rows)")
-    return lambda: _as_chunks(src, chunk_rows)
+    return lambda: pipeline.prefetched(
+        _as_chunks(src, chunk_rows), op=op)
 
 
 def _as_chunks(src, chunk_rows: int):
@@ -209,102 +220,143 @@ def ooc_join(left, right, on, how: str = "inner",
             + fp_alg)
     lparts = host_partition_chunks(lchunks(), keys, n_partitions)
     rparts = host_partition_chunks(rchunks(), keys, n_partitions)
+    from cylon_tpu.errors import OutOfCapacity
 
-    total = 0
-    for p in range(n_partitions):
-        watchdog.check("ooc_pass", f"join partition {p}")
+    # resume decisions are fixed at manifest load; snapshotting here
+    # keeps the prefetch worker and the async writer off the live
+    # manifest dict (only the writer mutates it during the pass)
+    done_map = ckpt.completed if ckpt is not None else {}
+
+    def _ingest(p):
+        """Pipelined ingest of partition p (prefetch worker): host
+        sizes always; the device tables only for fresh, non-empty
+        partitions — overlapped with partition p-1's compute. The
+        host spill buckets are freed as soon as they are ingested.
+        NOTE the tables are DEVICE-resident: the prefetcher's
+        depth+1-unit bound is an HBM bound here (depth 1 = two
+        partitions' tables live at once — set
+        CYLON_TPU_OOC_PREFETCH_DEPTH=0 or raise n_partitions when one
+        bucket pair barely fits). Power-of-2 capacities bound the
+        compiled-shape count to O(log(rows)) across partitions."""
         lp, rp = lparts[p], rparts[p]
         ln = len(next(iter(lp.values()))) if lp else 0
         rn = len(next(iter(rp.values()))) if rp else 0
-        done = ckpt.completed_rows(p) if ckpt is not None else None
-        if done is not None:
-            # completed partition: verify the re-scattered source still
-            # matches, then replay the durable output (identical bytes,
-            # no device work)
-            ckpt.verify_meta(p, "ooc_join", ln=ln, rn=rn)
-            # count the resume always; read the spill only when a sink
-            # needs the bytes (a count-only run must not pay the IO)
-            ckpt.note_resumed(p)
-            if done and sink is not None:
-                import pandas as pd
-
-                sink(pd.DataFrame(ckpt.load_unit(p)))
-            total += done
-            telemetry.counter("ooc.rows_out", op="join").inc(done)
-            lparts[p] = rparts[p] = None
-            continue
-        if ln == 0 and rn == 0:
-            if ckpt is not None:
-                ckpt.complete(p, {}, 0, meta={"ln": ln, "rn": rn})
-            continue
-        if ln == 0 or rn == 0:
-            if how == "inner":
-                if ckpt is not None:
-                    ckpt.complete(p, {}, 0, meta={"ln": ln, "rn": rn})
-                continue
-            # outer semantics with an empty side still need the pass
-        from cylon_tpu.errors import OutOfCapacity
-
-        # one trace slice per device pass: on the merged timeline the
-        # OOC join reads as n_partitions back-to-back bucket slices,
-        # so a slow bucket (skewed partition, deep regrow ladder) is
-        # visible by eye instead of buried in the pass total
-        with _span("ooc_join.partition", cat="stage", partition=p,
-                   rows_left=ln, rows_right=rn):
-            # stage-boundary HBM sample: the live-bytes gauge the
-            # in-core-vs-spill decision (ROADMAP item 1) will read
-            _memory.sample(op="ooc_join")
-            # power-of-2 capacities bound the compiled-shape count to
-            # O(log(rows)) across partitions
+        skip = (p in done_map or (ln == 0 and rn == 0)
+                or ((ln == 0 or rn == 0) and how == "inner"))
+        lt = rt = None
+        if not skip:
             lt = Table.from_pydict(lp, capacity=pow2_bucket(max(ln, 1)))
             rt = Table.from_pydict(rp, capacity=pow2_bucket(max(rn, 1)))
-            # ~1 output row per probe row is the expected shape of an
-            # equi-join on hash-partitioned keys; pow2 rounding plus
-            # the doubling ladder below absorbs fan-out, and starting
-            # tight matters — at 12.5M-row partitions a 4x(ln+rn)
-            # start is a multi-GB output buffer that can itself OOM
-            # the pass.
-            # ladder depth 12: the tight start shifts the ceiling down
-            # 4x vs the old 4x(ln+rn) start, and hot-key fan-out
-            # inside ONE partition cannot be relieved by more
-            # partitions — keep the reachable maximum at least where
-            # it was (a device OOM during a deep regrow raises
-            # through, which is the honest limit)
-            cap = pow2_bucket(2 * max(ln, rn, 1))
-            for _ in range(12):
-                try:
-                    res = dev_join(lt, rt, on=keys if len(keys) > 1
-                                   else keys[0], how=how,
-                                   suffixes=suffixes,
-                                   out_capacity=cap, ordered=False,
-                                   algorithm=algorithm)
-                    nrows = int(res.nrows)
-                except OutOfCapacity:
-                    nrows = cap + 1
-                if nrows <= cap:
-                    break
-                cap *= 2
-            else:
-                raise OutOfCapacity(
-                    f"ooc_join partition {p}: output exceeds {cap} "
-                    "rows — raise n_partitions")
-            total += nrows
-            telemetry.counter("ooc.rows_out", op="join").inc(nrows)
-            if ckpt is not None or sink is not None:
-                pdf = res.to_pandas()
-                if ckpt is not None:
-                    # checkpoint BEFORE the sink sees the partition: a
-                    # kill between the two replays it on resume, so
-                    # acknowledged output is never recomputed and
-                    # unacknowledged output is never lost
-                    ckpt.complete(
-                        p, {c: pdf[c].to_numpy() for c in pdf.columns},
-                        nrows, meta={"ln": ln, "rn": rn})
-                if sink is not None:
-                    sink(pdf)
-                del pdf
-            del res, lt, rt
-            lparts[p] = rparts[p] = None  # free the spill as we go
+        lparts[p] = rparts[p] = None  # free the spill as we go
+        return ln, rn, lt, rt
+
+    total = 0
+    with pipeline.committer("join") as com:
+        for p, (ln, rn, lt, rt) in pipeline.prefetch_map(
+                range(n_partitions), _ingest, op="join"):
+            watchdog.check("ooc_pass", f"join partition {p}")
+            done = done_map.get(p)
+            if done is not None:
+                # completed partition: verify the re-scattered source
+                # still matches, then replay the durable output
+                # (identical bytes, no device work). The spill READ +
+                # sink call ride the writer thread — FIFO submission
+                # order keeps replayed and fresh partitions in
+                # partition order, so a resumed run's sink stream is
+                # byte-identical to a fault-free run's
+                ckpt.verify_meta(p, "ooc_join", ln=ln, rn=rn)
+                # count the resume always; read the spill only when a
+                # sink needs the bytes (a count-only run must not pay
+                # the IO)
+                ckpt.note_resumed(p)
+                if done and sink is not None:
+                    import pandas as pd
+
+                    com.submit(lambda p=p: sink(
+                        pd.DataFrame(ckpt.load_unit(p))))
+                total += done
+                telemetry.counter("ooc.rows_out", op="join").inc(done)
+                continue
+            if ln == 0 or rn == 0:
+                if (ln == 0 and rn == 0) or how == "inner":
+                    if ckpt is not None:
+                        com.submit(lambda p=p, ln=ln, rn=rn:
+                                   ckpt.complete(p, {}, 0,
+                                                 meta={"ln": ln,
+                                                       "rn": rn}))
+                    continue
+                # outer semantics with an empty side still need the pass
+            # one trace slice per device pass: on the merged timeline
+            # the OOC join reads as n_partitions back-to-back bucket
+            # slices, so a slow bucket (skewed partition, deep regrow
+            # ladder) is visible by eye instead of buried in the pass
+            # total
+            with _span("ooc_join.partition", cat="stage", partition=p,
+                       rows_left=ln, rows_right=rn):
+                # stage-boundary HBM sample: the live-bytes gauge the
+                # in-core-vs-spill decision (ROADMAP item 1) will read
+                _memory.sample(op="ooc_join")
+                # ~1 output row per probe row is the expected shape of
+                # an equi-join on hash-partitioned keys; pow2 rounding
+                # plus the doubling ladder below absorbs fan-out, and
+                # starting tight matters — at 12.5M-row partitions a
+                # 4x(ln+rn) start is a multi-GB output buffer that can
+                # itself OOM the pass.
+                # ladder depth 12: the tight start shifts the ceiling
+                # down 4x vs the old 4x(ln+rn) start, and hot-key
+                # fan-out inside ONE partition cannot be relieved by
+                # more partitions — keep the reachable maximum at
+                # least where it was (a device OOM during a deep
+                # regrow raises through, which is the honest limit)
+                cap = pow2_bucket(2 * max(ln, rn, 1))
+                with _span("ooc.compute", cat="stage", op="join",
+                           unit=p):
+                    for _ in range(12):
+                        try:
+                            res = dev_join(lt, rt,
+                                           on=keys if len(keys) > 1
+                                           else keys[0], how=how,
+                                           suffixes=suffixes,
+                                           out_capacity=cap,
+                                           ordered=False,
+                                           algorithm=algorithm)
+                            nrows = int(res.nrows)
+                        except OutOfCapacity:
+                            nrows = cap + 1
+                        if nrows <= cap:
+                            break
+                        cap *= 2
+                    else:
+                        raise OutOfCapacity(
+                            f"ooc_join partition {p}: output exceeds "
+                            f"{cap} rows — raise n_partitions")
+                    pdf = (res.to_pandas()
+                           if ckpt is not None or sink is not None
+                           else None)
+                total += nrows
+                telemetry.counter("ooc.rows_out", op="join").inc(nrows)
+                if pdf is not None:
+                    cols = ({c: pdf[c].to_numpy()
+                             for c in pdf.columns}
+                            if ckpt is not None else None)
+
+                    def _commit(p=p, cols=cols, pdf=pdf, nrows=nrows,
+                                ln=ln, rn=rn):
+                        # checkpoint BEFORE the sink sees the
+                        # partition (both on the one writer thread, in
+                        # order): a kill between the two replays it on
+                        # resume, so acknowledged output is never
+                        # recomputed and unacknowledged output is
+                        # never lost
+                        if ckpt is not None:
+                            ckpt.complete(p, cols, nrows,
+                                          meta={"ln": ln, "rn": rn})
+                        if sink is not None:
+                            sink(pdf)
+
+                    com.submit(_commit)
+                    del pdf
+                del res, lt, rt
     return total
 
 
@@ -371,32 +423,41 @@ def ooc_groupby(src, by: Sequence[str], aggs,
             resume_dir, "groupby",
             (tuple(by), tuple(tuple(a) for a in aggs),
              int(chunk_rows), tf))
+    done_map = ckpt.completed if ckpt is not None else {}
     partials: list = []
-    for i, chunk in enumerate(chunks()):
-        src_rows = len(next(iter(chunk.values()))) if chunk else 0
-        done = ckpt.completed_rows(i) if ckpt is not None else None
-        if done is not None:
-            ckpt.verify_meta(i, "ooc_groupby", src_rows=src_rows)
-            cols = ckpt.resume_unit(i)
-            if done:
-                partials.append(pd.DataFrame(cols))
-            continue
-        with _span("ooc_groupby.chunk", cat="stage", chunk=i):
-            _memory.sample(op="ooc_groupby")
-            t = (Table.from_pydict(chunk) if transform is None
-                 else transform(chunk))
-            part = groupby_aggregate(t, list(by),
-                                     [(s, op, o) for s, op, o in aggs])
-            # partials hop through pandas: tiny (one row per group),
-            # and dictionary key columns decode to values (codes are
-            # chunk-local)
-            pdf = part.to_pandas()
-            if ckpt is not None:
-                ckpt.complete(
-                    i, {c: pdf[c].to_numpy() for c in pdf.columns},
-                    len(pdf), meta={"src_rows": src_rows})
-            partials.append(pdf)
-            del t, part
+    # pipelined: the chunk source arrives through the shared prefetcher
+    # (chunk i+1 pulls/decodes on a worker while chunk i pre-combines
+    # on-device — see _resolve_source), and each chunk's checkpoint
+    # commit overlaps the next chunk's compute on the async writer
+    with pipeline.committer("groupby") as com:
+        for i, chunk in enumerate(chunks()):
+            src_rows = len(next(iter(chunk.values()))) if chunk else 0
+            done = done_map.get(i)
+            if done is not None:
+                ckpt.verify_meta(i, "ooc_groupby", src_rows=src_rows)
+                cols = ckpt.resume_unit(i)
+                if done:
+                    partials.append(pd.DataFrame(cols))
+                continue
+            with _span("ooc_groupby.chunk", cat="stage", chunk=i):
+                _memory.sample(op="ooc_groupby")
+                with _span("ooc.compute", cat="stage", op="groupby",
+                           unit=i):
+                    t = (Table.from_pydict(chunk) if transform is None
+                         else transform(chunk))
+                    part = groupby_aggregate(
+                        t, list(by), [(s, op, o) for s, op, o in aggs])
+                    # partials hop through pandas: tiny (one row per
+                    # group), and dictionary key columns decode to
+                    # values (codes are chunk-local)
+                    pdf = part.to_pandas()
+                if ckpt is not None:
+                    cols = {c: pdf[c].to_numpy() for c in pdf.columns}
+                    com.submit(lambda i=i, cols=cols, n=len(pdf),
+                               sr=src_rows: ckpt.complete(
+                                   i, cols, n, meta={"src_rows": sr}))
+                partials.append(pdf)
+                del t, part
     if not partials:
         raise InvalidArgument("ooc_groupby: empty input")
 
@@ -577,50 +638,76 @@ def ooc_sort(src, by, n_partitions: int = 8, chunk_rows: int = 1 << 22,
     # range order: per-bucket device sort, spill in splitter order.
     # With a store, completed buckets replay from their durable spill
     # (identical bytes, no recompute) and each fresh bucket is spilled
-    # + recorded BEFORE its sink call, so a kill between buckets never
-    # loses acknowledged work.
-    total = 0
-    for p in range(n_partitions):
-        watchdog.check("ooc_pass", f"sort bucket {p}")
-        full = parts[p]
-        n = sizes[p]
-        done = ckpt.completed_rows(p) if ckpt is not None else None
-        if done is not None:
-            if done != n:
-                raise DataLossError(
-                    f"ooc_sort: resume manifest records {done} rows "
-                    f"for bucket {p} but the re-scattered source has "
-                    f"{n} — the source changed since the manifest was "
-                    "written; clear the resume_dir")
-            ckpt.note_resumed(p)
-            if n and sink is not None:
-                import pandas as pd
+    # + recorded BEFORE its sink call — both on the ONE async-writer
+    # thread, in bucket order — so a kill between buckets never loses
+    # acknowledged work and the sink stream keeps range order.
+    done_map = ckpt.completed if ckpt is not None else {}
 
-                sink(pd.DataFrame(ckpt.load_unit(p)))
-            total += n
-            # replayed rows count toward rows_out too: a resumed run
-            # produces identical output to a clean one, and must not
-            # read as a row deficit on any dashboard
-            telemetry.counter("ooc.rows_out", op="sort").inc(n)
-            parts[p] = None
-            continue
-        if n == 0:
-            if ckpt is not None:
-                ckpt.complete(p, {}, 0)
-            continue
-        with _span("ooc_sort.bucket", cat="stage", bucket=p, rows=n):
-            _memory.sample(op="ooc_sort")
+    def _ingest(p):
+        """Pipelined ingest of bucket p (prefetch worker): the
+        host→device ``from_pydict`` of bucket p+1 overlaps bucket p's
+        device sort; the host bucket is freed as soon as ingested.
+        Device-resident lookahead — same HBM note as ooc_join's
+        ingest: depth+1 buckets live at once, depth 0 restores the
+        one-bucket footprint."""
+        full, n = parts[p], sizes[p]
+        t = None
+        if p not in done_map and n > 0:
             t = Table.from_pydict(full, capacity=pow2_bucket(n))
-            res = sort_table(t, keys)
-            pdf = res.to_pandas()
-            if ckpt is not None:
-                ckpt.complete(
-                    p, {c: pdf[c].to_numpy() for c in pdf.columns}, n)
-            total += n
-            telemetry.counter("ooc.rows_out", op="sort").inc(n)
-            if sink is not None:
-                sink(pdf)
-            del res, t, full, pdf
-            parts[p] = None  # free the spill as we go
+        parts[p] = None  # free the spill as we go
+        return t
+
+    total = 0
+    with pipeline.committer("sort") as com:
+        for p, t in pipeline.prefetch_map(range(n_partitions), _ingest,
+                                          op="sort"):
+            watchdog.check("ooc_pass", f"sort bucket {p}")
+            n = sizes[p]
+            done = done_map.get(p)
+            if done is not None:
+                if done != n:
+                    raise DataLossError(
+                        f"ooc_sort: resume manifest records {done} "
+                        f"rows for bucket {p} but the re-scattered "
+                        f"source has {n} — the source changed since "
+                        "the manifest was written; clear the "
+                        "resume_dir")
+                ckpt.note_resumed(p)
+                if n and sink is not None:
+                    import pandas as pd
+
+                    com.submit(lambda p=p: sink(
+                        pd.DataFrame(ckpt.load_unit(p))))
+                total += n
+                # replayed rows count toward rows_out too: a resumed
+                # run produces identical output to a clean one, and
+                # must not read as a row deficit on any dashboard
+                telemetry.counter("ooc.rows_out", op="sort").inc(n)
+                continue
+            if n == 0:
+                if ckpt is not None:
+                    com.submit(lambda p=p: ckpt.complete(p, {}, 0))
+                continue
+            with _span("ooc_sort.bucket", cat="stage", bucket=p,
+                       rows=n):
+                _memory.sample(op="ooc_sort")
+                with _span("ooc.compute", cat="stage", op="sort",
+                           unit=p):
+                    res = sort_table(t, keys)
+                    pdf = res.to_pandas()
+                cols = ({c: pdf[c].to_numpy() for c in pdf.columns}
+                        if ckpt is not None else None)
+
+                def _commit(p=p, cols=cols, pdf=pdf, n=n):
+                    if ckpt is not None:
+                        ckpt.complete(p, cols, n)
+                    if sink is not None:
+                        sink(pdf)
+
+                total += n
+                telemetry.counter("ooc.rows_out", op="sort").inc(n)
+                if ckpt is not None or sink is not None:
+                    com.submit(_commit)
+                del res, t, pdf
     resilience.check_conservation("ooc_sort", rows_pass2, total)
     return total

@@ -1159,6 +1159,46 @@ def dist_sort(env: CylonEnv, table: Table, by: Sequence[str] | str,
     return out
 
 
+def _splitter_searchsorted(splitters, rows):
+    """``pid[i] = #splitter tuples lexicographically < row tuple i`` —
+    a vectorised multi-key ``searchsorted`` (lower bound) over the
+    sorted splitter list, as a fixed-depth binary search.
+
+    ``splitters``: parallel per-component arrays of shape ``(W-1,)``
+    (already lexicographically sorted — slices of one ``lax.sort``);
+    ``rows``: the matching per-component operand arrays of shape
+    ``(n,)``. Each of the ``ceil(log2(W-1+1))`` rounds gathers ONE
+    splitter tuple per row (``O(n)`` per component) and refines
+    ``lo``/``hi`` by a lexicographic compare, so per-op transients are
+    ``O(n · components)`` — flat in W — where the old implementation
+    materialised ``(W-1, n)`` boolean comparison matrices per
+    component: a wall at pod-scale W=32/64 (ROADMAP item 3). Strict
+    ``<`` matches the old matrix semantics exactly (a row equal to a
+    splitter tuple lands on the splitter's LEFT shard), so pid — and
+    therefore every shuffle — is bit-identical."""
+    m = int(splitters[0].shape[0])
+    n = rows[0].shape[0]
+    if m == 0:
+        # W=1: no splitters, every row is shard 0 (the old matrix code
+        # reduced over an empty axis; a gather from a size-0 array
+        # would be out of range)
+        return jnp.zeros(n, jnp.int32)
+    lo = jnp.zeros(n, jnp.int32)
+    hi = jnp.full(n, m, jnp.int32)
+    for _ in range(max(m.bit_length(), 1)):
+        active = lo < hi
+        mid = jnp.where(active, (lo + hi) // 2, 0)
+        less = jnp.zeros(n, bool)
+        eq = jnp.ones(n, bool)
+        for g, r in zip(splitters, rows):
+            sp = g[mid]
+            less = less | (eq & (sp < r))
+            eq = eq & (sp == r)
+        lo = jnp.where(active & less, mid + 1, lo)
+        hi = jnp.where(active & ~less, mid, hi)
+    return lo
+
+
 def _sort_body(env, table, by, asc0, asc, nsamp, nbins, out_l, w):
     cap_l = dtable.local_capacity(table)
     ax = env.world_axes
@@ -1266,14 +1306,14 @@ def _sort_body(env, table, by, asc0, asc, nsamp, nbins, out_l, w):
                                    num_keys=len(gathered))
             tot = gsorted[0].shape[0]
             cut = (jnp.arange(1, w, dtype=jnp.int32) * tot) // w
-            # pid = #splitter tuples lexicographically < the row tuple
-            less = jnp.zeros((w - 1, cap_l), bool)
-            eqacc = jnp.ones((w - 1, cap_l), bool)
-            for g, r in zip(gsorted, comps):
-                sp = g[cut]
-                less = less | (eqacc & (sp[:, None] < r[None, :]))
-                eqacc = eqacc & (sp[:, None] == r[None, :])
-            pid = less.sum(axis=0, dtype=jnp.int32)
+            # pid = #splitter tuples lexicographically < the row tuple:
+            # a vectorised multi-key searchsorted over the sorted
+            # splitter tuples — O(rows) transients regardless of W
+            # (ROADMAP item 3: the old (W-1, cap_l) boolean comparison
+            # matrices per key component were a host-memory wall at
+            # pod-scale W)
+            pid = _splitter_searchsorted([g[cut] for g in gsorted],
+                                         comps)
         sh, of = checked_recv(shuffle_local(lt, pid, out_l, axis_name=ax),
                               out_l)
         return _shard_view(poison(_sort_table(sh, by, ascending=asc),

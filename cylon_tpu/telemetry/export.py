@@ -431,6 +431,9 @@ REQUIRED_BENCH_KEYS = (
     "spill.read_bytes",
     "spill.write_bytes",
     "ooc.fallbacks",
+    "ooc.prefetch_hits",
+    "ooc.prefetch_misses",
+    "ooc.overlap_seconds",
     "watchdog.sections_expired",
 )
 

@@ -78,6 +78,7 @@ _COUNTERS = (
     "spill.read_bytes", "spill.write_bytes", "resilience.retries",
     "resilience.faults_injected", "ooc.chunks", "ooc.rows_out",
     "ooc.fallbacks", "ooc.fallback_partitions", "ooc.units_resumed",
+    "ooc.prefetch_hits", "ooc.prefetch_misses", "ooc.overlap_seconds",
     "join.algorithm", "join.overflow_fallbacks",
 )
 

@@ -20,6 +20,14 @@ the inter-stage buffer:
 
 Both return the same frame as their in-core twins (pandas-oracle
 tested at small SF in ``tests/test_outofcore.py``).
+
+Both drivers are PIPELINED through ``ooc_groupby``'s shared ingest
+funnel (:mod:`cylon_tpu.pipeline`): chunk k+1's pull/decode runs on a
+prefetch worker while chunk k's filter+pre-combine computes on-device,
+and per-chunk checkpoint commits (``resume_dir=``) overlap the next
+chunk on the async writer — ``CYLON_TPU_OOC_PREFETCH_DEPTH=0``
+restores the sequential behaviour (see ``docs/outofcore.md``
+"Pipelined execution").
 """
 
 from typing import Iterable, Mapping

@@ -94,6 +94,11 @@ SECTIONS: "dict[str, bool]" = {
     "overflow_fetch": False,
     "spill_io": True,
     "ooc_pass": False,
+    # one unit of pipelined ingest on a prefetch worker
+    # (cylon_tpu.pipeline) — never retryable on its own: the expiry
+    # surfaces on the consuming pass, whose ooc_pass section already
+    # says the mesh/pass state is unrecoverable
+    "ooc_prefetch": False,
     "exchange": False,
     # one admitted serve request's execution step (cylon_tpu.serve) —
     # never engine-retryable: re-running a half-executed query after

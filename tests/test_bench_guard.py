@@ -624,6 +624,76 @@ def test_checker_accepts_closures_and_comprehensions(tmp_path):
     assert undefined_names(p) == []
 
 
+# ------------------------------------------- ooc-overlap guards
+def test_ooc_overlap_record_schema_pinned():
+    """ISSUE 13 satellite: the overlap A/B verdict is only auditable
+    if every --ooc-overlap record pins the op, source model, BOTH
+    walls, the prefetch counters, the hidden-IO seconds, the
+    per-stage idle fractions and the trace artifact path — and the
+    harness asserts the schema before emitting."""
+    import bench
+
+    assert {"op", "rows", "source", "sequential_wall", "overlap_wall",
+            "overlap_speedup", "prefetch_hits", "prefetch_misses",
+            "overlap_seconds", "prefetch_compute_overlap_s",
+            "idle_fractions_sequential", "idle_fractions_overlap",
+            "platform", "trace_path"} <= bench.REQUIRED_OOC_OVERLAP_FIELDS
+    src = (REPO / "bench.py").read_text()
+    assert "REQUIRED_OOC_OVERLAP_FIELDS - record.keys()" in src
+
+
+def _fn_references(fn: "ast.FunctionDef") -> set:
+    """Every Name load + Attribute attr referenced inside ``fn``."""
+    out = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name):
+            out.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            out.add(node.attr)
+    return out
+
+
+def test_every_ooc_entrypoint_routes_ingest_through_prefetcher():
+    """ISSUE 13 satellite (CI lint): chunk ingest has ONE funnel —
+    ``_resolve_source`` → ``pipeline.prefetched`` — and every public
+    ``ooc_*`` entrypoint must route through it; the per-unit device
+    ingest loops of ooc_join/ooc_sort (and fallback's partition loop)
+    must ride ``pipeline.prefetch_map``, and every pass's durable
+    commits must ride ``pipeline.committer``. A later PR adding a
+    sequential side-door (a pass that iterates its source directly)
+    would silently regress the overlap this PR measured."""
+    path = REPO / "cylon_tpu" / "outofcore.py"
+    tree = ast.parse(path.read_text(), filename=str(path))
+    fns = {n.name: n for n in ast.iter_child_nodes(tree)
+           if isinstance(n, _FN)}
+    ops = [n for n in fns.values() if n.name.startswith("ooc_")]
+    assert len(ops) >= 3, "OOC entrypoint surface unexpectedly small"
+    # the shared funnel itself prefetches
+    assert "prefetched" in _fn_references(fns["_resolve_source"]), (
+        "_resolve_source no longer routes chunk ingest through "
+        "pipeline.prefetched — the shared-prefetcher funnel is gone")
+    for fn in ops:
+        refs = _fn_references(fn)
+        assert "_resolve_source" in refs, (
+            f"{fn.name} ingests chunks outside _resolve_source — a "
+            "sequential side-door around the shared prefetcher")
+        assert "committer" in refs, (
+            f"{fn.name} commits units outside pipeline.committer — "
+            "its spill writes no longer overlap compute")
+    for name in ("ooc_join", "ooc_sort"):
+        assert "prefetch_map" in _fn_references(fns[name]), (
+            f"{name}'s per-unit device ingest no longer rides "
+            "pipeline.prefetch_map")
+    # the fallback executor's partition loop too
+    fpath = REPO / "cylon_tpu" / "fallback.py"
+    ftree = ast.parse(fpath.read_text(), filename=str(fpath))
+    ffns = {n.name: n for n in ast.iter_child_nodes(ftree)
+            if isinstance(n, _FN)}
+    frefs = _fn_references(ffns["tpch_fallback"])
+    assert {"prefetch_map", "committer"} <= frefs, (
+        "tpch_fallback's partition loop left the pipelined executor")
+
+
 # ------------------------------------------- hash-join A/B guards
 def test_join_ab_record_schema_pinned():
     """ISSUE 12 satellite: the A/B verdict is only reproducible if

@@ -5,6 +5,7 @@ formatter against a real scraper's rules instead of "it looks right".
 """
 
 import json
+import math
 import re
 import urllib.request
 
@@ -28,6 +29,9 @@ _VALUE = re.compile(
     r"^[+-]?(?:[0-9]+(?:\.[0-9]+)?(?:[eE][+-]?[0-9]+)?|\.[0-9]+"
     r"(?:[eE][+-]?[0-9]+)?)$")
 _INT = re.compile(r"^[+-]?[0-9]+$")
+#: plain decimal (no exponent, no inf/nan) — the seconds-unit counter
+#: form; an exponent here would mean a value went through %g rounding
+_FLOAT = re.compile(r"^[+-]?[0-9]+(\.[0-9]+)?$")
 
 
 def _parse_labels(block: str) -> dict:
@@ -181,10 +185,18 @@ def test_http_metrics_payload_is_strictly_valid(monkeypatch):
     assert parsed["cylon_serve_requests"]["type"] == "counter"
     ((_, lab, v),) = parsed["cylon_promtest_http"]["samples"]
     assert lab == {"tenant": 't"x\\y'} and int(v) == 2**40
-    # every counter sample in the whole payload is an exact integer
+    # every counter sample in the whole payload is exact: count-like
+    # counters are exact integers (the %g-rounding-of-GB-byte-counters
+    # guard), and seconds-unit counters (legitimately float, like
+    # process_cpu_seconds_total — e.g. ooc.overlap_seconds) are plain
+    # finite decimals with NO exponent (what rounding would produce)
     for mname, entry in parsed.items():
         if entry["type"] == "counter":
             for _, _, value in entry["samples"]:
-                assert _INT.match(value), (mname, value)
+                if mname.endswith("_seconds"):
+                    assert _FLOAT.match(value), (mname, value)
+                    assert math.isfinite(float(value))
+                else:
+                    assert _INT.match(value), (mname, value)
     # strict JSON sanity of the parse result (no stray bytes)
     json.dumps({k: v["type"] for k, v in parsed.items()})

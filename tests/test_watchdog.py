@@ -175,8 +175,8 @@ def test_retryable_classification_per_section():
         verdicts[section] = is_retryable(ei.value)
     assert verdicts == {"barrier": False, "bootstrap": True,
                         "overflow_fetch": False, "spill_io": True,
-                        "ooc_pass": False, "exchange": False,
-                        "serve_request": False}
+                        "ooc_pass": False, "ooc_prefetch": False,
+                        "exchange": False, "serve_request": False}
 
 
 def test_retrying_absorbs_retryable_deadline():
