@@ -38,7 +38,8 @@ instrumented eager ``dist_join`` path with the flight recorder armed
 (``CYLON_TPU_TRACE`` — the pipelined headline hand-rolls its shard_map
 and bypasses the recorder by construction), writes the Chrome Trace
 Event artifact next to the bench record
-(``CYLON_BENCH_TRACE_PATH``, default ``bench.trace.json`` — open in
+(``CYLON_BENCH_TRACE_PATH``, default
+``bench_artifacts/bench.trace.json`` — open in
 Perfetto / ``chrome://tracing``) and pins its path + event count +
 rank-track count + per-stage wall coverage into the JSON record
 (:data:`REQUIRED_TRACE_FIELDS`, schema enforced by
@@ -222,6 +223,14 @@ REQUIRED_HEADLINE_FIELDS = frozenset({
     "metric", "value", "unit", "vs_baseline",
     "exchange_bytes_per_sec", "fraction_of_hbm_peak", "exchange_note",
 })
+
+#: where bench artifacts (Chrome traces and friends) land by default:
+#: a dedicated directory, NOT the repo root — committed artifacts stay
+#: out of the tree's top level and every record pins the actual path
+#: (ISSUE 14 satellite; override per artifact via
+#: ``CYLON_BENCH_TRACE_PATH``)
+ARTIFACTS_DIR = os.environ.get("CYLON_BENCH_ARTIFACTS_DIR",
+                               "bench_artifacts")
 
 #: fields a ``--trace`` run must pin into the headline record — the
 #: artifact is only auditable if the record says where it is and how
@@ -441,7 +450,8 @@ def _bench_ooc_overlap():
                 _, seq_idle, _ = _ooc_stage_stats(seq_evts, seq_wall)
                 _, ov_idle, xov = _ooc_stage_stats(ov_evts, ov_wall)
                 tpath = os.path.abspath(
-                    f"ooc_overlap.{op}.{source}.trace.json")
+                    os.path.join(ARTIFACTS_DIR,
+                    f"ooc_overlap.{op}.{source}.trace.json"))
                 telemetry.write_chrome_trace(
                     tpath, telemetry.to_chrome_trace(
                         [{"rank": 0, "clock_offset": 0.0,
@@ -654,7 +664,9 @@ def _traced_headline_join(n: int, rng) -> dict:
             os.environ["CYLON_TPU_FORCE_DIST"] = prev_force
     evts = trace.events()
     coverage = trace.stage_coverage(evts, "dist_join")
-    path = os.environ.get("CYLON_BENCH_TRACE_PATH", "bench.trace.json")
+    path = os.environ.get("CYLON_BENCH_TRACE_PATH",
+                          os.path.join(ARTIFACTS_DIR,
+                                       "bench.trace.json"))
     doc = telemetry.to_chrome_trace(trace.rank_buffers(env),
                                     world=env.world_size)
     telemetry.write_chrome_trace(path, doc)

@@ -41,6 +41,14 @@ import time
 import numpy as np
 
 
+
+def _artifacts_dir() -> str:
+    """The bench-artifact directory — bench.py's ARTIFACTS_DIR is the
+    single source of truth (one env knob, one default literal), so the
+    suite's trace paths can never diverge from the headline bench's."""
+    import bench as headline
+
+    return headline.ARTIFACTS_DIR
 def _timeit(fn, sync, reps):
     fn()  # compile
     float(np.asarray(sync()).ravel()[0])
@@ -233,7 +241,8 @@ def main():
         # a DISTINCT path: sharing the parent's would let the parent's
         # end-of-suite artifact overwrite the child's
         base = os.environ.get("CYLON_BENCH_TRACE_PATH",
-                              "bench_suite.trace.json")
+                              os.path.join(_artifacts_dir(),
+                                       "bench_suite.trace.json"))
         root = base[:-5] if base.endswith(".json") else base
         child_env["CYLON_BENCH_TRACE_PATH"] = root + ".exchange.json"
     else:
@@ -1303,7 +1312,8 @@ def _trace_artifact_record():
 
     evts = trace.events()
     path = os.environ.get("CYLON_BENCH_TRACE_PATH",
-                          "bench_suite.trace.json")
+                          os.path.join(_artifacts_dir(),
+                                       "bench_suite.trace.json"))
     telemetry.write_chrome_trace(path, trace.rank_buffers())
     _emit_record({"metric": "trace_artifact", "value": len(evts),
                   "unit": "events",

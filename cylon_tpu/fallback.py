@@ -113,6 +113,52 @@ def default_partitions() -> int:
         return 8
 
 
+def _hbm_budget_override() -> "int | None":
+    """The ``CYLON_TPU_HBM_BUDGET_BYTES`` operator cap, parsed ONCE
+    for every reader (pre-flight's free calculation and /health's
+    headroom denominator — divergent parses would let the two disagree
+    about which data source is live). None when unset or unusable; a
+    malformed value is LOUDLY ignored — silently un-forcing an
+    operator's budget cap (or a test's forced-tiny budget) would swap
+    the data source without a trace."""
+    knob = os.environ.get("CYLON_TPU_HBM_BUDGET_BYTES")
+    if not knob:
+        return None
+    try:
+        budget = int(knob)
+    except ValueError:
+        from cylon_tpu.utils.logging import get_logger
+
+        get_logger().warning(
+            "malformed CYLON_TPU_HBM_BUDGET_BYTES=%r ignored — "
+            "falling back to allocator stats", knob)
+        return None
+    return budget if budget > 0 else None
+
+
+def _allocator_stat_sum(field: str,
+                        used_delta: bool = False) -> "int | None":
+    """Sum one allocator stat across devices (``bytes_limit``, or
+    limit − in-use when ``used_delta``); None when no device reports
+    it (plain CPU) — the shared walk behind :func:`free_hbm_bytes`
+    and :func:`hbm_limit_bytes`."""
+    import jax
+
+    total, known = 0, False
+    for d in jax.devices():
+        try:
+            st = d.memory_stats() or {}
+        except Exception:
+            st = {}
+        limit, used = st.get(field), st.get("bytes_in_use")
+        if limit is None or (used_delta and used is None):
+            continue
+        known = True
+        total += (max(int(limit) - int(used), 0) if used_delta
+                  else int(limit))
+    return total if known else None
+
+
 def free_hbm_bytes() -> "int | None":
     """Free device memory the pre-flight compares against.
 
@@ -123,36 +169,23 @@ def free_hbm_bytes() -> "int | None":
     stats (``bytes_limit`` − ``bytes_in_use``) sum across devices;
     None when no device reports a limit (plain CPU) — pre-flight then
     stands down and the in-flight OOM catch is the only route."""
-    knob = os.environ.get("CYLON_TPU_HBM_BUDGET_BYTES")
-    if knob:
-        try:
-            budget = int(knob)
-        except ValueError:
-            # LOUDLY ignored: silently un-forcing an operator's budget
-            # cap (or a test's forced-tiny budget) would swap the
-            # pre-flight's data source without a trace
-            from cylon_tpu.utils.logging import get_logger
+    budget = _hbm_budget_override()
+    if budget is not None:
+        return max(budget - _memory.live_bytes(), 0)
+    return _allocator_stat_sum("bytes_limit", used_delta=True)
 
-            get_logger().warning(
-                "malformed CYLON_TPU_HBM_BUDGET_BYTES=%r ignored — "
-                "falling back to allocator stats", knob)
-            budget = 0
-        if budget > 0:
-            return max(budget - _memory.live_bytes(), 0)
-    import jax
 
-    free, known = 0, False
-    for d in jax.devices():
-        try:
-            st = d.memory_stats() or {}
-        except Exception:
-            st = {}
-        limit, used = st.get("bytes_limit"), st.get("bytes_in_use")
-        if limit is None or used is None:
-            continue
-        known = True
-        free += max(int(limit) - int(used), 0)
-    return free if known else None
+def hbm_limit_bytes() -> "int | None":
+    """Total device memory the headroom fraction divides by: the
+    ``CYLON_TPU_HBM_BUDGET_BYTES`` override when set (the same
+    authority order as :func:`free_hbm_bytes`), else the summed
+    allocator ``bytes_limit``; None on a limit-less backend (plain
+    CPU) — the ``/health`` verdict then skips its memory component
+    rather than inventing a denominator."""
+    budget = _hbm_budget_override()
+    if budget is not None:
+        return budget
+    return _allocator_stat_sum("bytes_limit")
 
 
 def _nbytes(obj) -> int:
@@ -218,6 +251,7 @@ def run_with_fallback(attempt, spill, *, op: str,
             and predicted_bytes > budget):
         telemetry.counter("ooc.fallbacks", op=op,
                           reason="preflight").inc()
+        telemetry.events.emit("fallback", op=op, reason="preflight")
         _trace.instant("fallback.spill", cat="fallback", op=op,
                        reason="preflight", predicted=predicted_bytes,
                        budget=budget)
@@ -238,6 +272,7 @@ def run_with_fallback(attempt, spill, *, op: str,
         if not _memory.is_oom(e):
             raise
         telemetry.counter("ooc.fallbacks", op=op, reason="oom").inc()
+        telemetry.events.emit("fallback", op=op, reason="oom")
         _trace.instant("fallback.spill", cat="fallback", op=op,
                        reason="oom", error=type(e).__name__)
         from cylon_tpu.utils.logging import get_logger

@@ -683,6 +683,8 @@ class CheckpointedRun:
         telemetry.counter("ooc.units_resumed", op=self.op).inc()
         _trace.instant("ckpt.resume", cat="resilience", op=self.op,
                        unit=int(unit))
+        telemetry.events.emit("checkpoint_resume", op=self.op,
+                              unit=int(unit))
 
     def load_unit(self, unit: int) -> dict:
         """A completed unit's columns from the durable spill ({} for

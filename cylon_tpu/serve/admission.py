@@ -48,10 +48,21 @@ env                                meaning                      default
 ``CYLON_TPU_SERVE_BREAKER_COOLDOWN`` open→half-open delay (s)   ``5``
 ``CYLON_TPU_SERVE_MEMORY_BUDGET``  predicted-bytes admission
                                    cap (bytes; ``0`` disables)  ``0``
+``CYLON_TPU_SERVE_SLO_TARGET``     per-tenant success objective
+                                   for burn-rate accounting
+                                   (e.g. ``0.99``; ``0``
+                                   disables)                    ``0``
+``CYLON_TPU_SERVE_SLO_LATENCY``    latency objective (s): a
+                                   completion slower than this
+                                   counts BAD toward the burn
+                                   (``0`` = success-only SLO)   ``0``
+``CYLON_TPU_SERVE_SLO_WINDOWS``    comma-separated burn windows
+                                   (s), short first             ``60,300``
+``CYLON_TPU_SERVE_BURN_CRITICAL``  burn rate at which /health
+                                   turns unhealthy              ``10``
 ================================== ============================ =========
 """
 
-import collections
 import dataclasses
 import os
 import threading
@@ -59,6 +70,8 @@ import time
 
 from cylon_tpu import telemetry
 from cylon_tpu.errors import InvalidArgument, ResourceExhausted
+from cylon_tpu.telemetry import events as _events
+from cylon_tpu.telemetry.timeseries import EventWindow
 
 __all__ = ["ServePolicy", "default_policy", "AdmissionController",
            "CircuitBreaker"]
@@ -81,6 +94,22 @@ class ServePolicy:
     #: ``serve.shed{reason="memory"}`` — the front-door twin of the
     #: OOM→spill fallback's pre-flight (``CYLON_TPU_SERVE_MEMORY_BUDGET``)
     memory_budget: "int | None" = None
+    #: SLO burn-rate accounting (ISSUE 14; None disables — the
+    #: default, so an unarmed engine allocates no windows): the
+    #: per-tenant SUCCESS objective (e.g. 0.99 = 1% error budget)
+    #: retirements are scored against
+    slo_target: "float | None" = None
+    #: latency objective (seconds; None = success-only SLO): a request
+    #: that completes but slower than this counts BAD toward the burn
+    slo_latency: "float | None" = None
+    #: burn windows (seconds, short first): the multi-window pair the
+    #: SRE recipe reads together — short for fast detection, long for
+    #: de-flapping
+    slo_windows: "tuple" = (60.0, 300.0)
+    #: burn rate at which the /health verdict flags a tenant's SLO as
+    #: unhealthy (>= 1 is already "burning too fast"; this is the
+    #: page-now threshold)
+    burn_critical: float = 10.0
 
     def __post_init__(self):
         if self.max_queue < 1:
@@ -105,6 +134,22 @@ class ServePolicy:
             raise InvalidArgument(
                 f"memory_budget must be >= 0 bytes (0/None disables), "
                 f"got {self.memory_budget}")
+        if self.slo_target is not None and not 0 < self.slo_target < 1:
+            raise InvalidArgument(
+                f"slo_target must be in (0, 1) or None, got "
+                f"{self.slo_target}")
+        if self.slo_latency is not None and self.slo_latency <= 0:
+            raise InvalidArgument(
+                f"slo_latency must be > 0 seconds or None, got "
+                f"{self.slo_latency}")
+        if not self.slo_windows or \
+                any(w <= 0 for w in self.slo_windows):
+            raise InvalidArgument(
+                f"slo_windows must be non-empty positive seconds, got "
+                f"{self.slo_windows}")
+        if self.burn_critical <= 0:
+            raise InvalidArgument(
+                f"burn_critical must be > 0, got {self.burn_critical}")
 
 
 def default_policy() -> ServePolicy:
@@ -113,6 +158,12 @@ def default_policy() -> ServePolicy:
     e = os.environ
     slo = float(e.get("CYLON_TPU_SERVE_SLO", "0"))
     mem = int(e.get("CYLON_TPU_SERVE_MEMORY_BUDGET", "0"))
+    target = float(e.get("CYLON_TPU_SERVE_SLO_TARGET", "0"))
+    latency = float(e.get("CYLON_TPU_SERVE_SLO_LATENCY", "0"))
+    windows = tuple(
+        float(w) for w in
+        e.get("CYLON_TPU_SERVE_SLO_WINDOWS", "60,300").split(",")
+        if w.strip())
     return ServePolicy(
         max_queue=int(e.get("CYLON_TPU_SERVE_MAX_QUEUE", "64")),
         default_slo=slo if slo > 0 else None,
@@ -123,6 +174,11 @@ def default_policy() -> ServePolicy:
         breaker_cooldown=float(
             e.get("CYLON_TPU_SERVE_BREAKER_COOLDOWN", "5")),
         memory_budget=mem if mem > 0 else None,
+        slo_target=target if target > 0 else None,
+        slo_latency=latency if latency > 0 else None,
+        slo_windows=windows or (60.0, 300.0),
+        burn_critical=float(
+            e.get("CYLON_TPU_SERVE_BURN_CRITICAL", "10")),
     )
 
 
@@ -138,7 +194,17 @@ class CircuitBreaker:
     seconds pass, when the breaker half-opens: the failure ledger
     clears and admissions probe through (a fresh storm re-trips it). A
     success in the closed state clears the ledger — only *sustained*
-    storms trip. ``threshold <= 0`` disables the breaker entirely."""
+    storms trip. ``threshold <= 0`` disables the breaker entirely.
+
+    The failure window rides the shared sliding-window machinery
+    (:class:`~cylon_tpu.telemetry.timeseries.EventWindow` — ISSUE 14),
+    and the breaker's state is OBSERVABLE instead of private:
+    :meth:`snapshot` reports state (``closed``/``open``/``half_open``
+    — half-open = cooldown elapsed, next admission probes through),
+    cooldown remaining and the windowed failure count; ``/healthz``
+    and the ``/health`` verdict both read it, and open/close
+    transitions land in the structured event journal
+    (``breaker_open``/``breaker_close``)."""
 
     #: error type names that count toward tripping: the systemic-
     #: overload classes (a deadline storm from a wedged mesh, resource
@@ -152,28 +218,59 @@ class CircuitBreaker:
         self.window = float(window)
         self.cooldown = float(cooldown)
         self._mu = threading.Lock()
-        self._failures: "collections.deque[float]" = collections.deque()
+        #: windowed failure ledger — O(slots) memory however large the
+        #: storm (the old deque of timestamps grew with it)
+        self._failures = EventWindow(self.window)
         self._opened_at: "float | None" = None
+
+    def _state_locked(self, now: float) -> str:
+        if self._opened_at is None:
+            return "closed"
+        if now - self._opened_at < self.cooldown:
+            return "open"
+        return "half_open"  # next allow() probes through
 
     @property
     def state(self) -> str:
         with self._mu:
-            return "open" if self._opened_at is not None else "closed"
+            return self._state_locked(time.monotonic())
+
+    def snapshot(self) -> dict:
+        """Observable breaker state (the ``/healthz`` + ``/health``
+        payload): state, seconds of cooldown remaining (0 unless
+        open), and the current windowed failure count."""
+        now = time.monotonic()
+        with self._mu:
+            state = self._state_locked(now)
+            remaining = (max(self.cooldown - (now - self._opened_at),
+                             0.0) if self._opened_at is not None
+                         else 0.0)
+            failures = self._failures.count(now)
+        return {"state": state,
+                "cooldown_remaining_s": round(remaining, 3),
+                "window_failures": failures,
+                "threshold": self.threshold,
+                "window_s": self.window,
+                "cooldown_s": self.cooldown}
 
     def record_failure(self, kind: str) -> None:
         if self.threshold <= 0 or kind not in self.BREAKING_KINDS:
             return
         now = time.monotonic()
         with self._mu:
-            self._failures.append(now)
-            while self._failures and \
-                    now - self._failures[0] > self.window:
-                self._failures.popleft()
-            if (self._opened_at is None
-                    and len(self._failures) >= self.threshold):
+            self._failures.add(1, now=now)
+            n = self._failures.count(now)
+            if self._opened_at is None and n >= self.threshold:
                 self._opened_at = now
                 telemetry.counter("serve.breaker_trips").inc()
                 telemetry.gauge("serve.breaker_open").set(1)
+                tripped = True
+            else:
+                tripped = False
+        if tripped:
+            _events.emit("breaker_open", failures=n,
+                         window_s=self.window,
+                         cooldown_s=self.cooldown)
 
     def record_success(self) -> None:
         """A completed request in the closed state clears the streak
@@ -188,16 +285,19 @@ class CircuitBreaker:
         probe through)."""
         if self.threshold <= 0:
             return True
+        now = time.monotonic()
         with self._mu:
             if self._opened_at is None:
                 return True
-            if time.monotonic() - self._opened_at < self.cooldown:
+            if now - self._opened_at < self.cooldown:
                 return False
             # half-open: let traffic probe; a fresh storm re-trips
+            open_s = now - self._opened_at
             self._opened_at = None
             self._failures.clear()
             telemetry.gauge("serve.breaker_open").set(0)
-            return True
+        _events.emit("breaker_close", open_s=round(open_s, 3))
+        return True
 
 
 class AdmissionController:
@@ -236,6 +336,7 @@ class AdmissionController:
             telemetry.counter("serve.shed", reason="memory",
                               tenant=tenant).inc()
             telemetry.counter("serve.rejected", tenant=tenant).inc()
+            _events.emit("shed", tenant=tenant, reason="memory")
             raise ResourceExhausted(
                 f"predicted memory {predicted_bytes} bytes exceeds "
                 f"the serve memory budget {budget} (tenant "
@@ -248,6 +349,7 @@ class AdmissionController:
             telemetry.counter("serve.shed", reason="breaker",
                               tenant=tenant).inc()
             telemetry.counter("serve.rejected", tenant=tenant).inc()
+            _events.emit("shed", tenant=tenant, reason="breaker")
             raise ResourceExhausted(
                 f"serve circuit breaker open (sustained "
                 f"DeadlineExceeded/ResourceExhausted storm; tenant "
@@ -267,6 +369,7 @@ class AdmissionController:
             telemetry.counter("serve.shed", reason="queue_full",
                               tenant=tenant).inc()
             telemetry.counter("serve.rejected", tenant=tenant).inc()
+            _events.emit("shed", tenant=tenant, reason="queue_full")
             raise ResourceExhausted(
                 f"serve queue full: {depth} live requests >= cap "
                 f"{self.policy.max_queue} (tenant {tenant!r}); "

@@ -51,6 +51,12 @@ REQUIRED_SERVE_FIELDS = frozenset({
     # slowest request's ANALYZE profile and the run's HBM high-water
     # mark, not just p50/p99
     "slowest_profile", "peak_live_bytes",
+    # windowed-observability columns (ISSUE 14): the sliding-window
+    # p99 (from the metric-history ring — within one pow2 bucket of
+    # the exact per-request quantile, which rides as p99_exact_s) and
+    # the worst SLO burn rate any tenant reached (0 when burn
+    # accounting is unarmed)
+    "windowed_p99_s", "slo_burn",
 })
 
 #: default mixed workload: groupby-heavy scan, 3-way join + top-k,
@@ -141,15 +147,118 @@ def _mk_resident(env, data):
     return resident
 
 
+def _fault_storm(engine, http_addr, requests: int = 8,
+                 tenant: str = "storm") -> dict:
+    """The ISSUE 14 measured acceptance: drive ONE tenant into a
+    deadline storm against a live engine and watch the observability
+    plane tell the story — ``/health`` flips ok → unhealthy (reasons
+    naming the breaker and the burning tenant's SLO), sheds and
+    breaker transitions land in ``/events`` in order, and after the
+    cooldown + the storm window aging out, ``/health`` recovers to ok.
+
+    Polls the verdict over HTTP when the introspection endpoint is
+    armed (the router's view), falling back to ``engine.health()``."""
+    import urllib.request
+
+    from cylon_tpu import telemetry
+    from cylon_tpu.telemetry import events as _events
+
+    def verdict():
+        if http_addr is not None:
+            url = "http://%s:%d/health" % http_addr
+            with urllib.request.urlopen(url, timeout=10) as r:
+                return json.loads(r.read())
+        return engine.health()
+
+    cursor = _events.since(0)["cursor"]
+    transitions = [verdict()["status"]]
+    unhealthy_reasons = None
+    peak_burn = 0.0
+
+    def note(v):
+        nonlocal unhealthy_reasons, peak_burn
+        if v["status"] != transitions[-1]:
+            transitions.append(v["status"])
+        if v["status"] == "unhealthy" and unhealthy_reasons is None:
+            unhealthy_reasons = list(v["reasons"])
+        worst = (v.get("components", {}).get("slo") or {}).get("worst")
+        if worst and worst["burn"] > peak_burn:
+            peak_burn = worst["burn"]
+
+    def slow():
+        time.sleep(0.3)
+        return None
+
+    t0 = time.perf_counter()
+    tickets = []
+    for _ in range(int(requests)):
+        try:
+            tickets.append(engine.submit(slow, tenant=tenant,
+                                         slo=0.02))
+        except Exception:
+            pass  # breaker may already be shedding: that IS the storm
+        note(verdict())
+    for tk in tickets:
+        try:
+            tk.result(30)
+        except Exception:
+            pass
+        note(verdict())
+    # keep poking the front door while open so sheds land in /events
+    shed_probe_errors = 0
+    deadline = time.monotonic() + 60
+    recovered = False
+    while time.monotonic() < deadline:
+        v = verdict()
+        note(v)
+        if v["status"] == "ok" and "unhealthy" in transitions:
+            recovered = True
+            break
+        try:
+            # good traffic probes the half-open breaker and re-earns
+            # the SLO budget once the storm ages out of the window
+            engine.submit(lambda: 1, tenant=tenant,
+                          slo=30.0).result(30)
+        except Exception:
+            shed_probe_errors += 1
+        time.sleep(0.25)
+    replay = _events.since(cursor)
+    kinds = [e["kind"] for e in replay["events"]]
+    seqs = [e["seq"] for e in replay["events"]]
+    return {
+        "tenant": tenant,
+        "requests": int(requests),
+        "wall_s": round(time.perf_counter() - t0, 3),
+        "health_transitions": transitions,
+        "unhealthy_reasons": unhealthy_reasons,
+        "recovered": recovered,
+        "peak_burn": round(peak_burn, 4),
+        # recovery probes the open/half-open breaker refused — how
+        # hard the front door pushed back during the recovery loop
+        "recovery_probes_shed": shed_probe_errors,
+        "storm_errors": telemetry.total("serve.errors"),
+        "storm_shed": telemetry.total("serve.shed"),
+        "breaker_trips": telemetry.total("serve.breaker_trips"),
+        "events_replayed": len(kinds),
+        "event_kinds": sorted(set(kinds)),
+        "events_in_order": seqs == sorted(seqs),
+        "events_dropped": replay["dropped"],
+    }
+
+
 def run_bench(clients: int = 8, requests: int = 2, sf: float = 0.002,
               schedule: str = "roundrobin", slo: "float | None" = None,
               max_queue: "int | None" = None, seed: int = 0,
-              mix=DEFAULT_MIX) -> dict:
+              mix=DEFAULT_MIX, slo_target: "float | None" = None,
+              slo_latency: "float | None" = None,
+              slo_windows: "tuple | None" = None,
+              storm: int = 0) -> dict:
     import cylon_tpu as ct
     from cylon_tpu import catalog, telemetry, tpch, watchdog
     from cylon_tpu.errors import ResourceExhausted
     from cylon_tpu.serve import ServeEngine, ServePolicy
     from cylon_tpu.serve.admission import default_policy
+    from cylon_tpu.telemetry import timeseries
     from cylon_tpu.tpch import dbgen
 
     env = ct.CylonEnv(ct.TPUConfig())
@@ -159,10 +268,28 @@ def run_bench(clients: int = 8, requests: int = 2, sf: float = 0.002,
         catalog.put_table(f"tpch/{name}", df.table)
 
     base = default_policy()
+    if storm and slo_target is None and base.slo_target is None:
+        # the fault-storm acceptance needs burn accounting armed and
+        # windows short enough to watch /health recover inside one
+        # bench run
+        slo_target = 0.99
+        slo_windows = slo_windows or (10.0, 30.0)
     policy = ServePolicy(
         max_queue=max_queue if max_queue is not None else base.max_queue,
         default_slo=slo if slo and slo > 0 else base.default_slo,
-        schedule=schedule)
+        schedule=schedule,
+        breaker_fails=base.breaker_fails,
+        breaker_window=base.breaker_window,
+        breaker_cooldown=base.breaker_cooldown,
+        slo_target=(slo_target if slo_target is not None
+                    else base.slo_target),
+        slo_latency=(slo_latency if slo_latency is not None
+                     else base.slo_latency),
+        slo_windows=tuple(slo_windows or base.slo_windows),
+        burn_critical=base.burn_critical)
+    # baseline sample for the windowed-p99 column: the whole replay
+    # lands in one history delta slot
+    timeseries.sample(force=True)
 
     # single-query oracles: each mix query runs ONCE, alone, through
     # the same shared compiled plan — every concurrent result must
@@ -224,18 +351,43 @@ def run_bench(clients: int = 8, requests: int = 2, sf: float = 0.002,
             th.join()
     wall = time.perf_counter() - t0
     http_addr = engine.http_address  # captured before close unbinds
-    engine.close(wait=True)
-
+    # close the replay's windowed slot + read the healthy-phase gate
+    # counters BEFORE any storm phase muddies them (storm errors are
+    # INTENDED; they ride the storm block, not the pass/fail columns)
+    timeseries.sample(force=True)
+    windowed_p99 = timeseries.history().quantile(
+        "serve.request_seconds", 0.99)
+    exact_walls = sorted(
+        tk.finished - tk.submitted for _, tk in all_tickets
+        if tk.finished is not None and tk.state == "done")
+    p99_exact = (float(np.quantile(np.asarray(exact_walls), 0.99))
+                 if exact_walls else None)
+    healthy_errors = telemetry.total("serve.errors")
+    healthy_shed = telemetry.total("serve.shed")
+    healthy_rejected = telemetry.total("serve.rejected")
+    healthy_expired = telemetry.total("serve.expired")
+    # ... and the latency/throughput columns: the cumulative request
+    # histogram, completed count and tenant set are REPLAY-ONLY too —
+    # read after the storm they would absorb the storm's expired
+    # walls + recovery probes and overstate qps against the
+    # replay-only wall
     hist = telemetry.merge_histograms(
         [inst for _, _, inst in
          telemetry.instruments("serve.request_seconds")])
     completed = telemetry.total("serve.completed")
+    n_tenants = len(engine.tenant_stats())
+
+    storm_block = (_fault_storm(engine, http_addr, requests=storm)
+                   if storm else None)
+    worst = engine.slo_report().get("worst")
+    engine.close(wait=True)
+
     cache = engine.plan_cache_stats()
     record = {
         "metric": "serve_bench_tpch_mix",
         "clients": clients,
         "requests_total": clients * requests,
-        "tenants": len(engine.tenant_stats()),
+        "tenants": n_tenants,
         "schedule": schedule,
         "sf": sf,
         "wall_s": round(wall, 3),
@@ -245,14 +397,27 @@ def run_bench(clients: int = 8, requests: int = 2, sf: float = 0.002,
         "p99_s": (round(hist.quantile(0.99), 4)
                   if hist is not None and hist.count else None),
         "completed": completed,
-        "rejected": telemetry.total("serve.rejected"),
-        "errors": telemetry.total("serve.errors"),
-        "expired": telemetry.total("serve.expired"),
+        "rejected": healthy_rejected,
+        "errors": healthy_errors,
+        "expired": healthy_expired,
         # robustness columns (ISSUE 8): load shed by the admission
         # layer (queue_full / breaker), journal replays and recoveries
         # — 0 on a healthy fault-free replay, pinned so a chaos run's
         # sheds/replays ride the trajectory
-        "shed": telemetry.total("serve.shed"),
+        "shed": healthy_shed,
+        # windowed-observability columns (ISSUE 14): sliding-window
+        # p99 from the metric-history ring (bucket resolution — the
+        # exact client-side quantile rides as p99_exact_s for the
+        # within-one-bucket pin) and the worst tenant burn rate
+        "windowed_p99_s": (round(windowed_p99, 4)
+                           if windowed_p99 is not None else None),
+        "p99_exact_s": (round(p99_exact, 4)
+                        if p99_exact is not None else None),
+        # the worst burn any tenant REACHED during the run (a storm's
+        # peak survives the recovery that the live read decays with)
+        "slo_burn": max(
+            worst["burn"] if worst is not None else 0.0,
+            storm_block["peak_burn"] if storm_block else 0.0),
         "journal_replayed": telemetry.total("serve.journal_replayed"),
         "recoveries": telemetry.total("serve.recoveries"),
         # graceful degradation (ISSUE 10): requests that completed
@@ -282,6 +447,8 @@ def run_bench(clients: int = 8, requests: int = 2, sf: float = 0.002,
         prof["query"] = slowest[1]
     record["slowest_profile"] = prof
     record["peak_live_bytes"] = telemetry.memory.peak_live_bytes()
+    if storm_block is not None:
+        record["storm"] = storm_block
     if http_addr is not None:
         record["http_url"] = "http://%s:%d" % http_addr
     return record
@@ -301,19 +468,43 @@ def main(argv=None):
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--mix", default=",".join(DEFAULT_MIX),
                    help="comma-separated TPC-H query names")
+    p.add_argument("--slo-target", type=float, default=0.0,
+                   help="per-tenant success objective for burn-rate "
+                        "accounting (e.g. 0.99; 0 = policy/env default)")
+    p.add_argument("--slo-latency", type=float, default=0.0,
+                   help="latency objective seconds (0 = success-only)")
+    p.add_argument("--storm", type=int, default=0,
+                   help="after the replay, drive N fault-storm "
+                        "requests on one tenant and record the "
+                        "/health ok->unhealthy->ok transitions + "
+                        "/events replay (the ISSUE 14 acceptance)")
     args = p.parse_args(argv)
+
+    if args.storm:
+        # the storm acceptance wants the full plane armed: the event
+        # journal and the router's HTTP view of /health
+        os.environ.setdefault("CYLON_TPU_EVENTS", "1")
+        os.environ.setdefault("CYLON_TPU_SERVE_HTTP_PORT", "0")
 
     record = run_bench(
         clients=args.clients, requests=args.requests, sf=args.sf,
         schedule=args.schedule, slo=args.slo,
         max_queue=args.max_queue, seed=args.seed,
-        mix=tuple(q.strip() for q in args.mix.split(",") if q.strip()))
+        mix=tuple(q.strip() for q in args.mix.split(",") if q.strip()),
+        slo_target=args.slo_target if args.slo_target > 0 else None,
+        slo_latency=args.slo_latency if args.slo_latency > 0 else None,
+        storm=args.storm)
     missing = REQUIRED_SERVE_FIELDS - record.keys()
     assert not missing, f"serve record dropped fields {missing}"
     _emit_record(record)
     # a replay that corrupted results or failed requests is a FAILED
-    # bench, not a slow one
-    return 1 if (record["oracle_mismatches"] or record["errors"]) else 0
+    # bench, not a slow one; a storm leg that never drove /health to
+    # unhealthy AND back to ok failed its acceptance
+    if record["oracle_mismatches"] or record["errors"]:
+        return 1
+    if args.storm and not record.get("storm", {}).get("recovered"):
+        return 1
+    return 0
 
 
 if __name__ == "__main__":

@@ -14,22 +14,42 @@ Endpoints (all GET, all read-only — the bench guard lints statically
 that no handler can reach ``submit``/``register_*``/``drop_*``/
 ``close``):
 
-=======================  ==============================================
-path                     payload
-=======================  ==============================================
-``/healthz``             liveness: state, live request count, uptime
-``/metrics``             live Prometheus text (the PR 3 exposition
-                         formatter over a fresh registry snapshot)
-``/queries``             in-flight tickets — tenant, state, elapsed,
-                         remaining SLO budget, step count — plus the
-                         process's active watchdog sections (what the
-                         engine is blocked on RIGHT NOW)
-``/tenants``             ``ServeEngine.tenant_stats()``
-``/tables``              resident catalog: rows/bytes/pins/holders +
-                         the per-device byte split
-``/profiles/<rid>``      one retired-or-live request's ANALYZE
-                         profile (``QueryTicket.profile()``)
-=======================  ==============================================
+=========================  ============================================
+path                       payload
+=========================  ============================================
+``/healthz``               liveness: state, live request count,
+                           uptime — PLUS the breaker's observable
+                           state (open/half-open, cooldown remaining)
+                           and shed counts, so the cheap probe can
+                           never silently disagree with ``/health``
+``/health``                the ROUTER-GRADE composite verdict
+                           (:func:`health_verdict`): ``{"status":
+                           ok|degraded|unhealthy, "score",
+                           "reasons": [...], "components": {...}}``
+                           from queue depth vs cap, breaker state,
+                           SLO burn rates, free-HBM headroom,
+                           recent watchdog expiries and scheduler
+                           last-step age
+``/metrics``               live Prometheus text (the PR 3 exposition
+                           formatter over a fresh registry snapshot)
+``/metrics/window``        the sliding-window JSON view
+                           (:func:`cylon_tpu.telemetry.timeseries.
+                           window_view`): merged counter/histogram
+                           deltas over ``?window=<s>`` (default: the
+                           full history window)
+``/events``                the structured event journal replayed in
+                           order from ``?since=<cursor>``
+                           (:func:`cylon_tpu.telemetry.events.since`)
+``/queries``               in-flight tickets — tenant, state, elapsed,
+                           remaining SLO budget, step count — plus the
+                           process's active watchdog sections (what
+                           the engine is blocked on RIGHT NOW)
+``/tenants``               ``ServeEngine.tenant_stats()``
+``/tables``                resident catalog: rows/bytes/pins/holders +
+                           the per-device byte split
+``/profiles/<rid>``        one retired-or-live request's ANALYZE
+                           profile (``QueryTicket.profile()``)
+=========================  ============================================
 
 Binding is loopback-only (``127.0.0.1``) — this is an operator
 diagnostic port, not a public API; port ``0`` binds an ephemeral port
@@ -40,12 +60,149 @@ import json
 import os
 import threading
 import time
+import urllib.parse
 
-__all__ = ["maybe_start", "IntrospectServer", "ENDPOINTS"]
+__all__ = ["maybe_start", "IntrospectServer", "ENDPOINTS",
+           "health_verdict"]
 
 #: the read-only surface (for docs and the landing page)
-ENDPOINTS = ("/healthz", "/metrics", "/queries", "/tenants",
-             "/tables", "/profiles/<rid>")
+ENDPOINTS = ("/healthz", "/health", "/metrics", "/metrics/window",
+             "/events", "/queries", "/tenants", "/tables",
+             "/profiles/<rid>")
+
+#: /health status thresholds over the composite score (1.0 = pristine)
+_OK_SCORE = 0.8
+_DEGRADED_SCORE = 0.5
+
+
+def health_verdict(engine) -> dict:
+    """The composite health verdict a router polls (ISSUE 14).
+
+    Pure read: every component is an existing observable — queue depth
+    vs the admission cap, the circuit breaker's
+    :meth:`~cylon_tpu.serve.admission.CircuitBreaker.snapshot`,
+    per-tenant SLO burn rates (:meth:`ServeEngine.slo_report`),
+    free-HBM headroom (the PR 8/9 allocator accounting), watchdog
+    sections expired inside the metric-history window, and the
+    scheduler's last-step age. Each finding subtracts a fixed penalty
+    from a score starting at 1.0 and appends a human-readable reason;
+    ``status`` is ``ok`` (>= 0.8), ``degraded`` (>= 0.5) or
+    ``unhealthy`` — the contract being: a router should prefer ``ok``
+    engines, deprioritise ``degraded`` ones, and stop routing to
+    ``unhealthy`` ones entirely (an open breaker or a wedged scheduler
+    alone is enough to get there)."""
+    from cylon_tpu import fallback as _fallback
+    from cylon_tpu.telemetry import timeseries
+
+    reasons: "list[str]" = []
+    components: dict = {}
+    score = 1.0
+    policy = engine._policy
+    adm = engine._admission
+
+    # 1. queue depth vs cap — the front door's remaining capacity
+    live, cap = adm.live, policy.max_queue
+    ratio = live / cap if cap else 0.0
+    components["queue"] = {"live": live, "cap": cap,
+                           "ratio": round(ratio, 3)}
+    if ratio >= 1.0:
+        score -= 0.3
+        reasons.append(f"queue_full: {live}/{cap} live requests")
+    elif ratio >= 0.8:
+        score -= 0.1
+        reasons.append(f"queue_pressure: {live}/{cap} live requests")
+
+    # 2. circuit breaker — open means every new submit sheds
+    br = adm.breaker.snapshot()
+    components["breaker"] = br
+    if br["state"] == "open":
+        score -= 0.6
+        reasons.append(
+            f"breaker_open: {br['window_failures']} failure(s) in "
+            f"{br['window_s']:.0f}s window, cooldown "
+            f"{br['cooldown_remaining_s']:.1f}s remaining")
+    elif br["state"] == "half_open":
+        score -= 0.15
+        reasons.append("breaker_half_open: probing after cooldown")
+
+    # 3. SLO burn — the worst tenant/window pair, read fresh
+    slo = engine.slo_report()
+    components["slo"] = slo
+    worst = slo.get("worst")
+    if worst is not None:
+        b = worst["burn"]
+        if b >= policy.burn_critical:
+            score -= 0.5
+            reasons.append(
+                f"slo_burn: tenant {worst['tenant']!r} burning "
+                f"{b:.1f}x its error budget over {worst['window']}")
+        elif b >= 1.0:
+            score -= 0.15
+            reasons.append(
+                f"slo_burn_warning: tenant {worst['tenant']!r} at "
+                f"{b:.1f}x budget over {worst['window']}")
+
+    # 4. free-HBM headroom (PR 8/9 allocator accounting; skipped on a
+    # limit-less backend rather than inventing a denominator)
+    free = _fallback.free_hbm_bytes()
+    limit = _fallback.hbm_limit_bytes()
+    mem = {"free_hbm_bytes": free, "hbm_limit_bytes": limit}
+    if free is not None and limit:
+        headroom = free / limit
+        mem["headroom"] = round(headroom, 4)
+        if headroom < 0.02:
+            score -= 0.4
+            reasons.append(
+                f"hbm_exhausted: {headroom:.1%} of {limit} bytes free")
+        elif headroom < 0.10:
+            score -= 0.15
+            reasons.append(
+                f"hbm_pressure: {headroom:.1%} of {limit} bytes free")
+    components["memory"] = mem
+
+    # 5. watchdog expiries inside the history window (arms/refreshes
+    # the sliding-window ring — the /health poll IS the cadence)
+    view = timeseries.window_view()
+    expired = 0
+    for e in view["series"].values():
+        if e.get("name") == "watchdog.sections_expired" \
+                and e.get("type") == "counter":
+            expired += e.get("value", 0)
+    components["watchdog"] = {
+        "expired_in_window": expired,
+        "window_s": round(view["window_s"], 1)}
+    if expired:
+        score -= 0.2
+        reasons.append(
+            f"watchdog_expired: {expired} section(s) blew their "
+            f"deadline in the last {view['window_s']:.0f}s")
+
+    # 6. scheduler progress — live work + a stale sweep = wedged
+    age = engine.last_step_age()
+    try:
+        stall_after = float(os.environ.get(
+            "CYLON_TPU_SERVE_STALL_AGE", "10"))
+    except ValueError:
+        stall_after = 10.0
+    components["scheduler"] = {
+        "last_step_age_s": (None if age is None else round(age, 3)),
+        "stall_after_s": stall_after}
+    if live > 0 and age is not None and age > stall_after:
+        score -= 0.6
+        reasons.append(
+            f"scheduler_stalled: {live} live request(s) but no "
+            f"scheduler step for {age:.1f}s")
+
+    if getattr(engine, "_closed", False):
+        score = 0.0
+        reasons.append("engine_closed")
+
+    score = max(round(score, 3), 0.0)
+    status = ("ok" if score >= _OK_SCORE else
+              "degraded" if score >= _DEGRADED_SCORE else "unhealthy")
+    return {"status": status, "score": score, "reasons": reasons,
+            "components": components, "live": live,
+            "uptime_s": engine.uptime_s}
 
 
 def maybe_start(engine) -> "IntrospectServer | None":
@@ -147,15 +304,49 @@ class IntrospectServer:
 
     def _route(self, h) -> None:
         from cylon_tpu import telemetry, watchdog
+        from cylon_tpu.telemetry import events as _events
+        from cylon_tpu.telemetry import timeseries as _ts
 
-        path = h.path.split("?", 1)[0].rstrip("/") or "/"
+        path, _, query = h.path.partition("?")
+        path = path.rstrip("/") or "/"
+        qs = urllib.parse.parse_qs(query)
         eng = self._engine
         if path == "/healthz":
+            # the cheap liveness probe carries the breaker's
+            # observable state + shed counts, so it can never
+            # silently disagree with the /health verdict (ISSUE 14
+            # satellite): a prober seeing "ok" while every submit
+            # sheds was exactly the bug class this closes
             self._send(h, 200, {
                 "status": "closed" if eng._closed else "ok",
                 "live": eng.live,
                 "uptime_s": time.monotonic() - self._started,
+                "breaker": eng._admission.breaker.snapshot(),
+                "shed": telemetry.total("serve.shed"),
+                "rejected": telemetry.total("serve.rejected"),
             })
+        elif path == "/health":
+            self._send(h, 200, health_verdict(eng))
+        elif path == "/metrics/window":
+            window = None
+            if qs.get("window"):
+                try:
+                    window = float(qs["window"][0])
+                except ValueError:
+                    self._send(h, 400, {
+                        "error": f"malformed window "
+                                 f"{qs['window'][0]!r}"})
+                    return
+            self._send(h, 200, _ts.window_view(window))
+        elif path == "/events":
+            try:
+                cursor = int(qs.get("since", ["0"])[0])
+            except ValueError:
+                self._send(h, 400, {
+                    "error": f"malformed since cursor "
+                             f"{qs['since'][0]!r}"})
+                return
+            self._send(h, 200, _events.since(cursor))
         elif path == "/metrics":
             self._send(h, 200, telemetry.to_prometheus(),
                        content_type="text/plain; version=0.0.4; "

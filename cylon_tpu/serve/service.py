@@ -97,6 +97,8 @@ from cylon_tpu.ops_graph.execution import (PriorityExecution,
 from cylon_tpu.ops_graph.op import Op
 from cylon_tpu.serve.admission import AdmissionController, ServePolicy
 from cylon_tpu.serve import introspect
+from cylon_tpu.serve.slo import SloTracker
+from cylon_tpu.telemetry import events as _events
 from cylon_tpu.telemetry import memory as _memory
 from cylon_tpu.telemetry import profile as _profile
 from cylon_tpu.telemetry import trace as _trace
@@ -211,6 +213,10 @@ class _QueryOp(Op):
         t = self.ticket
         if t.done:
             return False
+        # liveness stamp at STEP granularity (not just sweep ends):
+        # /health's scheduler-age probe must not read a long single
+        # step mid-sweep as a wedged scheduler
+        self._engine._last_sweep = time.monotonic()
         try:
             rem = t.remaining()
             if rem is not None and rem <= 0:
@@ -257,6 +263,8 @@ class _QueryOp(Op):
                           reason="oom").inc()
         _trace.instant("serve.degrade", cat="serve", tenant=t.tenant,
                        rid=t.rid, error=type(e).__name__)
+        _events.emit("degraded", tenant=t.tenant, rid=t.rid,
+                     error=type(e).__name__)
         from cylon_tpu.utils.logging import get_logger
 
         get_logger().warning(
@@ -334,6 +342,16 @@ class ServeEngine:
         self._env = env
         self._admission = AdmissionController(policy)
         self._policy = self._admission.policy
+        #: per-tenant SLO burn accounting (ISSUE 14) — a no-op unless
+        #: the policy sets slo_target (no windows allocated)
+        self._slo = SloTracker(self._policy)
+        self._started = time.monotonic()
+        #: monotonic ts of the scheduler's last liveness stamp —
+        #: refreshed at admission, loop wake-up, every op step, and
+        #: sweep completion, so /health's age probe only grows while
+        #: the scheduler is genuinely wedged inside one step (an idle
+        #: engine or a freshly-admitted cold query never reads stalled)
+        self._last_sweep: "float | None" = None
         if self._policy.schedule == "priority":
             self._exec = PriorityExecution()
         else:
@@ -502,6 +520,8 @@ class ServeEngine:
         telemetry.counter("serve.requests", tenant=ticket.tenant).inc()
         _trace.instant("serve.admit", cat="serve", tenant=ticket.tenant,
                        rid=ticket.rid, slo=slo)
+        _events.emit("admit", tenant=ticket.tenant, rid=ticket.rid,
+                     slo=slo)
         # WRITE-AHEAD: the journal records the admission durably BEFORE
         # the scheduler can touch it — a kill at any later instant
         # leaves the request recoverable (bench-guard lints this order).
@@ -623,6 +643,11 @@ class ServeEngine:
             if self._closed:  # lost a race with close(): undo and refuse
                 self._undo_admission(op)
                 raise InvalidArgument("engine is closed")
+            # reset the scheduler-age clock at admission: after an
+            # idle gap _last_sweep is stale by construction (the loop
+            # was parked in cond.wait), and /health polled before the
+            # first post-idle sweep must not read that as a stall
+            self._last_sweep = time.monotonic()
             if self._policy.schedule == "priority":
                 self._exec.add_op(op, ticket.priority)
             else:
@@ -642,10 +667,12 @@ class ServeEngine:
                     self._cond.wait()
                 if self._closed and not self._exec.ops:
                     return
+                self._last_sweep = time.monotonic()  # awake, sweeping
             # one fair-share / weighted sweep over every live query:
             # each op advances one step (or `priority` steps), so
             # requests interleave at step granularity
             self._exec.progress()
+            self._last_sweep = time.monotonic()
             with self._cond:
                 for op in [o for o in self._exec.ops if o.done()]:
                     self._exec.remove_op(op)
@@ -682,6 +709,14 @@ class ServeEngine:
             # failures (SLO expiries, resource exhaustion) trips it
             # and new admissions shed while this in-flight set drains
             self._admission.breaker.record_failure(type(error).__name__)
+        # SLO accounting (ISSUE 14): every retirement is a good/bad
+        # event against the tenant's objectives — burn-rate gauges
+        # serve.slo_burn{tenant,window} refresh here (no-op when the
+        # policy sets no slo_target)
+        self._slo.record(t.tenant, ok=error is None, latency_s=wall)
+        _events.emit("retire", tenant=t.tenant, rid=t.rid,
+                     state=t.state, wall_s=round(wall, 6),
+                     error=type(error).__name__ if error else None)
         if self._journal is not None:
             try:
                 self._journal.done(rid=t.rid,
@@ -745,6 +780,48 @@ class ServeEngine:
                 "steps": op._step,
             })
         return out
+
+    @property
+    def uptime_s(self) -> float:
+        return time.monotonic() - self._started
+
+    def last_step_age(self) -> "float | None":
+        """Seconds since the scheduler last showed liveness (admission,
+        loop wake, op step, or sweep completion) — None before any.
+        With live requests pending, a large age means the scheduler is
+        wedged inside one step, the signal ``/health`` turns into a
+        ``scheduler_stalled`` verdict."""
+        last = self._last_sweep
+        return None if last is None else time.monotonic() - last
+
+    def slo_report(self) -> dict:
+        """Fresh per-tenant burn rates plus the worst offender —
+        ``{"enabled", "objective", "latency_s", "tenants":
+        {tenant: {"60s": burn, ...}}, "worst": {...} | None}``.
+        Windows key by the ``serve.slo_burn`` gauge's window label."""
+        from cylon_tpu.serve.slo import _wlabel
+
+        rates = {
+            t: {_wlabel(w): (round(b, 4) if b is not None else None)
+                for w, b in burns.items()}
+            for t, burns in self._slo.burn_rates().items()}
+        worst = self._slo.worst()
+        return {
+            "enabled": self._slo.enabled,
+            "objective": self._slo.objective,
+            "latency_s": self._slo.latency_s,
+            "tenants": rates,
+            "worst": (None if worst is None else {
+                "tenant": worst[0], "window": _wlabel(worst[1]),
+                "burn": round(worst[2], 4)}),
+        }
+
+    def health(self) -> dict:
+        """The router-grade composite verdict (``/health``):
+        ``{"status": ok|degraded|unhealthy, "score", "reasons": [...],
+        "components": {...}}`` — see
+        :func:`cylon_tpu.serve.introspect.health_verdict`."""
+        return introspect.health_verdict(self)
 
     def tenant_stats(self) -> "dict[str, dict]":
         """Per-tenant serving report: requests/completed/errors/

@@ -36,7 +36,7 @@ straggler attribution (``trace.critical_path``). Same
 no-overhead-when-off contract. See ``docs/observability.md``.
 """
 
-from cylon_tpu.telemetry import memory, profile, trace
+from cylon_tpu.telemetry import events, memory, profile, timeseries, trace
 from cylon_tpu.telemetry.aggregate import (gather_metrics,
                                            gather_traces,
                                            merge_snapshots)
@@ -72,5 +72,5 @@ __all__ = [
     "ICI_LINK_BYTES_PER_SEC", "fraction_of_peak", "trace",
     "to_chrome_trace", "chrome_trace_json", "write_chrome_trace",
     "tenant_scope", "current_tenant", "tenant_labels",
-    "merge_histograms", "memory", "profile",
+    "merge_histograms", "memory", "profile", "events", "timeseries",
 ]

@@ -404,9 +404,19 @@ def arm_exporters(reg) -> None:
         interval = 0.0
     if interval > 0:
         def _loop():
+            from cylon_tpu.telemetry import timeseries
+
             while True:
                 time.sleep(interval)
                 write_snapshot(reg.snapshot(), reason="interval")
+                try:
+                    # the interval daemon doubles as the windowed-
+                    # history cadence (ISSUE 14): one delta sample per
+                    # flush, so /metrics/window and rate() have data
+                    # even when nothing polls the endpoints
+                    timeseries.sample()
+                except Exception:  # pragma: no cover - never kill it
+                    pass
 
         threading.Thread(target=_loop, name="cylon-tpu-metrics",
                          daemon=True).start()

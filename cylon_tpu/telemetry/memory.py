@@ -367,6 +367,9 @@ def forensics(point: str):
     except BaseException as e:
         if is_oom(e):
             _r.counter("memory.oom_events", point=point).inc()
+            from cylon_tpu.telemetry import events as _events
+
+            _events.emit("oom", point=point, error=type(e).__name__)
             if getattr(e, "oom_report", None) is None:
                 try:
                     rep = oom_report()

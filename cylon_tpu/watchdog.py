@@ -449,6 +449,10 @@ class _Monitor:
                                 section=rec.section, detail=rec.detail,
                                 elapsed=rec.dump_after,
                                 budget=rec.budget)
+        telemetry.events.emit("watchdog_expired", section=rec.section,
+                              detail=rec.detail,
+                              elapsed_s=rec.dump_after,
+                              budget_s=rec.budget)
         pol = default_deadline_policy()
         header = (
             f"cylon_tpu watchdog: section {rec.section!r}"

@@ -1,0 +1,250 @@
+"""The router-grade health plane (ISSUE 14 tentpole piece 4):
+/health's composite verdict, the /healthz breaker/shed fix, windowed
+serve metrics, and the whole-plane unarmed-process pin."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from cylon_tpu import catalog, telemetry
+from cylon_tpu.errors import ResourceExhausted
+from cylon_tpu.serve import ServeEngine, ServePolicy
+from cylon_tpu.telemetry import events, timeseries
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    catalog.clear()
+    telemetry.reset("serve.")
+    timeseries.reset()
+    events.clear()
+    yield
+    catalog.clear()
+    telemetry.reset("serve.")
+    timeseries.reset()
+    events.clear()
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=10) as r:
+        assert r.status == 200
+        return json.loads(r.read())
+
+
+def test_healthy_engine_verdict_shape():
+    eng = ServeEngine(policy=ServePolicy(max_queue=4))
+    assert eng.submit(lambda: 1, tenant="a").result(30) == 1
+    h = eng.health()
+    eng.close()
+    assert h["status"] == "ok" and h["score"] == 1.0
+    assert h["reasons"] == []
+    for comp in ("queue", "breaker", "slo", "memory", "watchdog",
+                 "scheduler"):
+        assert comp in h["components"], comp
+    assert h["components"]["breaker"]["state"] == "closed"
+    assert h["components"]["queue"]["cap"] == 4
+    json.loads(json.dumps(telemetry.json_safe(h), allow_nan=False))
+
+
+def test_healthz_reports_breaker_and_shed(monkeypatch):
+    """The ISSUE 14 satellite: the cheap liveness probe carries the
+    breaker's observable state + shed counts, so it can never
+    silently disagree with /health."""
+    monkeypatch.setenv("CYLON_TPU_SERVE_HTTP_PORT", "0")
+    eng = ServeEngine(policy=ServePolicy(max_queue=1))
+    base = "http://%s:%d" % eng.http_address
+    h = _get_json(base + "/healthz")
+    assert h["status"] == "ok"
+    assert h["breaker"]["state"] == "closed"
+    assert h["breaker"]["cooldown_remaining_s"] == 0.0
+    assert h["shed"] == 0 and h["rejected"] == 0
+    # overflow the 1-slot queue -> the shed shows up in /healthz
+    gate = threading.Event()
+
+    def gated():
+        while not gate.is_set():
+            yield
+            time.sleep(0.001)
+        return 1
+
+    tk = eng.submit(gated, tenant="a")
+    with pytest.raises(ResourceExhausted):
+        eng.submit(lambda: 2, tenant="b")
+    h = _get_json(base + "/healthz")
+    assert h["shed"] == 1 and h["rejected"] == 1
+    gate.set()
+    assert tk.result(30) == 1
+    eng.close()
+
+
+def test_fault_storm_health_flips_and_recovers(monkeypatch):
+    """THE acceptance scenario: one tenant's deadline storm drives
+    /health ok -> unhealthy with reasons naming BOTH the breaker and
+    the burning tenant's SLO; the shed/breaker events replay in order
+    from /events?since=; after cooldown + the burn window aging out,
+    /health recovers."""
+    monkeypatch.setenv("CYLON_TPU_EVENTS", "1")
+    monkeypatch.setenv("CYLON_TPU_SERVE_HTTP_PORT", "0")
+    pol = ServePolicy(max_queue=8, breaker_fails=3,
+                      breaker_window=30.0, breaker_cooldown=0.4,
+                      slo_target=0.9, slo_windows=(1.5, 3.0),
+                      burn_critical=5.0)
+    eng = ServeEngine(policy=pol)
+    base = "http://%s:%d" % eng.http_address
+    assert _get_json(base + "/health")["status"] == "ok"
+    cursor0 = events.since(0)["cursor"]
+
+    def slow():
+        time.sleep(0.15)
+        return 1
+
+    tickets = [eng.submit(slow, tenant="noisy", slo=0.01)
+               for _ in range(5)]
+    failed = 0
+    for tk in tickets:
+        try:
+            tk.result(30)
+        except Exception:
+            failed += 1
+    # the first request can complete late-but-done (it was RUNNING
+    # when its budget blew; the completed retirement stands) — the
+    # QUEUED ones expire, and >= breaker_fails of them must, to trip
+    assert failed >= pol.breaker_fails, failed
+    h = _get_json(base + "/health")
+    assert h["status"] == "unhealthy", h
+    blob = " ".join(h["reasons"])
+    assert "breaker_open" in blob
+    assert "slo_burn" in blob and "noisy" in blob
+    # open breaker sheds the front door (and the shed is journaled)
+    with pytest.raises(ResourceExhausted):
+        eng.submit(lambda: 1, tenant="quiet")
+    # the storm replays IN ORDER from the cursor
+    rep = events.since(cursor0)
+    kinds = [e["kind"] for e in rep["events"]]
+    assert "breaker_open" in kinds
+    assert kinds.count("shed") >= 1
+    shed = next(e for e in rep["events"] if e["kind"] == "shed")
+    assert shed["reason"] == "breaker"
+    seqs = [e["seq"] for e in rep["events"]]
+    assert seqs == sorted(seqs)
+    assert kinds.index("retire") < kinds.index("breaker_open") <= \
+        kinds.index("shed")
+    # recovery: cooldown passes, good traffic probes through, the
+    # burn windows age out -> ok again
+    deadline = time.monotonic() + 30
+    status = None
+    while time.monotonic() < deadline:
+        try:
+            eng.submit(lambda: 1, tenant="noisy",
+                       slo=30.0).result(30)
+        except ResourceExhausted:
+            pass
+        status = _get_json(base + "/health")["status"]
+        if status == "ok":
+            break
+        time.sleep(0.2)
+    assert status == "ok", _get_json(base + "/health")
+    assert "breaker_close" in [e["kind"] for e in
+                               events.since(cursor0)["events"]]
+    eng.close()
+
+
+def test_scheduler_stall_turns_unhealthy():
+    eng = ServeEngine(policy=ServePolicy(max_queue=4))
+    gate = threading.Event()
+
+    def gated():
+        while not gate.is_set():
+            yield
+            time.sleep(0.001)
+        return 1
+
+    tk = eng.submit(gated, tenant="a")
+    # fake a wedged scheduler: live work + a stale last sweep
+    eng.last_step_age = lambda: 99.0
+    h = eng.health()
+    assert h["status"] == "unhealthy"
+    assert any("scheduler_stalled" in r for r in h["reasons"])
+    del eng.last_step_age
+    gate.set()
+    assert tk.result(30) == 1
+    assert eng.health()["status"] == "ok"
+    eng.close()
+
+
+def test_metrics_window_endpoint_serves_windowed_view(monkeypatch):
+    monkeypatch.setenv("CYLON_TPU_SERVE_HTTP_PORT", "0")
+    eng = ServeEngine(policy=ServePolicy(max_queue=4))
+    base = "http://%s:%d" % eng.http_address
+    _get_json(base + "/metrics/window")  # baseline sample
+    for _ in range(3):
+        eng.submit(lambda: 1, tenant="w").result(30)
+    timeseries.sample(force=True)
+    view = _get_json(base + "/metrics/window")
+    done = [e for e in view["series"].values()
+            if e["name"] == "serve.completed"]
+    assert done and sum(e["value"] for e in done) == 3
+    # windowed p99 of the request histogram exists and is one pow2
+    # bucket of the true latency
+    q = timeseries.history().quantile("serve.request_seconds", 0.99)
+    assert q is not None and q > 0
+    # malformed window -> 400, not a dead thread
+    import urllib.error
+
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get_json(base + "/metrics/window?window=nope")
+    assert ei.value.code == 400
+    assert _get_json(base + "/healthz")["status"] == "ok"
+    eng.close()
+
+
+def test_windowed_p99_within_one_bucket_of_exact():
+    """The serve-record pin's correctness half: the sliding-window p99
+    sits within one pow2 bucket of the exact per-request quantile."""
+    import numpy as np
+
+    timeseries.sample(force=True)
+    eng = ServeEngine(policy=ServePolicy(max_queue=8))
+    walls = []
+    for i in range(12):
+        tk = eng.submit(lambda: 1, tenant="p")
+        tk.result(30)
+        walls.append(tk.finished - tk.submitted)
+    eng.close()
+    timeseries.sample(force=True)
+    got = timeseries.history().quantile("serve.request_seconds", 0.99,
+                                        tenant="p")
+    exact = float(np.quantile(np.asarray(walls), 0.99))
+    assert got is not None
+    # one bucket = a factor of two on the pow2 ladder: the windowed
+    # p99 is the pow2 upper bound of the bucket holding the largest
+    # wall, so it brackets the exact quantile from above within 2x of
+    # the true maximum (deterministic — no interpolation assumptions)
+    assert exact <= got <= 2 * max(walls), (got, exact, max(walls))
+
+
+def test_unarmed_process_zero_plane(monkeypatch):
+    """THE unarmed pin: with none of the new knobs set, a full
+    submit/retire cycle arms NOTHING in the windowed/event plane —
+    no history ring, no event journal, no sockets, no new threads."""
+    for var in ("CYLON_TPU_EVENTS", "CYLON_TPU_SERVE_HTTP_PORT",
+                "CYLON_TPU_METRICS_DIR", "CYLON_TPU_METRICS_INTERVAL",
+                "CYLON_TPU_SERVE_SLO_TARGET"):
+        monkeypatch.delenv(var, raising=False)
+    events.clear()
+    timeseries.reset()
+    before = set(threading.enumerate())
+    eng = ServeEngine(policy=ServePolicy(max_queue=2))
+    assert eng.submit(lambda: 5, tenant="a").result(30) == 5
+    eng.close()
+    assert timeseries._HISTORY is None  # no ring
+    assert events._JOURNAL is None      # no journal
+    assert eng._http is None            # no socket
+    # the SLO tracker allocated no windows (no objective)
+    assert eng._slo._tenants == {}
+    after = set(threading.enumerate())
+    new = {t for t in after - before if t.is_alive()}
+    assert not new, f"unarmed engine leaked threads: {new}"

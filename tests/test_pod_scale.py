@@ -54,6 +54,25 @@ def _tuple_components(rng, n, dtypes, dup_frac=0.5):
     return comps
 
 
+def _worst_intermediate(jx):
+    """(elements, shape) of the largest intermediate any equation in
+    the (recursively walked) jaxpr produces — the static flat-memory
+    probe both W-audit tests share."""
+
+    def _sizes(jx):
+        for eqn in jx.eqns:
+            for v in eqn.outvars:
+                aval = getattr(v, "aval", None)
+                if aval is not None and hasattr(aval, "shape"):
+                    yield int(np.prod(aval.shape, dtype=np.int64)), \
+                        aval.shape
+            for sub in eqn.params.values():
+                if hasattr(sub, "jaxpr"):
+                    yield from _sizes(sub.jaxpr)
+
+    return max(_sizes(jx), default=(0, ()))
+
+
 @pytest.mark.parametrize("w", [2, 8, 32, 64])
 def test_splitter_searchsorted_matches_dense_reference(w):
     import jax.numpy as jnp
@@ -144,22 +163,56 @@ def test_splitter_assignment_flat_memory_at_w32():
                        .astype(np.uint64))]
     rows = [jnp.asarray(rng.integers(0, 100, n).astype(np.uint64))]
     jaxpr = jax.make_jaxpr(_splitter_searchsorted)(sps, rows)
-
-    def _sizes(jx):
-        for eqn in jx.eqns:
-            for v in eqn.outvars:
-                aval = getattr(v, "aval", None)
-                if aval is not None and hasattr(aval, "shape"):
-                    yield int(np.prod(aval.shape, dtype=np.int64)), \
-                        aval.shape
-            for sub in eqn.params.values():
-                if hasattr(sub, "jaxpr"):
-                    yield from _sizes(sub.jaxpr)
-
-    worst = max(_sizes(jaxpr.jaxpr), default=(0, ()))
+    worst = _worst_intermediate(jaxpr.jaxpr)
     assert worst[0] <= 2 * n, (
         f"splitter assignment materialises a {worst[1]} intermediate "
         f"({worst[0]} elements) — per-op memory is not flat in W")
+
+
+def test_dist_groupby_precombine_flat_memory_at_w32():
+    """ROADMAP item 3 audit starter (ISSUE 14 satellite): trace
+    ``dist_groupby``'s per-shard probe/pre-combine path — the local
+    pre-combine ``groupby_aggregate`` over the decomposable plan plus
+    the ``partition_ids`` hash routing — at W=32 and assert NO
+    intermediate scales with W x rows (same proof style as the
+    ``_splitter_searchsorted`` test). The hash router is ``hash % W``
+    (flat by construction) and the pre-combine is W-independent, so
+    the only W-scaled state left in the op is the shuffle's (W, cap)
+    receive buffer itself — which is the *data*, not a transient
+    (ROADMAP item 3 note records the remaining audit surface)."""
+    import jax
+
+    from cylon_tpu import Table
+    from cylon_tpu.ops.groupby import groupby_aggregate
+    from cylon_tpu.ops.hash import partition_ids
+    from cylon_tpu.parallel.dist_ops import _combine_plan, _key_data
+
+    w, n = 32, 4096
+    rng = np.random.default_rng(5)
+    t = Table.from_pydict({
+        "g": rng.integers(0, 64, n).astype(np.int64),
+        "v": rng.normal(size=n),
+        "u": rng.integers(0, 1000, n).astype(np.int64),
+    })
+    aggs = [("v", "sum", "s"), ("v", "mean", "m"),
+            ("u", "min", "mn"), ("u", "count", "c")]
+    pre, final, post = _combine_plan(aggs)
+
+    def probe(tab):
+        part = groupby_aggregate(tab, ["g"], pre)
+        keys, vals = _key_data(part, ["g"])
+        return partition_ids(keys, w, vals)
+
+    jaxpr = jax.make_jaxpr(probe)(t)
+    worst = _worst_intermediate(jaxpr.jaxpr)
+    # flat in W: the generous 8n bound admits the pre-combine's
+    # per-agg sort/scan transients but would catch even a (2, n)
+    # W-shaped matrix creeping back in (the dense splitter shape was
+    # (W-1, n) — here that would be 31n)
+    assert worst[0] <= 8 * n, (
+        f"dist_groupby pre-combine path materialises a {worst[1]} "
+        f"intermediate ({worst[0]} elements) — per-op memory is not "
+        "flat in W; record it in ROADMAP item 3")
 
 
 _W32_SCRIPT = '''
