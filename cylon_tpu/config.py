@@ -114,6 +114,7 @@ DEADLINE_SECTIONS: "dict[str, float | None]" = {
     "ooc_prefetch": None,    # one pipelined-ingest unit (cylon_tpu.pipeline)
     "exchange": None,        # shuffle/repartition/dist_join dispatch
     "serve_request": None,   # one serve-layer query step (cylon_tpu.serve)
+    "router_poll": None,     # one fleet-router health/events poll
 }
 
 

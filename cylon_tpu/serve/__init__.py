@@ -20,11 +20,18 @@ workload against it. See ``docs/serving.md``.
 from cylon_tpu.serve.admission import (AdmissionController,
                                        CircuitBreaker, ServePolicy,
                                        default_policy)
-from cylon_tpu.serve.durability import CatalogSnapshot, RequestJournal
+from cylon_tpu.serve.durability import (CatalogSnapshot, JournalLock,
+                                        RequestJournal, fence_journal)
+from cylon_tpu.serve.fleet import (EngineGateway, FleetLayout,
+                                   FleetRouter, HttpEngineClient,
+                                   LocalEngineClient, RouterTicket)
 from cylon_tpu.serve.introspect import IntrospectServer
 from cylon_tpu.serve.service import QueryTicket, ServeEngine
 from cylon_tpu.serve.session import Session
 
 __all__ = ["ServeEngine", "QueryTicket", "Session", "ServePolicy",
            "AdmissionController", "CircuitBreaker", "RequestJournal",
-           "CatalogSnapshot", "default_policy", "IntrospectServer"]
+           "CatalogSnapshot", "default_policy", "IntrospectServer",
+           "JournalLock", "fence_journal", "FleetLayout",
+           "FleetRouter", "RouterTicket", "EngineGateway",
+           "HttpEngineClient", "LocalEngineClient"]

@@ -59,6 +59,19 @@ REQUIRED_SERVE_FIELDS = frozenset({
     "windowed_p99_s", "slo_burn",
 })
 
+#: fleet-record fields (ISSUE 15): the ``--fleet`` acceptance is only
+#: auditable if every record pins the engine count, the failover and
+#: replay counters, the lost-ack and double-execution audits (both
+#: MUST be 0) and the p99 before/during/after the mid-run kill.
+#: ``tests/test_bench_guard.py`` pins the set; main() asserts it
+#: before emitting.
+REQUIRED_FLEET_FIELDS = frozenset({
+    "metric", "engines", "clients", "requests_total", "completed",
+    "failovers", "replayed", "lost_acks", "routed", "deduped",
+    "retry_deduped", "double_executions", "oracle_mismatches",
+    "errors", "p99_before_s", "p99_during_s", "p99_after_s",
+})
+
 #: default mixed workload: groupby-heavy scan, 3-way join + top-k,
 #: 6-way join, and a scalar aggregate — four distinct shapes so the
 #: schedule interleaves genuinely different pipelines
@@ -478,7 +491,41 @@ def main(argv=None):
                         "requests on one tenant and record the "
                         "/health ok->unhealthy->ok transitions + "
                         "/events replay (the ISSUE 14 acceptance)")
+    p.add_argument("--fleet", action="store_true",
+                   help="replicated-fleet mode (ISSUE 15): spawn "
+                        "--engines engine PROCESSES over one durable "
+                        "tree, route the mix through a FleetRouter, "
+                        "SIGKILL one engine mid-run and prove 0 lost "
+                        "acks / 0 double-executions across the "
+                        "failover")
+    p.add_argument("--engines", type=int, default=2,
+                   help="engine process count for --fleet (>= 2)")
+    p.add_argument("--no-kill", action="store_true",
+                   help="--fleet without the mid-run kill (baseline)")
     args = p.parse_args(argv)
+
+    if args.fleet:
+        from cylon_tpu.serve.fleet import run_fleet_bench
+
+        record = run_fleet_bench(
+            clients=args.clients,
+            requests=max(args.requests, 2), sf=args.sf,
+            seed=args.seed, engines=args.engines,
+            mix=tuple(q.strip() for q in args.mix.split(",")
+                      if q.strip()),
+            kill_mid_run=not args.no_kill)
+        missing = REQUIRED_FLEET_FIELDS - record.keys()
+        assert not missing, f"fleet record dropped fields {missing}"
+        _emit_record(record)
+        # the acceptance gate: an acknowledged request lost, a double
+        # execution, an oracle mismatch, or (with the kill armed) a
+        # run that never failed over is a FAILED bench
+        if record["lost_acks"] or record["double_executions"] \
+                or record["oracle_mismatches"] or record["errors"]:
+            return 1
+        if not args.no_kill and record["failovers"] < 1:
+            return 1
+        return 0
 
     if args.storm:
         # the storm acceptance wants the full plane armed: the event

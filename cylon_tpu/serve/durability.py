@@ -24,6 +24,21 @@ so ``ServeEngine.recover(dir)`` can rebuild both after a hard kill:
   restore as local tables — re-scatter against the recovered mesh if
   the deployment shards them).
 
+* :class:`JournalLock` — the multi-engine fence (ISSUE 15). A fleet
+  shares one durable dir tree, so a second live engine pointed at an
+  OWNED journal must fail loudly instead of silently interleaving
+  journal lines with the owner. Each journal carries an exclusive
+  owner lockfile (``journal.lock``, created ``O_EXCL``) recording the
+  owner's pid/host plus a random fencing token; every append
+  re-verifies the token on disk, so :func:`fence_journal` (the
+  router's "you are dead to me" write) makes a zombie owner's next
+  append raise :class:`~cylon_tpu.errors.FailedPrecondition` instead
+  of corrupting the stream. Stale locks — dead pid on this host, a
+  fence marker, or a heartbeat mtime older than
+  ``CYLON_TPU_FLEET_LOCK_TTL`` (0 disables the TTL rule) — are broken
+  automatically on acquire, which is exactly what
+  ``ServeEngine.recover`` needs to adopt a killed engine's journal.
+
 Crash-window contract (shared with :class:`CheckpointedRun`): every
 manifest write is tmp + fsync + ``os.replace``; journal lines are
 flushed + fsynced per record, and a torn trailing line (the kill landed
@@ -32,12 +47,194 @@ mid-append) is skipped on replay, never fatal.
 
 import json
 import os
+import socket
 import threading
+import time
+import uuid
 
+from cylon_tpu.errors import FailedPrecondition
 from cylon_tpu.resilience import SpillStore, atomic_write_json
 from cylon_tpu.utils.logging import get_logger
 
-__all__ = ["RequestJournal", "CatalogSnapshot"]
+__all__ = ["RequestJournal", "CatalogSnapshot", "JournalLock",
+           "fence_journal"]
+
+
+class JournalLock:
+    """Exclusive owner lockfile for one request journal.
+
+    The file holds ``{"pid", "host", "owner", "token", "acquired"}``;
+    the in-memory ``token`` is the owner's proof of possession. Three
+    operations matter:
+
+    * :meth:`acquire` — ``O_EXCL`` create; an existing lock is broken
+      IFF :meth:`_stale` says so (owner pid dead on this host, a
+      ``fenced`` marker, or mtime heartbeat older than the TTL),
+      otherwise :class:`~cylon_tpu.errors.FailedPrecondition` names the
+      live owner. A broken-and-reacquired lock gets a FRESH token, so
+      the previous owner is fenced as a side effect.
+    * :meth:`verify` — called under the journal mutex before every
+      append: the on-disk token must still be ours. A mismatch means
+      somebody fenced us (or adopted the journal); the append raises
+      instead of interleaving with the new owner.
+    * :meth:`heartbeat` — ``os.utime`` after every append, the
+      liveness signal the TTL rule reads (a wedged-but-alive engine
+      eventually reads stale once the deployment sets the TTL).
+    """
+
+    FILE = "journal.lock"
+
+    def __init__(self, root: str):
+        self.root = str(root)
+        self.path = os.path.join(self.root, self.FILE)
+        self.token: "str | None" = None
+
+    # ------------------------------------------------------- internals
+    def _read(self) -> "dict | None":
+        try:
+            with open(self.path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    @staticmethod
+    def _ttl() -> float:
+        try:
+            return float(os.environ.get("CYLON_TPU_FLEET_LOCK_TTL",
+                                        "0") or 0)
+        except ValueError:
+            return 0.0
+
+    def _stale(self, cur: "dict | None") -> bool:
+        """May this lock be broken? Unreadable/torn locks and fence
+        markers are always breakable (a fence only needs to stop the
+        OLD token holder — any new owner may take over). On the
+        owner's own host, pid liveness is AUTHORITATIVE: a dead pid is
+        stale, a provably-alive pid is never stale (an idle engine
+        appends nothing, so its heartbeat mtime ages — the TTL must
+        not break a live owner; fencing a wedged-but-alive engine is
+        :func:`fence_journal`'s job, a deliberate act). Only when the
+        pid is uncheckable (different host — shared storage) does the
+        armed-TTL heartbeat rule decide."""
+        if cur is None or cur.get("fenced"):
+            return True
+        pid = cur.get("pid")
+        if cur.get("host") == socket.gethostname() \
+                and isinstance(pid, int):
+            try:
+                os.kill(pid, 0)
+            except ProcessLookupError:
+                return True
+            except PermissionError:
+                return False  # alive, different user
+            return False  # alive: liveness beats any heartbeat age
+        ttl = self._ttl()
+        if ttl > 0:
+            try:
+                age = time.time() - os.stat(self.path).st_mtime
+            except OSError:
+                return True
+            if age > ttl:
+                return True
+        return False
+
+    # ------------------------------------------------------ operations
+    def acquire(self, owner: str = "engine") -> "JournalLock":
+        os.makedirs(self.root, exist_ok=True)
+        payload = {"pid": os.getpid(), "host": socket.gethostname(),
+                   "owner": str(owner),
+                   "token": uuid.uuid4().hex,
+                   "acquired": time.time()}
+        for _ in range(8):  # bounded retry around break/acquire races
+            try:
+                fd = os.open(self.path,
+                             os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            except FileExistsError:
+                cur = self._read()
+                if not self._stale(cur):
+                    cur = cur or {}
+                    raise FailedPrecondition(
+                        f"journal {self.root!r} is owned by a live "
+                        f"engine (pid {cur.get('pid')} on "
+                        f"{cur.get('host')!r}, owner "
+                        f"{cur.get('owner')!r}) — a second engine must "
+                        "never append to an owned journal; point it at "
+                        "its own durable dir, or fence/stop the owner "
+                        "first. NOTE: pid liveness is only checkable "
+                        "on the owner's host — for cross-host "
+                        "deployments (shared storage) arm "
+                        "CYLON_TPU_FLEET_LOCK_TTL so a crashed "
+                        "remote owner's heartbeat expires, or "
+                        "fence_journal()/unlink the lock once the "
+                        "owner is provably gone")
+                get_logger().warning(
+                    "breaking stale journal lock %s (owner %r)",
+                    self.path, (cur or {}).get("owner"))
+                try:
+                    os.unlink(self.path)
+                except FileNotFoundError:
+                    pass
+                continue
+            with os.fdopen(fd, "w") as f:
+                json.dump(payload, f)
+                f.flush()
+                os.fsync(f.fileno())
+            self.token = payload["token"]
+            return self
+        raise FailedPrecondition(
+            f"could not acquire journal lock {self.path!r}: lost the "
+            "break/acquire race repeatedly")
+
+    def verify(self) -> None:
+        """Raise :class:`~cylon_tpu.errors.FailedPrecondition` unless
+        the on-disk lock still carries OUR token — i.e. we were fenced
+        (or the lock was broken and re-acquired) since the last
+        append."""
+        cur = self._read()
+        if cur is None or cur.get("token") != self.token:
+            raise FailedPrecondition(
+                f"journal {self.root!r} has been FENCED (lock token "
+                f"changed; current owner: "
+                f"{(cur or {}).get('owner')!r}) — this engine no "
+                "longer owns its journal and must not append; a "
+                "router declared it dead and failed its requests over")
+
+    def heartbeat(self) -> None:
+        try:
+            os.utime(self.path, None)
+        except OSError:  # pragma: no cover - heartbeat best-effort
+            pass
+
+    def release(self) -> None:
+        """Unlink the lock IFF it is still ours (never steal a
+        successor's lock — release after a fence is a no-op)."""
+        if self.token is None:
+            return
+        cur = self._read()
+        if cur is not None and cur.get("token") == self.token:
+            try:
+                os.unlink(self.path)
+            except OSError:  # pragma: no cover - release best-effort
+                pass
+        self.token = None
+
+
+def fence_journal(root: str, owner: str = "router") -> None:
+    """FENCE a journal: atomically install a fresh lock token so the
+    current owner's next :meth:`JournalLock.verify` fails. This is the
+    router's failover barrier — written AFTER an engine is declared
+    dead and BEFORE its journaled-but-incomplete requests replay on a
+    peer, so a zombie engine (alive but unreachable) can never append
+    an ``admit``/``done`` line that races the replay. The fence itself
+    is marked breakable (``fenced: true``): a later
+    ``ServeEngine.recover`` on the same dir adopts the journal
+    normally."""
+    payload = {"pid": os.getpid(), "host": socket.gethostname(),
+               "owner": str(owner), "token": uuid.uuid4().hex,
+               "acquired": time.time(), "fenced": True}
+    os.makedirs(str(root), exist_ok=True)
+    atomic_write_json(os.path.join(str(root), JournalLock.FILE),
+                      payload)
 
 
 class RequestJournal:
@@ -60,19 +257,30 @@ class RequestJournal:
 
     FILE = "journal.jsonl"
 
-    def __init__(self, root: str):
+    def __init__(self, root: str, owner: str = "engine"):
         self.root = str(root)
         os.makedirs(self.root, exist_ok=True)
         self.path = os.path.join(self.root, self.FILE)
         self._mu = threading.Lock()
+        #: exclusive ownership BEFORE the append handle opens: a second
+        #: live engine pointed at this journal fails here (ISSUE 15 —
+        #: two writers would silently interleave admit/done lines);
+        #: stale locks (dead pid, fence marker, expired heartbeat) are
+        #: broken, which is how recover() adopts a killed engine's dir
+        self.lock = JournalLock(self.root).acquire(owner=owner)
         self._f = open(self.path, "a")
 
     def _append(self, entry: dict) -> None:
         line = json.dumps(entry)
         with self._mu:
+            # fencing check rides every append: once a router fenced
+            # this journal (token replaced), appending would race the
+            # failover replay — refuse instead
+            self.lock.verify()
             self._f.write(line + "\n")
             self._f.flush()
             os.fsync(self._f.fileno())
+            self.lock.heartbeat()
 
     def admit(self, *, rid: int, key: "str | None", name: "str | None",
               args=(), kwargs=None, tenant: str = "default",
@@ -168,6 +376,7 @@ class RequestJournal:
                 self._f.close()
             except OSError:  # pragma: no cover - close best-effort
                 pass
+            self.lock.release()
 
 
 class CatalogSnapshot:
@@ -182,16 +391,70 @@ class CatalogSnapshot:
 
     FORMAT = "serve-catalog-v1"
     MAP = "tables.json"
+    INIT_LOCK = ".init.lock"
 
     def __init__(self, root: str):
         self.root = os.path.join(str(root), "catalog")
-        self.store = SpillStore(self.root, fingerprint=self.FORMAT)
+        self.store = self._store_with_init_mutex()
         self._mpath = os.path.join(self.root, self.MAP)
         try:
             with open(self._mpath) as f:
                 self._map = json.load(f)
         except (OSError, ValueError):
             self._map = {"tables": {}, "next": 0}
+
+    def _store_with_init_mutex(self) -> SpillStore:
+        """Open the spill store under a tiny cross-process init mutex.
+
+        A FLEET shares one snapshot store (ISSUE 15): two engine
+        processes constructing it concurrently on a FRESH dir would
+        race SpillStore's first-manifest write against the other's
+        stale-state sweep (which unlinks ``manifest.json.tmp*`` —
+        deleting the peer's in-flight atomic write). The mutex only
+        guards construction; steady-state saves stay lock-free
+        (identical content, atomic per-file replace). A mutex file
+        older than 60s is a crashed initializer and is broken."""
+        os.makedirs(self.root, exist_ok=True)
+        lockpath = os.path.join(self.root, self.INIT_LOCK)
+        deadline = time.monotonic() + 120.0
+        while True:
+            try:
+                fd = os.open(lockpath,
+                             os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            except FileExistsError:
+                if time.monotonic() > deadline:
+                    raise FailedPrecondition(
+                        f"snapshot store {self.root!r} init mutex "
+                        "held past the deadline — wedged "
+                        "initializer?")
+                try:
+                    age = time.time() - os.stat(lockpath).st_mtime
+                except OSError:
+                    age = None  # released/claimed under us: retry
+                if age is not None and age > 60.0:
+                    # crashed initializer: CLAIM the stale mutex by
+                    # atomic rename — exactly one breaker wins the
+                    # replace (the losers' replace raises and they
+                    # just retry the O_EXCL create), so a freshly
+                    # re-created lock can never be unlinked by a
+                    # racing breaker that statted the OLD file
+                    stale = (f"{lockpath}.stale{os.getpid()}_"
+                             f"{threading.get_ident()}")
+                    try:
+                        os.replace(lockpath, stale)
+                        os.unlink(stale)
+                    except OSError:
+                        pass
+                time.sleep(0.05)
+                continue
+            os.close(fd)
+            try:
+                return SpillStore(self.root, fingerprint=self.FORMAT)
+            finally:
+                try:
+                    os.unlink(lockpath)
+                except OSError:  # pragma: no cover - best-effort
+                    pass
 
     def _flush_map(self) -> None:
         atomic_write_json(self._mpath, self._map)

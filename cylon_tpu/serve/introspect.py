@@ -311,6 +311,17 @@ class IntrospectServer:
         path = path.rstrip("/") or "/"
         qs = urllib.parse.parse_qs(query)
         eng = self._engine
+        if path in ("/healthz", "/health") and eng.closing:
+            # a drain in progress: close() is joining the scheduler /
+            # flushing the journal, and the engine's internals are
+            # mid-teardown. Answer the probe CLEANLY (503 = stop
+            # routing here) instead of racing the teardown into a 500
+            # — the router treats "closing" like "unhealthy", which is
+            # the correct drain signal (ISSUE 15 satellite).
+            self._send(h, 503, {"status": "closing",
+                                "live": eng.live,
+                                "uptime_s": eng.uptime_s})
+            return
         if path == "/healthz":
             # the cheap liveness probe carries the breaker's
             # observable state + shed counts, so it can never

@@ -338,7 +338,8 @@ class ServeEngine:
     _ids = itertools.count(1)
 
     def __init__(self, env=None, policy: "ServePolicy | None" = None,
-                 durable_dir: "str | None" = None):
+                 durable_dir: "str | None" = None,
+                 snapshot_dir: "str | None" = None):
         self._env = env
         self._admission = AdmissionController(policy)
         self._policy = self._admission.policy
@@ -371,8 +372,12 @@ class ServeEngine:
             from cylon_tpu.serve.durability import (CatalogSnapshot,
                                                     RequestJournal)
 
+            # the journal acquires this engine's exclusive owner lock
+            # (fleet fencing — a second live engine on the same dir
+            # fails loudly); snapshot_dir lets a fleet share ONE
+            # snapshot store while journals stay per-engine
             self._journal = RequestJournal(durable_dir)
-            self._snapshot = CatalogSnapshot(durable_dir)
+            self._snapshot = CatalogSnapshot(snapshot_dir or durable_dir)
         self.durable_dir = durable_dir
         #: bounded rid -> ticket history (live AND retired): the
         #: lookup surface behind /profiles/<rid> and QueryTicket
@@ -724,6 +729,18 @@ class ServeEngine:
                                    state=t.state)
             except OSError:  # pragma: no cover - journal best-effort
                 pass  # a full disk must not wedge retirement
+            except FailedPrecondition as e:
+                # journal FENCED mid-flight: a router declared this
+                # engine dead and is replaying its journal on a peer.
+                # The retirement still completes locally (the client
+                # holding this ticket gets its answer) but the done
+                # line must NOT race the replay — log loudly instead.
+                from cylon_tpu.utils.logging import get_logger
+
+                get_logger().error(
+                    "request %d retired but its journal is fenced "
+                    "(%s); a fleet router has failed this engine over",
+                    t.rid, e)
         telemetry.timer("serve.request_seconds",
                         tenant=t.tenant).observe(wall)
         _trace.instant("serve.done" if error is None else "serve.error",
@@ -745,6 +762,15 @@ class ServeEngine:
     def live(self) -> int:
         """Live (queued + running) request count."""
         return self._admission.live
+
+    @property
+    def closing(self) -> bool:
+        """True once :meth:`close` has committed to shutting down
+        (``_closed`` published — admission refused, drain under way or
+        done): the public flag the introspection endpoints turn into a
+        clean 503 ``{"status": "closing"}`` instead of racing the
+        teardown (ISSUE 15 satellite)."""
+        return self._closed
 
     @property
     def http_address(self) -> "tuple[str, int] | None":
@@ -863,7 +889,8 @@ class ServeEngine:
     def recover(cls, durable_dir: str, env=None,
                 policy: "ServePolicy | None" = None,
                 queries: "dict | None" = None,
-                replay: bool = True) -> "ServeEngine":
+                replay: bool = True,
+                snapshot_dir: "str | None" = None) -> "ServeEngine":
         """Rebuild a killed durable engine from ``durable_dir``.
 
         1. **Mesh**: ``env=None`` starts a fresh resident
@@ -895,7 +922,8 @@ class ServeEngine:
             import cylon_tpu as ct
 
             env = ct.CylonEnv(ct.TPUConfig())
-        engine = cls(env, policy, durable_dir=durable_dir)
+        engine = cls(env, policy, durable_dir=durable_dir,
+                     snapshot_dir=snapshot_dir)
         for name, fn in (queries or {}).items():
             # a (fn, fallback) pair re-registers the degrade path too,
             # so replayed requests keep their graceful degradation

@@ -75,6 +75,9 @@ EVENT_KINDS: "dict[str, tuple]" = {
     # watchdog
     "watchdog_expired": ("section", "detail", "elapsed_s",
                          "budget_s"),
+    # fleet router (ISSUE 15; engine-less process — no tenant/rid)
+    "failover": ("engine", "reason", "replayed", "lost"),
+    "fence": ("engine", "owner"),
 }
 
 

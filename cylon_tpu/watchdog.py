@@ -105,6 +105,11 @@ SECTIONS: "dict[str, bool]" = {
     # its SLO passed only deepens the pile-up; the retry decision
     # belongs to the client
     "serve_request": False,
+    # one fleet-router poll of one engine's /health + /events cursor
+    # (cylon_tpu.serve.fleet) — retryable: a poll is a read against a
+    # possibly-dying HTTP endpoint, and the router's whole failure
+    # model is "retry, then declare the engine dead"
+    "router_poll": True,
 }
 
 # the retryability registry here and the budget-defaults registry in

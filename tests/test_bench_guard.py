@@ -16,7 +16,8 @@ import py_compile
 import pytest
 
 REPO = pathlib.Path(__file__).resolve().parents[1]
-DRIVERS = ["bench_suite.py", "bench.py", "cylon_tpu/serve/bench.py"]
+DRIVERS = ["bench_suite.py", "bench.py", "cylon_tpu/serve/bench.py",
+           "cylon_tpu/serve/fleet.py"]
 
 _FN = (ast.FunctionDef, ast.AsyncFunctionDef)
 
@@ -683,6 +684,64 @@ def test_serve_record_schema_pins_windowed_columns():
     from cylon_tpu.serve.bench import REQUIRED_SERVE_FIELDS
 
     assert {"windowed_p99_s", "slo_burn"} <= REQUIRED_SERVE_FIELDS
+
+
+# ------------------------------------------------- fleet guards
+def test_fleet_record_schema_pinned():
+    """ISSUE 15 satellite: the --fleet record must keep the engine
+    count, failover/replay counters, the lost-ack and double-execution
+    audits and the p99 before/during/after the kill (main() asserts
+    the set before emitting, so the pin is enforced at bench runtime
+    too)."""
+    from cylon_tpu.serve.bench import REQUIRED_FLEET_FIELDS
+
+    assert {"engines", "failovers", "lost_acks", "replayed",
+            "double_executions", "p99_before_s", "p99_during_s",
+            "p99_after_s"} <= REQUIRED_FLEET_FIELDS
+    src = (REPO / "cylon_tpu" / "serve" / "bench.py").read_text()
+    assert "REQUIRED_FLEET_FIELDS - record.keys()" in src
+
+
+#: ServeEngine/scheduler internals the fleet router must NEVER touch —
+#: the router has to work CROSS-PROCESS, so anything it needs must be
+#: reachable through the public HTTP/engine API; a private-attr
+#: shortcut here would only work in-process and rot silently
+_FLEET_FORBIDDEN = frozenset({
+    "_dispatch", "_exec", "_loop", "_retire", "_admission",
+    "_journal", "_snapshot", "_idem", "_queries", "_recent",
+    "_slo", "_last_sweep", "_profiler", "_undo_admission",
+    "_journal_admit", "_evict_idem_locked", "_cond", "_closed",
+    "_closing",
+})
+
+
+def test_fleet_router_talks_only_public_engine_api():
+    """ISSUE 15 satellite (CI lint): serve/fleet.py reaches engines
+    only through their public surface (submit_named/ticket/health/
+    closing/close/... or HTTP) — no attribute access to scheduler or
+    journal internals anywhere in the module."""
+    path = REPO / "cylon_tpu" / "serve" / "fleet.py"
+    tree = ast.parse(path.read_text(), filename=str(path))
+    bad = [(n.lineno, n.attr) for n in ast.walk(tree)
+           if isinstance(n, ast.Attribute)
+           and n.attr in _FLEET_FORBIDDEN]
+    assert not bad, (
+        f"fleet.py reaches engine internals {bad} — the router must "
+        "work cross-process through the public HTTP/engine API only")
+
+
+def test_fleet_poll_runs_under_registered_router_poll_section():
+    """The router's poll loop runs under the NAMED router_poll
+    watchdog section, registered (retryable) in both registries."""
+    from cylon_tpu import watchdog
+    from cylon_tpu.config import DEADLINE_SECTIONS
+
+    secs = _watchdog_section_constants(
+        REPO / "cylon_tpu" / "serve" / "fleet.py")
+    assert "router_poll" in secs, (
+        "fleet.py no longer polls under the router_poll section")
+    assert watchdog.SECTIONS.get("router_poll") is True
+    assert "router_poll" in DEADLINE_SECTIONS
 
 
 def test_checker_accepts_closures_and_comprehensions(tmp_path):

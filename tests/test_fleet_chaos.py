@@ -1,0 +1,154 @@
+"""Fleet chaos (ISSUE 15 acceptance, test scale): two REAL engine
+processes over one durable tree, one hard-killed mid-mixed-TPC-H run
+via the rc-43 harness (``FaultRule.kill`` at the ``plan`` injection
+point — the same seeded-kill contract as tests/test_chaos.py), the
+router failing over. Proven:
+
+* the killed child died AT the seeded fault point (rc 43, "injected
+  HARD KILL" in its log) — not some other crash;
+* every acknowledged ticket completes ORACLE-EXACT against the
+  single-query in-process oracles, across the failover (0 lost acks);
+* the dead engine's journaled-but-incomplete requests replayed on the
+  surviving peer exactly once, and an idempotent retry that lands
+  after the failover does not double-execute (cross-journal
+  done-line audit == 0 doubles).
+"""
+
+import time
+
+import pytest
+
+from cylon_tpu import telemetry
+from cylon_tpu.resilience import KILL_EXIT_CODE
+from cylon_tpu.serve.bench import _materialize, _mk_resident, \
+    _results_match
+from cylon_tpu.serve.durability import RequestJournal
+from cylon_tpu.serve.fleet import (FleetLayout, FleetRouter,
+                                   _affinity_order,
+                                   audit_double_executions,
+                                   spawn_engine)
+
+MIX = ("q1", "q6")
+SF, SEED = 0.001, 0
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    telemetry.reset("fleet.")
+    yield
+    telemetry.reset("fleet.")
+
+
+def _oracles():
+    import cylon_tpu as ct
+    from cylon_tpu import tpch
+    from cylon_tpu.tpch import dbgen
+
+    env = ct.CylonEnv(ct.TPUConfig())
+    resident = _mk_resident(env, dbgen.generate(SF, SEED))
+    return {q: _materialize(tpch.compiled(q)(resident, env=env))
+            for q in MIX}
+
+
+def _tenants_for(victim: str, survivor: str, n_each: int):
+    """Deterministic tenants whose affinity ring starts at each
+    engine — so the victim provably serves traffic before it dies."""
+    names = sorted((victim, survivor))
+    out = {victim: [], survivor: []}
+    i = 0
+    while any(len(v) < n_each for v in out.values()):
+        t = f"tenant{i}"
+        first = _affinity_order(t, names)[0]
+        if len(out[first]) < n_each:
+            out[first].append(t)
+        i += 1
+    return out
+
+
+def test_kill_one_engine_mid_tpch_run_loses_nothing(tmp_path):
+    oracles = _oracles()
+    root = str(tmp_path / "fleet")
+    # e0 carries the seeded kill: its SECOND compiled-query dispatch
+    # hard-dies at the `plan` injection point (os._exit 43 — no
+    # cleanup, no lock release, exactly like a preemption)
+    import concurrent.futures as cf
+
+    with cf.ThreadPoolExecutor(2) as ex:
+        f0 = ex.submit(spawn_engine, root, "e0", SF, SEED, MIX,
+                       {"JAX_PLATFORMS": "cpu",
+                        "CHAOS_KILL": "plan:2"})
+        f1 = ex.submit(spawn_engine, root, "e1", SF, SEED, MIX,
+                       {"JAX_PLATFORMS": "cpu"})
+        p0, p1 = f0.result(), f1.result()
+    router = FleetRouter([p0.client, p1.client], poll_interval=0.2,
+                         fail_threshold=3, unhealthy_dwell=2.0)
+    try:
+        tenants = _tenants_for("e0", "e1", 2)
+        tickets = []  # (key, query, ticket)
+        k = 0
+        # interleave: each tenant submits one of each mix query, so
+        # e0 sees >= 2 dispatches (the second one kills it) with
+        # acknowledged work in flight
+        for q in MIX:
+            for t in tenants["e0"] + tenants["e1"]:
+                key = f"key{k}"
+                tickets.append((key, q, router.submit(
+                    q, tenant=t, idempotency_key=key)))
+                k += 1
+        mismatches = []
+        for key, q, tk in tickets:
+            got = tk.result(300)  # must NOT raise: acks are never lost
+            if not _results_match(got, oracles[q]):
+                mismatches.append(key)
+        assert mismatches == [], mismatches
+
+        # the child died AT the seeded kill point — rc 43, logged
+        assert p0.proc.wait(60) == KILL_EXIT_CODE
+        with open(p0.log_path) as f:
+            assert "injected HARD KILL" in f.read()
+
+        rep = router.report()
+        assert telemetry.total("fleet.failovers") == 1
+        assert telemetry.total("fleet.lost_acks") == 0
+        assert telemetry.total("fleet.replayed") >= 1
+        assert rep["failovers"][0]["engine"] == "e0"
+
+        # idempotent retry AFTER the failover: a key that already
+        # completed comes back from the fleet-scoped dedup without a
+        # second execution anywhere
+        key0, q0, tk0 = tickets[0]
+        again = router.submit(q0, tenant=tenants["e0"][0],
+                              idempotency_key=key0)
+        assert again is tk0
+        assert _results_match(again.result(30), oracles[q0])
+        assert telemetry.total("fleet.deduped") >= 1
+
+        # cross-journal exactly-once audit: no key has two
+        # done(state=done) lines the router didn't knowingly replay
+        doubles, detail = audit_double_executions(
+            FleetLayout(root), rep["replayed_keys"])
+        assert doubles == 0, detail
+
+        # the dead engine's journal was fenced before the replay
+        lay = FleetLayout(root)
+        import json as _json
+        import os as _os
+
+        lock = _json.load(open(_os.path.join(
+            lay.engine_dir("e0"), "journal.lock")))
+        assert lock.get("fenced") is True
+        assert lock["owner"].startswith("router:")
+
+        # and every replayed key completed on the SURVIVOR's journal
+        done_e1 = {e.get("key") for e in
+                   RequestJournal.read(lay.engine_dir("e1"))
+                   if e["kind"] == "done"
+                   and e.get("state") == "done"}
+        for rk in rep["replayed_keys"]:
+            assert rk in done_e1, (rk, done_e1)
+    finally:
+        router.close()
+        p1.terminate()
+        if p0.proc.poll() is None:  # pragma: no cover - belt+braces
+            p0.proc.kill()
+        time.sleep(0)  # yield so daemon drains flush

@@ -176,7 +176,8 @@ def test_retryable_classification_per_section():
     assert verdicts == {"barrier": False, "bootstrap": True,
                         "overflow_fetch": False, "spill_io": True,
                         "ooc_pass": False, "ooc_prefetch": False,
-                        "exchange": False, "serve_request": False}
+                        "exchange": False, "serve_request": False,
+                        "router_poll": True}
 
 
 def test_retrying_absorbs_retryable_deadline():
