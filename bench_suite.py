@@ -362,35 +362,77 @@ def _run_tpch(sf, reps, tag_hbm: bool = False, ooc_report=None):
     from cylon_tpu import tpch
     from cylon_tpu.tpch import dbgen
 
-    data = dbgen.generate(sf=sf, seed=0)
     only = os.environ.get("CYLON_BENCH_TPCH_QUERIES")
     valid = {f"q{i}" for i in range(1, 23)}
     only = ({q.strip() for q in only.split(",")} & valid) if only else None
+    keep_by_table = None
     if only and os.environ.get("CYLON_BENCH_TPCH_PRUNE_INGEST",
                                "1") != "0":
-        # query-subset runs ingest only the columns those queries
-        # reference (the storage-scan projection any engine does) —
-        # at SF10 a full lineitem load alone is ~10 GB of HBM.
+        # query-subset runs generate AND ingest only the columns those
+        # queries reference (the storage-scan projection any engine
+        # does) — at SF10 a full lineitem load alone is ~10 GB of HBM,
+        # and at SF100 full generation alone would dwarf host RAM.
         # Keep-sets AND predicate are the SAME explicit manifest +
         # queries.manifest_keep that queries._tables prunes by, so the
         # two layers cannot diverge
         from cylon_tpu.tpch.manifest import MANIFEST
         from cylon_tpu.tpch.queries import manifest_keep
 
-        keep_by_table: dict = {}
+        keep_by_table = {}
         for qn in sorted(only):
             for t, ks in MANIFEST[qn].items():
                 keep_by_table.setdefault(t, set()).update(ks)
+    data = dbgen.generate(sf=sf, seed=0, keep=keep_by_table)
+    if keep_by_table is not None:
+        from cylon_tpu.tpch.manifest import MANIFEST
+        from cylon_tpu.tpch.queries import manifest_keep
+
         # a table NO selected query reads keeps zero columns (ingest
         # builds an empty frame for it; nothing is device_put)
         data = {t: {c: cols[c] for c in manifest_keep(
                         t, cols, keep_by_table.get(t, frozenset()))}
                 for t, cols in data.items()}
+    names = [f"q{i}" for i in range(1, 23)]
+    selected = [q for q in names if only is None or q in only]
+    # EXPLAIN-style pre-flight (the single-chip ceiling, same contract
+    # as fallback.run_query's): with a device budget in force
+    # (CYLON_TPU_HBM_BUDGET_BYTES or real allocator limits), a query
+    # whose manifest-projected input bytes × the transient-expansion
+    # knob exceed free device memory routes STRAIGHT to the out-of-
+    # core completion — no doomed ingest+dispatch. At SF100 this is
+    # load-bearing: the in-core attempt would die on ingest before any
+    # recordable OOM. Plain CPU (no budget) stands down as ever.
+    from cylon_tpu import fallback as _fb
+
+    free = _fb.free_hbm_bytes()
+    preflight: dict = {}
+    if free is not None:
+        from cylon_tpu.tpch.manifest import MANIFEST
+
+        exp = _fb.expansion_factor()
+        for qname in selected:
+            est = 0
+            for t, ks in MANIFEST[qname].items():
+                for c in ks:
+                    arr = data.get(t, {}).get(c)
+                    if arr is None:
+                        continue
+                    a = np.asarray(arr)
+                    # object strings ride as padded device bytes:
+                    # ~64 B/row is the manifest columns' envelope
+                    est += (len(a) * 64 if a.dtype == object
+                            else a.nbytes)
+            est = int(est * exp)
+            if est > free:
+                preflight[qname] = est
     # tables pre-ingested once (the reference's TPC-H timing also runs
     # on loaded tables); tpch.ingest applies the storage policy
     # (comment columns as device bytes — at SF>=1 a host dictionary
-    # for them would be the dataset)
-    dfs = tpch.ingest(data)
+    # for them would be the dataset). When EVERY selected query was
+    # preflight-routed there is nothing to ingest — skip the load
+    # entirely (at SF100 even the pruned ingest is tens of GB)
+    dfs = (tpch.ingest(data)
+           if len(preflight) < len(selected) else None)
     if tag_hbm:
         _hbm_stats(f"tpch_sf{sf}_ingest")
     # eager mode: one compiled program PER OPERATOR instead of per
@@ -402,8 +444,6 @@ def _run_tpch(sf, reps, tag_hbm: bool = False, ooc_report=None):
     attempted: list = []
     crashed: list = []
     scalar_q = ("q6", "q14", "q17", "q19")
-    names = [f"q{i}" for i in range(1, 23)]
-    selected = [q for q in names if only is None or q in only]
 
     def _accounting(pending=()):
         skipped = [q for q in selected if q not in attempted]
@@ -438,6 +478,20 @@ def _run_tpch(sf, reps, tag_hbm: bool = False, ooc_report=None):
             pass  # checkpointing must never fail the run
 
     for qname in selected:
+        if qname in preflight:
+            _emit_record({
+                "metric": f"tpch_{qname}_sf{sf}_preflight_spill",
+                "value": 1, "unit": "routed to ooc fallback",
+                "predicted_bytes": preflight[qname],
+                "free_hbm_bytes": free, "path": "ooc_fallback"})
+            if _fallback_ok(qname):
+                ooc_pending.append(qname)
+            else:  # pragma: no cover - all 22 queries carry a plan
+                _emit(f"tpch_{qname}_sf{sf}_fallback_unsupported", 1,
+                      "no spill decomposition")
+            attempted.append(qname)
+            _checkpoint()
+            continue
         qfn = getattr(tpch, qname) if eager else tpch.compiled(qname)
         res = {}
         try:
@@ -485,14 +539,12 @@ def _run_tpch(sf, reps, tag_hbm: bool = False, ooc_report=None):
             res.clear()
             if _fallback_ok(qname):
                 ooc_pending.append(qname)
-            else:
-                # recorded DNF with the reason, never a silent one:
-                # the manifest names why no spill decomposition exists
-                from cylon_tpu.tpch.manifest import FALLBACK
-
+            else:  # pragma: no cover - all 22 queries carry a plan
+                # recorded DNF, never a silent one (since ISSUE 16's
+                # two-phase plans this arm is unreachable for TPC-H
+                # names; it guards future non-TPC-H query sets)
                 _emit(f"tpch_{qname}_sf{sf}_fallback_unsupported", 1,
-                      FALLBACK.get(qname, {}).get(
-                          "why", "no spill decomposition"))
+                      "no spill decomposition")
         attempted.append(qname)
         _checkpoint()
     # regrow events: CompiledQuery memoizes the scale each (query,
@@ -804,15 +856,16 @@ def scale_main():
         from cylon_tpu.tpch.queries import manifest_keep
 
         pending = report["tpch_ooc"]
-        data = dbgen.generate(sf=sf, seed=0)
-        # prune to the pending queries' manifests, like the child's
-        # ingest — regenerating SF10 unpruned would hold ~10+ GB of
-        # comment strings in host RAM for streaming runs that read
-        # only lineitem's numeric columns + the small build tables
+        # generate AND prune to the pending queries' manifests, like
+        # the child's ingest — regenerating SF10 unpruned would hold
+        # ~10+ GB of comment strings in host RAM for streaming runs
+        # that read only lineitem's numeric columns + the small build
+        # tables (at SF100 unpruned generation would not fit at all)
         keep_by_table: dict = {}
         for qn in sorted(set(pending)):
             for t, ks in MANIFEST[qn].items():
                 keep_by_table.setdefault(t, set()).update(ks)
+        data = dbgen.generate(sf=sf, seed=0, keep=keep_by_table)
         data = {t: {c: cols[c] for c in manifest_keep(
                         t, cols, keep_by_table.get(t, frozenset()))}
                 for t, cols in data.items()}
@@ -820,6 +873,60 @@ def scale_main():
 
     if crashed:
         raise RuntimeError("; ".join(crashed))
+
+
+#: the at-scale race configs (ISSUE 16 / ROADMAP item 1) — the runs
+#: the paper's claim is about, as named legs so the guard tests can
+#: pin them and a driver can re-run any one by name. Each leg is one
+#: ``--scale`` invocation (inheriting scale_main's sentinel +
+#: crash-respawn machinery) with this env overlaid. The HBM budget
+#: pins the v5e single-chip ceiling so in_core-vs-ooc_fallback routing
+#: matches the real chip even on a CPU dev host.
+SCALE_LEGS = (
+    # the full 22-query suite at SF10: per-query wall + path column
+    ("tpch_sf10_full", {"CYLON_BENCH_TPCH_SF": "10",
+                        "CYLON_BENCH_ROWS": "0",
+                        "CYLON_BENCH_TPCH_QUERIES": "",
+                        "CYLON_TPU_HBM_BUDGET_BYTES": "17179869184"}),
+    # the 1B-row inner-join config (BASELINE.json's headline scale)
+    ("join_1b", {"CYLON_BENCH_ROWS": "1000000000",
+                 "CYLON_BENCH_TPCH_SF": "0",
+                 "CYLON_TPU_HBM_BUDGET_BYTES": "17179869184"}),
+    # SF100 Q3/Q5: manifest-pruned generation (full SF100 dbgen would
+    # dwarf host RAM), preflight-routed to the out-of-core paths
+    ("tpch_sf100_q3q5", {"CYLON_BENCH_TPCH_SF": "100",
+                         "CYLON_BENCH_ROWS": "0",
+                         "CYLON_BENCH_TPCH_QUERIES": "q3,q5",
+                         "CYLON_TPU_HBM_BUDGET_BYTES": "17179869184"}),
+)
+
+
+def race_main():
+    """--race: run the :data:`SCALE_LEGS` at-scale configs end to end,
+    one ``--scale`` child per leg (each child gets scale_main's full
+    sentinel / timeout-classification / crash-respawn coverage), with
+    a wall + rc record per leg. CYLON_BENCH_RACE_LEGS="name1,name2"
+    restricts the set. A failed leg is a recorded failure line and the
+    remaining legs still run — the race never silently truncates."""
+    only = os.environ.get("CYLON_BENCH_RACE_LEGS")
+    only = {s.strip() for s in only.split(",")} if only else None
+    failures = []
+    for name, leg_env in SCALE_LEGS:
+        if only is not None and name not in only:
+            continue
+        child_env = dict(os.environ)
+        child_env.update(leg_env)
+        t0 = time.perf_counter()
+        rc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--scale"],
+            env=child_env, check=False).returncode
+        _emit_record({"metric": f"race_{name}_wall",
+                      "value": round(time.perf_counter() - t0, 1),
+                      "unit": "s", "leg": name, "rc": rc})
+        if rc != 0:
+            failures.append(f"{name}: rc={rc}")
+    if failures:
+        raise RuntimeError("race legs failed: " + "; ".join(failures))
 
 
 def scale_incore_main(leg: str):
@@ -1340,6 +1447,8 @@ if __name__ == "__main__":
         leg = next(a for a in sys.argv
                    if a.startswith("--scale-incore=")).split("=", 1)[1]
         scale_incore_main(leg)
+    elif "--race" in sys.argv:
+        race_main()
     elif "--scale" in sys.argv:
         scale_main()
     elif "--tpch" in sys.argv:

@@ -115,6 +115,7 @@ DEADLINE_SECTIONS: "dict[str, float | None]" = {
     "exchange": None,        # shuffle/repartition/dist_join dispatch
     "serve_request": None,   # one serve-layer query step (cylon_tpu.serve)
     "router_poll": None,     # one fleet-router health/events poll
+    "fallback_merge": None,  # two-phase fallback global merge (fallback.py)
 }
 
 

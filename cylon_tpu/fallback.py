@@ -227,11 +227,21 @@ def predict_query_bytes(data: Mapping, query: "str | None" = None) -> int:
 
 def supports(query: str) -> bool:
     """Does ``query`` have a usable (non-``None``-merge) fallback plan
-    in :data:`cylon_tpu.tpch.manifest.FALLBACK`? (The hand-written
-    streaming q1/q5 paths exist independently of this answer.)"""
+    in :data:`cylon_tpu.tpch.manifest.FALLBACK`? Since the two-phase
+    executor landed this is True for all 22 TPC-H queries — False now
+    means "not a TPC-H query name". (The hand-written streaming q1/q5
+    paths exist independently of this answer.)"""
     from cylon_tpu.tpch.manifest import FALLBACK
 
     return FALLBACK.get(query, {}).get("merge") is not None
+
+
+def _known_queries() -> str:
+    """The manifest's query names in numeric order, for fail-fast
+    error messages."""
+    from cylon_tpu.tpch.manifest import FALLBACK
+
+    return ", ".join(sorted(FALLBACK, key=lambda q: int(q[1:])))
 
 
 # --------------------------------------------------------- the executor
@@ -354,6 +364,45 @@ def _decode_partial(cols: dict):
     return pd.DataFrame(cols)
 
 
+def _resume_partial(ckpt, unit: int, op: "str | None" = None):
+    """Replay one completed unit back into its partial (float, frame,
+    or the schema'd EMPTY frame a 0-row frame unit reconstructs from
+    its ``__schema__`` meta — a resumed all-empty run must return the
+    byte-identical frame the first run did). ``op`` relabels the
+    ``ooc.units_resumed`` counter (the merge unit counts under
+    ``op="fallback_merge"``, not the per-query op)."""
+    if op is None:
+        cols = ckpt.resume_unit(unit)
+    else:
+        cols = ckpt.load_unit(unit)
+        telemetry.counter("ooc.units_resumed", op=op).inc()
+        _trace.instant("ckpt.resume", cat="resilience", op=op,
+                       unit=int(unit))
+        telemetry.events.emit("checkpoint_resume", op=op,
+                              unit=int(unit))
+    got = _decode_partial(cols)
+    if got is None:
+        schema = (ckpt.unit_meta(unit) or {}).get("__schema__")
+        if schema:
+            import pandas as pd
+
+            got = pd.DataFrame({c: np.empty(0, np.dtype(d))
+                                for c, d in schema})
+    return got
+
+
+def _partial_schema_meta(partial, meta: dict) -> dict:
+    """Unit meta for a checkpointed partial: the verify-on-resume input
+    sizes plus, for frame partials, the column schema (a 0-row unit
+    writes no spill file; the resume rebuilds the empty frame from
+    this)."""
+    unit_meta = dict(meta)
+    if not isinstance(partial, float):
+        unit_meta["__schema__"] = [[c, str(partial[c].dtype)]
+                                   for c in partial.columns]
+    return unit_meta
+
+
 def _cols_fingerprint(cols: dict) -> str:
     """Content digest of one table's host columns (string columns
     canonicalised to unicode so object-array identity never leaks into
@@ -430,6 +479,128 @@ def _merge_partials(partials: list, spec: dict, limit):
     return df[columns]
 
 
+def _two_phase(query: str, part_tables: dict, bcast: dict,
+               n_partitions: int, resume_dir: "str | None",
+               plan_fp: tuple, params: dict):
+    """The two-phase global-aggregate executor
+    (:mod:`cylon_tpu.tpch.twophase`): phase 1 emits associative
+    partials per partition, a global merge computes the blocking
+    scalar, phase 2 (when the plan has one) re-runs the cheap apply per
+    partition with the scalar broadcast in.
+
+    Unit layout under ``resume_dir``: phase-1 partial ``p`` → unit
+    ``p`` (0..P-1), the merge result → unit ``P`` (journaled as its own
+    unit — a kill between the phases resumes WITHOUT recomputing the
+    merge), phase-2 partial ``p`` → unit ``P+1+p``. The merge runs
+    under the ``fallback_merge`` watchdog section and fires the
+    ``global_merge`` fault-injection point, and its resume counts
+    ``ooc.units_resumed{op="fallback_merge"}`` so a chaos harness can
+    see WHICH side of the phase boundary replayed."""
+    from cylon_tpu import watchdog
+    from cylon_tpu.tpch.twophase import PLANS
+
+    plan = PLANS[query]
+    merge_unit = n_partitions
+    ckpt = None
+    if resume_dir is not None:
+        ckpt = resilience.CheckpointedRun(
+            resume_dir, f"fallback_{query}", ("twophase-v1",) + plan_fp)
+    done_map = ckpt.completed if ckpt is not None else {}
+    telemetry.counter("ooc.fallback_partitions",
+                      op=query).inc(n_partitions)
+    metas = [{t: (len(next(iter(part_tables[t][p].values())))
+                  if part_tables[t][p] else 0) for t in part_tables}
+             for p in range(n_partitions)]
+
+    def _ingest(phase_base):
+        def _one(p):
+            """Prefetch worker: assemble partition p's input mapping
+            (broadcast host tables shared, partitioned slices attached)
+            unless the unit is already durable or the partition is
+            empty."""
+            meta = metas[p]
+            data_p = None
+            if (phase_base + p) not in done_map and any(meta.values()):
+                data_p = dict(bcast)
+                for t in part_tables:
+                    data_p[t] = part_tables[t][p]
+            return data_p
+        return _one
+
+    def _run_phase(label, phase_base, compute):
+        """One per-partition pass: resume durable units, skip empty
+        partitions (0-row unit, no recompute on resume), compute and
+        asynchronously checkpoint the rest. Returns the partition-
+        aligned partial list."""
+        partials = [None] * n_partitions
+        with pipeline.committer(f"fallback.{query}.{label}") as com:
+            for p, data_p in pipeline.prefetch_map(
+                    range(n_partitions), _ingest(phase_base),
+                    op="fallback"):
+                unit, meta = phase_base + p, metas[p]
+                if unit in done_map:
+                    ckpt.verify_meta(
+                        unit, f"tpch_fallback[{query}] {label}", **meta)
+                    partials[p] = _resume_partial(ckpt, unit)
+                    continue
+                if all(v == 0 for v in meta.values()):
+                    if ckpt is not None:
+                        com.submit(lambda unit=unit, meta=meta:
+                                   ckpt.complete(unit, {}, 0, meta=meta))
+                    continue
+                with _span("fallback.partition", cat="stage",
+                           query=query, partition=p, phase=label,
+                           **{f"rows_{t}": n for t, n in meta.items()}):
+                    _memory.sample(op="fallback")
+                    with _span("ooc.compute", cat="stage", op="fallback",
+                               unit=unit):
+                        partial = compute(p, data_p)
+                if ckpt is not None:
+                    cols, rows = _encode_partial(partial)
+                    unit_meta = _partial_schema_meta(partial, meta)
+                    com.submit(lambda unit=unit, cols=cols, rows=rows,
+                               unit_meta=unit_meta: ckpt.complete(
+                                   unit, cols, rows, meta=unit_meta))
+                partials[p] = partial
+                del data_p
+        return partials
+
+    partials1 = _run_phase(
+        "phase1", 0, lambda p, data_p: plan.phase1(data_p, **params))
+
+    if merge_unit in done_map:
+        # the journaled merge replays from the checkpoint — the scalar
+        # is NEVER recomputed from possibly-partial in-memory state
+        merged = _resume_partial(ckpt, merge_unit, op="fallback_merge")
+    else:
+        def _compute_merge():
+            resilience.inject("global_merge", f"fallback.{query}")
+            return plan.merge(partials1, **params)
+
+        with _span("fallback.merge", cat="stage", query=query,
+                   partitions=n_partitions):
+            merged = watchdog.bounded(_compute_merge, "fallback_merge",
+                                      detail=f"fallback.{query}")
+        if ckpt is not None:
+            cols, rows = _encode_partial(merged)
+            # synchronous commit: phase 2 depends on the merge being
+            # durable — a kill during phase 2 must resume the SAME
+            # scalar, not re-derive it
+            ckpt.complete(merge_unit, cols, rows,
+                          meta=_partial_schema_meta(
+                              merged, {"n_partitions": n_partitions}))
+    telemetry.counter("ooc.merge_phases", op=query).inc()
+    telemetry.events.emit("merge_phase", op=query)
+
+    partials2 = None
+    if plan.phase2 is not None:
+        partials2 = _run_phase(
+            "phase2", merge_unit + 1,
+            lambda p, data_p: plan.phase2(data_p, partials1[p], merged,
+                                          **params))
+    return plan.reduce(merged, partials2, **params)
+
+
 def tpch_fallback(query: str, data: Mapping, *, env=None,
                   n_partitions: "int | None" = None,
                   resume_dir: "str | None" = None,
@@ -437,16 +608,18 @@ def tpch_fallback(query: str, data: Mapping, *, env=None,
     """The spill path for one TPC-H query: hash-partition its base
     tables by the manifest's dominant join key, run the EXISTING
     (compiled by default) query per partition, merge the partials
-    (module docstring). Returns the HOST result (pandas frame or
-    float).
+    (module docstring). Queries whose answer embeds a global scalar
+    (``merge == "twophase"``) route to the two-phase executor
+    (:func:`_two_phase`) instead — partial pass, journaled global
+    merge, apply pass. Returns the HOST result (pandas frame or
+    float). All 22 queries have a plan; an unknown query name fails
+    fast with the known-query list.
 
     ``resume_dir`` checkpoints every completed partition through
     :class:`cylon_tpu.resilience.CheckpointedRun` (fingerprint = query
     + partition plan + params; per-partition input sizes re-verified
     on resume), so a hard-killed fallback resumes instead of
-    restarting. Raises :class:`~cylon_tpu.errors.InvalidArgument` for
-    queries whose manifest plan declares no correct decomposition
-    (``FALLBACK[q]["why"]`` names the blocker).
+    restarting.
     """
     from cylon_tpu import tpch
     from cylon_tpu.outofcore import host_partition_chunks
@@ -455,13 +628,8 @@ def tpch_fallback(query: str, data: Mapping, *, env=None,
     spec = FALLBACK.get(query)
     if spec is None:
         raise InvalidArgument(
-            f"no fallback plan declared for {query!r} in "
-            "tpch.manifest.FALLBACK")
-    if spec.get("merge") is None:
-        raise InvalidArgument(
-            f"{query} has no correct spill decomposition: "
-            f"{spec.get('why', 'undeclared')} — it keeps "
-            "in-core-or-recorded-OOM semantics")
+            f"unknown TPC-H query {query!r} — known queries: "
+            f"{_known_queries()}")
     if n_partitions is None:
         n_partitions = default_partitions()
     if int(n_partitions) < 1:
@@ -470,6 +638,7 @@ def tpch_fallback(query: str, data: Mapping, *, env=None,
         raise InvalidArgument(
             f"n_partitions must be >= 1, got {n_partitions}")
     n_partitions = int(n_partitions)
+    two_phase = spec["merge"] == "twophase"
     eager_fn = getattr(tpch, query)
     limit = _resolve_limit(eager_fn, spec, params)
     part_params = dict(params)
@@ -499,12 +668,28 @@ def tpch_fallback(query: str, data: Mapping, *, env=None,
                 # (a changed build side discards the checkpoint and
                 # recomputes, never mixes generations)
                 bcast_fp.append((tname, _cols_fingerprint(cols)))
-            bcast.update(tpch.ingest({tname: cols}))
+            # a two-phase plan's phase fns are HOST compute — its
+            # broadcast tables stay host columns (no device ingest on
+            # the degraded path)
+            if two_phase:
+                bcast[tname] = cols
+            else:
+                bcast.update(tpch.ingest({tname: cols}))
         elif key is None:
             part_tables[tname] = _partition_rows(cols, n_partitions)
         else:
             part_tables[tname] = host_partition_chunks(
                 [cols], [key], n_partitions)
+    if two_phase:
+        return _two_phase(query, part_tables, bcast, n_partitions,
+                          resume_dir,
+                          (tuple(sorted((t, k) for t, k in
+                                        spec["partition"].items())),
+                           int(n_partitions),
+                           tuple(sorted((k, repr(v))
+                                        for k, v in params.items())),
+                           tuple(sorted(bcast_fp))),
+                          params)
     ckpt = None
     if resume_dir is not None:
         ckpt = resilience.CheckpointedRun(
@@ -549,20 +734,7 @@ def tpch_fallback(query: str, data: Mapping, *, env=None,
                 # still matches, then replay the durable partial — no
                 # recompute
                 ckpt.verify_meta(p, f"tpch_fallback[{query}]", **meta)
-                got = _decode_partial(ckpt.resume_unit(p))
-                if got is None:
-                    # a 0-row FRAME partial keeps no spill file — its
-                    # schema rides the unit meta so a resumed
-                    # all-empty query still returns the schema'd empty
-                    # frame the first run did (byte-identical resume)
-                    schema = (ckpt.unit_meta(p) or {}).get("__schema__")
-                    if schema:
-                        import pandas as pd
-
-                        got = pd.DataFrame(
-                            {c: np.empty(0, np.dtype(d))
-                             for c, d in schema})
-                partials.append(got)
+                partials.append(_resume_partial(ckpt, p))
                 continue
             if all(v == 0 for v in meta.values()):
                 if ckpt is not None:
@@ -580,15 +752,7 @@ def tpch_fallback(query: str, data: Mapping, *, env=None,
                                                   **part_params))
                 if ckpt is not None:
                     cols, rows = _encode_partial(partial)
-                    unit_meta = dict(meta)
-                    if not isinstance(partial, float):
-                        # frame partials record their schema: a 0-row
-                        # unit writes no spill file, and the resume
-                        # must still reconstruct the schema'd empty
-                        # frame
-                        unit_meta["__schema__"] = [
-                            [c, str(partial[c].dtype)]
-                            for c in partial.columns]
+                    unit_meta = _partial_schema_meta(partial, meta)
                     # checkpoint BEFORE the partial joins the merge
                     # set (com.drain() on scope exit is the barrier
                     # before _merge_partials): a kill from here on
@@ -609,23 +773,19 @@ def run_query(query: str, data: Mapping, *, env=None,
     manifest-projected input bytes against free HBM, run in-core when
     it fits, degrade through :func:`tpch_fallback` when it cannot (or
     when the in-core dispatch dies OOM). Returns the HOST result on
-    either path. Queries without a usable fallback plan
-    (:func:`supports`) skip the pre-flight and keep their
-    in-core-or-raise semantics."""
+    either path. Every known query has a usable plan (the two-phase
+    executor closed the last six); an unknown name fails fast with the
+    known-query list."""
     from cylon_tpu import tpch
+
+    if not supports(query):
+        raise InvalidArgument(
+            f"unknown TPC-H query {query!r} — known queries: "
+            f"{_known_queries()}")
 
     def attempt():
         qfn = tpch.compiled(query) if compiled else getattr(tpch, query)
         return _materialize(qfn(data, env=env, **params))
-
-    if not supports(query):
-        # no usable spill decomposition: genuinely in-core-or-raise —
-        # no pre-flight, no retry, no ooc.fallbacks count; an OOM
-        # still gets the forensics dump (and the seeded-fault hook
-        # stays live so tests can drive the raise deterministically)
-        with _memory.forensics(f"fallback.{query}"):
-            resilience.inject("plan", f"fallback.{query}")
-            return attempt()
 
     def spill():
         return tpch_fallback(query, data, env=env,

@@ -81,9 +81,13 @@ KILL_EXIT_CODE = 43
 #: fallback executor's in-core attempt), where a seeded
 #: ``MemoryError`` is the deterministic twin of a device
 #: RESOURCE_EXHAUSTED — the injection the OOM→spill fallback tests
-#: drive.
+#: drive; ``global_merge`` — the two-phase fallback executor's global
+#: merge step (``fallback._two_phase``), the blocking scalar
+#: computation between the partial pass and the apply pass, so chaos
+#: harnesses can kill a run exactly at the phase boundary.
 INJECTION_POINTS = ("spill_write", "spill_read", "chunk_source",
-                    "io_read", "exchange", "worker", "plan")
+                    "io_read", "exchange", "worker", "plan",
+                    "global_merge")
 
 
 # ------------------------------------------------------------ fault plans

@@ -73,9 +73,11 @@ REQUIRED_FLEET_FIELDS = frozenset({
 })
 
 #: default mixed workload: groupby-heavy scan, 3-way join + top-k,
-#: 6-way join, and a scalar aggregate — four distinct shapes so the
-#: schedule interleaves genuinely different pipelines
-DEFAULT_MIX = ("q1", "q3", "q5", "q6")
+#: 6-way join, a scalar aggregate, and a two-phase global aggregate
+#: (q14's promo ratio needs a global merge scalar — its spill path is
+#: the ISSUE 16 two-phase plan) — five distinct shapes so the schedule
+#: interleaves genuinely different pipelines
+DEFAULT_MIX = ("q1", "q3", "q5", "q6", "q14")
 
 
 def _emit_record(line: dict):

@@ -129,7 +129,7 @@ __all__ = [
 
 #: default mixed workload for fleet engine processes (mirrors
 #: serve.bench.DEFAULT_MIX without importing the bench at module load)
-DEFAULT_MIX = ("q1", "q3", "q5", "q6")
+DEFAULT_MIX = ("q1", "q3", "q5", "q6", "q14")
 
 
 def _poll_interval() -> float:
@@ -1221,6 +1221,28 @@ def _mk_fleet_query(cq, resident, env):
     return run
 
 
+def _mk_fleet_fallback(query: str, data):
+    """The registered spill path for one fleet query: the partitioned
+    host fallback (the two-phase plan for global-aggregate queries
+    like q14). Registered — not per-submit — so a journal REPLAY after
+    a failover re-arms it automatically: a replayed request that OOMs
+    on the survivor recomputes its merge scalar there instead of
+    trusting anything from the dead engine's journal."""
+    from cylon_tpu import fallback
+
+    def run():
+        # eager per-partition execution: the spill path must not
+        # re-enter the compiled-dispatch layer that just exhausted
+        # memory (it would OOM again under the same pressure). The
+        # result is already HOST-shaped (pandas frame / float) — the
+        # same client-visible shape _materialize gives the compiled
+        # path.
+        out = fallback.tpch_fallback(query, data, compiled=False)
+        return out if hasattr(out, "columns") else float(out)
+
+    return run
+
+
 def _engine_main(args) -> int:
     """One fleet engine process: resident TPC-H tables on its own
     mesh, named queries registered for the gateway, durable dir at
@@ -1237,14 +1259,26 @@ def _engine_main(args) -> int:
     from cylon_tpu.serve.bench import _mk_resident
     from cylon_tpu.tpch import dbgen
 
-    # chaos harness hook (same env contract as tests/test_chaos.py):
+    # chaos harness hooks (same env contract as tests/test_chaos.py):
     # CHAOS_KILL=point:nth installs a process-wide FaultRule.kill so
-    # the engine hard-dies (rc 43) at a seeded mid-query instant
+    # the engine hard-dies (rc 43) at a seeded mid-query instant;
+    # CHAOS_OOM=point:nth makes every hit from nth on raise
+    # MemoryError — each dispatch exhausts memory, so every request
+    # completes through its registered spill fallback (the degraded
+    # path, including replayed requests after a failover)
+    rules = []
     kill = os.environ.get("CHAOS_KILL")
     if kill:
         point, nth = kill.rsplit(":", 1)
-        resilience.install(resilience.FaultPlan(
-            [resilience.FaultRule.kill(point, nth=int(nth))]))
+        rules.append(resilience.FaultRule.kill(point, nth=int(nth)))
+    oom = os.environ.get("CHAOS_OOM")
+    if oom:
+        point, nth = oom.rsplit(":", 1)
+        rules.append(resilience.FaultRule(
+            point, nth=int(nth), times=0,
+            error=MemoryError("injected OOM (CHAOS_OOM)")))
+    if rules:
+        resilience.install(resilience.FaultPlan(rules))
 
     layout = FleetLayout(args.root)
     env = ct.CylonEnv(ct.TPUConfig())
@@ -1258,7 +1292,8 @@ def _engine_main(args) -> int:
     mix = tuple(q.strip() for q in args.mix.split(",") if q.strip())
     for q in mix:
         engine.register_query(q, _mk_fleet_query(tpch.compiled(q),
-                                                 resident, env))
+                                                 resident, env),
+                              fallback=_mk_fleet_fallback(q, data))
     gateway = EngineGateway(engine, port=args.gateway_port)
     ready = {"name": args.name, "pid": os.getpid(),
              "gateway": list(gateway.address),
@@ -1329,6 +1364,7 @@ def spawn_engine(root: str, name: str, sf: float = 0.002,
     # as a wedged scheduler (the router would dwell it to death)
     child_env.setdefault("CYLON_TPU_SERVE_STALL_AGE", "120")
     child_env.pop("CHAOS_KILL", None)
+    child_env.pop("CHAOS_OOM", None)
     child_env.update(env_extra or {})
     logf = open(log_path, "ab")
     proc = subprocess.Popen(cmd, stdout=subprocess.PIPE, stderr=logf,

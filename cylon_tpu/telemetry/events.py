@@ -72,6 +72,9 @@ EVENT_KINDS: "dict[str, tuple]" = {
     # resilience / fallback
     "checkpoint_resume": ("op", "unit"),
     "fallback": ("op", "reason"),
+    # two-phase global aggregate: the journaled merge scalar was
+    # computed (or replayed) for this query (ISSUE 16)
+    "merge_phase": ("op",),
     # watchdog
     "watchdog_expired": ("section", "detail", "elapsed_s",
                          "budget_s"),

@@ -441,9 +441,11 @@ REQUIRED_BENCH_KEYS = (
     "spill.read_bytes",
     "spill.write_bytes",
     "ooc.fallbacks",
+    "ooc.merge_phases",
     "ooc.prefetch_hits",
     "ooc.prefetch_misses",
     "ooc.overlap_seconds",
+    "ooc.units_resumed",
     "watchdog.sections_expired",
 )
 

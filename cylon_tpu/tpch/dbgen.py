@@ -126,52 +126,93 @@ _START = date_int(1992, 1, 1)
 _END = date_int(1998, 8, 2)
 
 
-def generate(sf: float = 0.01, seed: int = 0) -> Mapping[str, dict]:
+def generate(sf: float = 0.01, seed: int = 0,
+             keep: "Mapping[str, set] | None" = None
+             ) -> Mapping[str, dict]:
     """Generate all eight tables as ``{name: {column: np.ndarray}}``.
 
     ``sf`` is the TPC-H scale factor (1.0 => 6M-row lineitem); fractional
     values scale every table proportionally (min 1 row), so tests run at
     sf≈0.001 with the same shape of data the benchmark runs at sf=100.
+
+    ``keep`` is an optional ``{table: columns}`` GENERATION manifest
+    (same shape as ``tpch.manifest.MANIFEST`` keep-sets): columns
+    outside it are never built — at SF100 full generation would dwarf
+    host RAM (lineitem's comment strings alone are >100 GB), while the
+    Q3/Q5 projection fits. Cross-column intermediates are still drawn
+    unconditionally so dependent columns stay mutually consistent.
+    ``keep=None`` (the default) draws the byte-identical full dataset
+    it always has; a PRUNED run skips the pruned columns' random
+    draws, which shifts the stream — its values and data-dependent row
+    counts (lineitem's 1-7 items/order) are NOT identical to a full
+    run at the same seed. Use pruned generation for at-scale benches,
+    never as an oracle against full data.
     """
     rng = np.random.default_rng(seed)
+
+    def want(t: str, c: str) -> bool:
+        return keep is None or c in keep.get(t, ())
     n_cust = max(int(150_000 * sf), 10)
     n_supp = max(int(10_000 * sf), 5)
     n_ord = max(int(1_500_000 * sf), 20)
     n_part = max(int(200_000 * sf), 8)
 
-    region = {
-        "r_regionkey": np.arange(5, dtype=np.int64),
-        "r_name": REGIONS.copy(),
-    }
-    nation = {
-        "n_nationkey": np.arange(len(NATIONS), dtype=np.int64),
-        "n_name": np.array([n for n, _ in NATIONS], dtype=object),
-        "n_regionkey": np.array([r for _, r in NATIONS], dtype=np.int64),
-    }
+    region = {}
+    if want("region", "r_regionkey"):
+        region["r_regionkey"] = np.arange(5, dtype=np.int64)
+    if want("region", "r_name"):
+        region["r_name"] = REGIONS.copy()
+    nation = {}
+    if want("nation", "n_nationkey"):
+        nation["n_nationkey"] = np.arange(len(NATIONS), dtype=np.int64)
+    if want("nation", "n_name"):
+        nation["n_name"] = np.array([n for n, _ in NATIONS],
+                                    dtype=object)
+    if want("nation", "n_regionkey"):
+        nation["n_regionkey"] = np.array([r for _, r in NATIONS],
+                                         dtype=np.int64)
+    # cross-column intermediates stay unconditionally drawn, at their
+    # historical stream positions: for keep=None the byte stream (and
+    # so every value) is identical to what this generator has always
+    # produced
     c_nationkey = rng.integers(0, len(NATIONS), n_cust).astype(np.int64)
     # spec 4.2.2.9: phone country code = nationkey + 10; Q22 slices it
     phone_tail = rng.integers(0, 10_000_000, n_cust)
-    customer = {
-        "c_custkey": np.arange(1, n_cust + 1, dtype=np.int64),
-        "c_nationkey": c_nationkey,
-        "c_mktsegment": SEGMENTS[rng.integers(0, len(SEGMENTS), n_cust)],
-        "c_acctbal": np.round(rng.uniform(-999.99, 9999.99, n_cust), 2),
-        "c_phone": np.array(
+    customer = {}
+    if want("customer", "c_custkey"):
+        customer["c_custkey"] = np.arange(1, n_cust + 1, dtype=np.int64)
+    if want("customer", "c_nationkey"):
+        customer["c_nationkey"] = c_nationkey
+    if want("customer", "c_mktsegment"):
+        customer["c_mktsegment"] = SEGMENTS[
+            rng.integers(0, len(SEGMENTS), n_cust)]
+    if want("customer", "c_acctbal"):
+        customer["c_acctbal"] = np.round(
+            rng.uniform(-999.99, 9999.99, n_cust), 2)
+    if want("customer", "c_phone"):
+        customer["c_phone"] = np.array(
             [f"{nk + 10}-{t % 1000:03d}-{(t // 1000) % 1000:03d}-"
              f"{t // 1_000_000:04d}"
-             for nk, t in zip(c_nationkey, phone_tail)], dtype=object),
-    }
-    supplier = {
-        "s_suppkey": np.arange(1, n_supp + 1, dtype=np.int64),
-        "s_name": np.array([f"Supplier#{i:09d}" for i in
-                            range(1, n_supp + 1)], dtype=object),
-        "s_nationkey": rng.integers(0, len(NATIONS), n_supp).astype(np.int64),
-        "s_acctbal": np.round(rng.uniform(-999.99, 9999.99, n_supp), 2),
+             for nk, t in zip(c_nationkey, phone_tail)], dtype=object)
+    supplier = {}
+    if want("supplier", "s_suppkey"):
+        supplier["s_suppkey"] = np.arange(1, n_supp + 1, dtype=np.int64)
+    if want("supplier", "s_name"):
+        supplier["s_name"] = np.array(
+            [f"Supplier#{i:09d}" for i in range(1, n_supp + 1)],
+            dtype=object)
+    if want("supplier", "s_nationkey"):
+        supplier["s_nationkey"] = rng.integers(
+            0, len(NATIONS), n_supp).astype(np.int64)
+    if want("supplier", "s_acctbal"):
+        supplier["s_acctbal"] = np.round(
+            rng.uniform(-999.99, 9999.99, n_supp), 2)
+    if want("supplier", "s_comment"):
         # spec 4.2.3: ~10/10000 suppliers carry Customer...Complaints
         # (scaled up slightly so tiny test SFs still select rows)
-        "s_comment": _inject_seq(rng, _phrases(rng, n_supp, 6), 0.01,
-                                 "Customer", "Complaints"),
-    }
+        supplier["s_comment"] = _inject_seq(
+            rng, _phrases(rng, n_supp, 6), 0.01,
+            "Customer", "Complaints")
     p_type = np.array(
         [f"{a} {b} {c}" for a in TYPE_S1 for b in TYPE_S2 for c in TYPE_S3],
         dtype=object)
@@ -183,58 +224,82 @@ def generate(sf: float = 0.01, seed: int = 0) -> Mapping[str, dict]:
     colors = np.array(COLORS, dtype=object)
     name_a = colors[rng.integers(0, len(colors), n_part)]
     name_b = colors[rng.integers(0, len(colors), n_part)]
-    part = {
-        "p_partkey": np.arange(1, n_part + 1, dtype=np.int64),
-        "p_name": np.array([f"{a} {b}" for a, b in zip(name_a, name_b)],
-                           dtype=object),
-        "p_mfgr": np.array([f"Manufacturer#{m}" for m in
-                            rng.integers(1, 6, n_part)], dtype=object),
-        "p_brand": brands[rng.integers(0, len(brands), n_part)],
-        "p_type": p_type[rng.integers(0, len(p_type), n_part)],
-        "p_size": rng.integers(1, 51, n_part).astype(np.int64),
-        "p_container": p_container[rng.integers(0, len(p_container), n_part)],
-        "p_retailprice": np.round(rng.uniform(900.0, 2000.0, n_part), 2),
-    }
+    part = {}
+    if want("part", "p_partkey"):
+        part["p_partkey"] = np.arange(1, n_part + 1, dtype=np.int64)
+    if want("part", "p_name"):
+        part["p_name"] = np.array(
+            [f"{a} {b}" for a, b in zip(name_a, name_b)], dtype=object)
+    if want("part", "p_mfgr"):
+        part["p_mfgr"] = np.array(
+            [f"Manufacturer#{m}" for m in rng.integers(1, 6, n_part)],
+            dtype=object)
+    if want("part", "p_brand"):
+        part["p_brand"] = brands[rng.integers(0, len(brands), n_part)]
+    if want("part", "p_type"):
+        part["p_type"] = p_type[rng.integers(0, len(p_type), n_part)]
+    if want("part", "p_size"):
+        part["p_size"] = rng.integers(1, 51, n_part).astype(np.int64)
+    if want("part", "p_container"):
+        part["p_container"] = p_container[
+            rng.integers(0, len(p_container), n_part)]
+    if want("part", "p_retailprice"):
+        part["p_retailprice"] = np.round(
+            rng.uniform(900.0, 2000.0, n_part), 2)
     # partsupp: 4 DISTINCT suppliers per part (spec primary key is
     # (ps_partkey, ps_suppkey)). base + i*step mod S is duplicate-free
     # for i in 0..3 whenever 0 < step <= (S-1)/3, mirroring dbgen's
     # arithmetic-progression supplier assignment.
-    ps_partkey = np.repeat(part["p_partkey"], 4)
+    ps_partkey = np.repeat(np.arange(1, n_part + 1, dtype=np.int64), 4)
     n_ps = len(ps_partkey)
     base = rng.integers(0, n_supp, n_part)
     step = rng.integers(1, max((n_supp - 1) // 3, 1) + 1, n_part)
-    ps_suppkey = ((base[:, None] + np.arange(4)[None, :] * step[:, None])
-                  % n_supp + 1).reshape(-1).astype(np.int64)
-    partsupp = {
-        "ps_partkey": ps_partkey,
-        "ps_suppkey": ps_suppkey,
-        "ps_availqty": rng.integers(1, 10_000, n_ps).astype(np.int64),
-        "ps_supplycost": np.round(rng.uniform(1.0, 1000.0, n_ps), 2),
-    }
+    partsupp = {}
+    if want("partsupp", "ps_partkey"):
+        partsupp["ps_partkey"] = ps_partkey
+    if want("partsupp", "ps_suppkey"):
+        partsupp["ps_suppkey"] = (
+            (base[:, None] + np.arange(4)[None, :] * step[:, None])
+            % n_supp + 1).reshape(-1).astype(np.int64)
+    if want("partsupp", "ps_availqty"):
+        partsupp["ps_availqty"] = rng.integers(
+            1, 10_000, n_ps).astype(np.int64)
+    if want("partsupp", "ps_supplycost"):
+        partsupp["ps_supplycost"] = np.round(
+            rng.uniform(1.0, 1000.0, n_ps), 2)
     o_orderdate = rng.integers(_START, _END + 1, n_ord).astype(np.int32)
     # spec: status F when every lineitem shipped (old orders), O when
     # none (recent), P in between — date-driven like real dbgen
     cut_f = date_int(1995, 6, 1)
     cut_o = date_int(1995, 6, 30)
-    o_orderstatus = np.where(o_orderdate < cut_f, "F",
-                             np.where(o_orderdate > cut_o, "O", "P")
-                             ).astype(object)
-    orders = {
-        "o_orderkey": np.arange(1, n_ord + 1, dtype=np.int64),
-        "o_custkey": rng.integers(1, n_cust + 1, n_ord).astype(np.int64),
-        "o_orderstatus": o_orderstatus,
-        "o_orderdate": o_orderdate,
-        "o_orderpriority": PRIORITIES[rng.integers(0, len(PRIORITIES),
-                                                   n_ord)],
-        "o_shippriority": np.zeros(n_ord, dtype=np.int64),
-        "o_totalprice": np.round(rng.uniform(800.0, 500_000.0, n_ord), 2),
+    orders = {}
+    if want("orders", "o_orderkey"):
+        orders["o_orderkey"] = np.arange(1, n_ord + 1, dtype=np.int64)
+    if want("orders", "o_custkey"):
+        orders["o_custkey"] = rng.integers(
+            1, n_cust + 1, n_ord).astype(np.int64)
+    if want("orders", "o_orderstatus"):
+        orders["o_orderstatus"] = np.where(
+            o_orderdate < cut_f, "F",
+            np.where(o_orderdate > cut_o, "O", "P")).astype(object)
+    if want("orders", "o_orderdate"):
+        orders["o_orderdate"] = o_orderdate
+    if want("orders", "o_orderpriority"):
+        orders["o_orderpriority"] = PRIORITIES[
+            rng.integers(0, len(PRIORITIES), n_ord)]
+    if want("orders", "o_shippriority"):
+        orders["o_shippriority"] = np.zeros(n_ord, dtype=np.int64)
+    if want("orders", "o_totalprice"):
+        orders["o_totalprice"] = np.round(
+            rng.uniform(800.0, 500_000.0, n_ord), 2)
+    if want("orders", "o_comment"):
         # ~2% carry special...requests (Q13's NOT LIKE exclusion)
-        "o_comment": _inject_seq(rng, _phrases(rng, n_ord, 5), 0.02,
-                                 "special", "requests"),
-    }
+        orders["o_comment"] = _inject_seq(
+            rng, _phrases(rng, n_ord, 5), 0.02, "special", "requests")
     # 1..7 lineitems per order (TPC-H mean 4)
     per_order = rng.integers(1, 8, n_ord)
-    l_orderkey = np.repeat(orders["o_orderkey"], per_order)
+    l_orderkey = np.repeat(np.arange(1, n_ord + 1, dtype=np.int64),
+                           per_order)
     n_li = len(l_orderkey)
     l_orderdate = np.repeat(o_orderdate, per_order)
     l_shipdate = (l_orderdate + rng.integers(1, 122, n_li)).astype(np.int32)
@@ -247,31 +312,50 @@ def generate(sf: float = 0.01, seed: int = 0) -> Mapping[str, dict]:
     l_suppkey = ((base[l_partkey - 1]
                   + rng.integers(0, 4, n_li) * step[l_partkey - 1])
                  % n_supp + 1).astype(np.int64)
-    lineitem = {
-        "l_orderkey": l_orderkey,
-        "l_partkey": l_partkey,
-        "l_suppkey": l_suppkey,
-        "l_quantity": rng.integers(1, 51, n_li).astype(np.int64),
-        "l_extendedprice": np.round(rng.uniform(900.0, 105_000.0, n_li), 2),
-        "l_discount": np.round(rng.integers(0, 11, n_li) / 100.0, 2),
-        "l_tax": np.round(rng.integers(0, 9, n_li) / 100.0, 2),
-        "l_returnflag": np.array(["R", "A", "N"])[
-            rng.integers(0, 3, n_li)],
-        "l_linestatus": np.array(["O", "F"])[rng.integers(0, 2, n_li)],
-        "l_shipdate": l_shipdate,
-        "l_commitdate": (l_orderdate
-                         + rng.integers(30, 91, n_li)).astype(np.int32),
-        "l_receiptdate": (l_shipdate
-                          + rng.integers(1, 31, n_li)).astype(np.int32),
-        "l_shipmode": SHIPMODES[rng.integers(0, len(SHIPMODES), n_li)],
-        "l_shipinstruct": SHIPINSTRUCT[rng.integers(0, len(SHIPINSTRUCT),
-                                                    n_li)],
+    lineitem = {}
+    if want("lineitem", "l_orderkey"):
+        lineitem["l_orderkey"] = l_orderkey
+    if want("lineitem", "l_partkey"):
+        lineitem["l_partkey"] = l_partkey
+    if want("lineitem", "l_suppkey"):
+        lineitem["l_suppkey"] = l_suppkey
+    if want("lineitem", "l_quantity"):
+        lineitem["l_quantity"] = rng.integers(
+            1, 51, n_li).astype(np.int64)
+    if want("lineitem", "l_extendedprice"):
+        lineitem["l_extendedprice"] = np.round(
+            rng.uniform(900.0, 105_000.0, n_li), 2)
+    if want("lineitem", "l_discount"):
+        lineitem["l_discount"] = np.round(
+            rng.integers(0, 11, n_li) / 100.0, 2)
+    if want("lineitem", "l_tax"):
+        lineitem["l_tax"] = np.round(rng.integers(0, 9, n_li) / 100.0, 2)
+    if want("lineitem", "l_returnflag"):
+        lineitem["l_returnflag"] = np.array(["R", "A", "N"])[
+            rng.integers(0, 3, n_li)]
+    if want("lineitem", "l_linestatus"):
+        lineitem["l_linestatus"] = np.array(["O", "F"])[
+            rng.integers(0, 2, n_li)]
+    if want("lineitem", "l_shipdate"):
+        lineitem["l_shipdate"] = l_shipdate
+    if want("lineitem", "l_commitdate"):
+        lineitem["l_commitdate"] = (
+            l_orderdate + rng.integers(30, 91, n_li)).astype(np.int32)
+    if want("lineitem", "l_receiptdate"):
+        lineitem["l_receiptdate"] = (
+            l_shipdate + rng.integers(1, 31, n_li)).astype(np.int32)
+    if want("lineitem", "l_shipmode"):
+        lineitem["l_shipmode"] = SHIPMODES[
+            rng.integers(0, len(SHIPMODES), n_li)]
+    if want("lineitem", "l_shipinstruct"):
+        lineitem["l_shipinstruct"] = SHIPINSTRUCT[
+            rng.integers(0, len(SHIPINSTRUCT), n_li)]
+    if want("lineitem", "l_comment"):
         # varchar(44) near-unique text — no query reads it, but it is
         # the canonical high-cardinality string column (the judge's
         # "the host dictionary IS the dataset" case) and rides every
         # lineitem shuffle as device bytes
-        "l_comment": _phrases(rng, n_li, 4, max_chars=44),
-    }
+        lineitem["l_comment"] = _phrases(rng, n_li, 4, max_chars=44)
     return {
         "region": region,
         "nation": nation,

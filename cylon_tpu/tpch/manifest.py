@@ -213,10 +213,19 @@ MANIFEST = {
 #:     and re-applies it after the merge.
 #:   * ``"sum"`` — scalar queries that are a pure SUM over rows of the
 #:     partitioned table(s): the answer is the sum of partial scalars.
-#:   * ``None`` — the stock query's output embeds global non-associative
-#:     state (a ratio, a global scalar threshold, COUNT(DISTINCT)) that
-#:     per-partition runs cannot recombine; ``why`` names the blocker.
-#:     These queries keep in-core-or-recorded-OOM semantics.
+#:   * ``"twophase"`` — the query's output embeds global
+#:     non-associative state (a ratio of sums, a global
+#:     threshold/average, COUNT(DISTINCT)) that per-partition runs of
+#:     the stock query cannot recombine. These run a hand-decomposed
+#:     plan (:data:`cylon_tpu.tpch.twophase.PLANS`) instead: phase 1
+#:     emits associative partials per partition, a journaled global
+#:     merge computes the blocking value, phase 2 re-applies it per
+#:     partition. The partition map is chosen FOR the decomposition —
+#:     q16 splits partsupp/supplier by SUPPKEY (the distinct key) so
+#:     per-partition distinct counts are disjoint and summable; q15
+#:     co-partitions supplier with the lineitem revenue groups; q22
+#:     co-partitions orders with customer so the NOT EXISTS anti-join
+#:     stays partition-local.
 #:
 #: - ``sort``/``ascending``/``limit_kwarg``: the query's final order
 #:   (and the name of its limit parameter), re-applied after the merge.
@@ -271,10 +280,11 @@ FALLBACK = {
         "sort": ["supp_nation", "cust_nation", "l_year"],
     },
     "q8": {
+        # per-year market share is a ratio of sums: phase 1 emits
+        # (total, nation_total) per o_year, the merge re-sums and
+        # takes the ratio — no phase 2
         "partition": {"lineitem": "l_orderkey", "orders": "o_orderkey"},
-        "merge": None,
-        "why": "per-year market share is a ratio of sums — partial "
-               "ratios do not recombine from the query's output",
+        "merge": "twophase",
     },
     "q9": {
         "partition": {"lineitem": "l_orderkey", "orders": "o_orderkey"},
@@ -291,10 +301,11 @@ FALLBACK = {
         "limit_kwarg": "limit",
     },
     "q11": {
+        # HAVING value > fraction * GLOBAL total: phase 1 emits exact
+        # per-partkey value sums (groups never span partitions), the
+        # merge sums the total, phase 2 filters against it
         "partition": {"partsupp": "ps_partkey"},
-        "merge": None,
-        "why": "the HAVING threshold is a fraction of a GLOBAL total — "
-               "per-partition runs filter against partition-local totals",
+        "merge": "twophase",
     },
     "q12": {
         "partition": {"orders": "o_orderkey", "lineitem": "l_orderkey"},
@@ -309,22 +320,25 @@ FALLBACK = {
         "sort": ["custdist", "c_count"], "ascending": [False, False],
     },
     "q14": {
+        # scalar promo/total percentage: phase 1 emits the (promo_rev,
+        # total_rev) sum pair, the merge takes the ratio — no phase 2
         "partition": {"lineitem": "l_partkey", "part": "p_partkey"},
-        "merge": None,
-        "why": "scalar promo/total percentage — partial percentages do "
-               "not recombine from the query's output",
+        "merge": "twophase",
     },
     "q15": {
-        "partition": {"lineitem": "l_suppkey"},
-        "merge": None,
-        "why": "the = MAX(total_revenue) filter compares against a "
-               "GLOBAL max unavailable inside one partition",
+        # = MAX(total_revenue) against a GLOBAL max: phase 1 emits
+        # exact per-suppkey revenue sums, the merge takes the max,
+        # phase 2 filters and joins the co-partitioned supplier slice
+        "partition": {"lineitem": "l_suppkey", "supplier": "s_suppkey"},
+        "merge": "twophase",
     },
     "q16": {
-        "partition": {"part": "p_partkey", "partsupp": "ps_partkey"},
-        "merge": None,
-        "why": "COUNT(DISTINCT ps_suppkey) per part-attribute group — "
-               "distinct counts across partitions are not summable",
+        # COUNT(DISTINCT ps_suppkey) per part-attribute group:
+        # partitioned BY THE DISTINCT KEY (suppkey, not partkey) so
+        # per-partition distinct sets are disjoint and the merge SUMS
+        # them exactly; part broadcasts — no phase 2
+        "partition": {"partsupp": "ps_suppkey", "supplier": "s_suppkey"},
+        "merge": "twophase",
     },
     "q17": {
         "partition": {"part": "p_partkey", "lineitem": "l_partkey"},
@@ -353,9 +367,11 @@ FALLBACK = {
         "limit_kwarg": "limit",
     },
     "q22": {
+        # the balance cutoff is a GLOBAL average: phase 1 emits the
+        # (sum, count) pair over positive-balance coded customers, the
+        # merge divides, phase 2 re-filters and anti-joins the
+        # co-partitioned orders slice
         "partition": {"customer": "c_custkey", "orders": "o_custkey"},
-        "merge": None,
-        "why": "the balance cutoff is a GLOBAL average over customers — "
-               "partition-local averages change the candidate set",
+        "merge": "twophase",
     },
 }

@@ -1,0 +1,421 @@
+"""Two-phase plans for the TPC-H queries whose answer embeds a global
+scalar (``FALLBACK[q]["merge"] == "twophase"``).
+
+Six queries (q8/q11/q14/q15/q16/q22) cannot recombine from
+per-partition runs of the UNCHANGED query fn: their output bakes in a
+ratio of global sums (q8/q14), a threshold against a global total or
+max (q11/q15), a global average (q22), or a COUNT(DISTINCT) (q16).
+Each gets a hand-decomposed plan instead — the classic two-phase
+aggregate:
+
+* **phase 1** runs per partition over the SAME co-partitioned host
+  shards the generic executor builds, and emits an *associative
+  partial*: sum/count pairs for ratios and averages, per-group sums for
+  thresholds, per-partition distinct counts for q16 (exact because the
+  executor hash-partitions partsupp BY ``ps_suppkey`` — the distinct
+  key — so no supplier's rows span partitions and per-partition
+  distinct sets are disjoint).
+* **merge** combines all partials into the blocking global value (the
+  promo ratio, the HAVING total, the max revenue, the balance average —
+  or, for q8/q16, directly the final frame). The executor journals this
+  result as its own checkpoint unit and counts
+  ``ooc.merge_phases{query}``.
+* **phase 2** (q11/q15/q22 only) re-runs the cheap apply per partition
+  with the merged value broadcast in — a filter against the global
+  threshold plus partition-local joins that are exact under the
+  declared co-partitioning (q22's NOT EXISTS anti-join: orders are
+  hash-split by ``o_custkey`` with customers by ``c_custkey``, so a
+  customer's orders never land elsewhere).
+* **reduce** concatenates phase-2 partials into the final host answer
+  (or unwraps the merged frame when there is no phase 2).
+
+Everything here is HOST compute (pandas/numpy) — this module only runs
+on the degraded path, after the in-core attempt did not fit, so the
+partials must not re-enter the device. The numeric semantics mirror
+``queries.py`` exactly: the same ``_like_seq`` two-word LIKE, the same
+Hinnant civil-from-days year extraction, the same zero-denominator and
+empty-input guards. Resume determinism: every phase fn is a pure
+function of its (durable) inputs, partials round-trip through the
+spill store bit-exactly (float64 ``.npz``), and merges iterate in
+partition order — so a killed run re-merges to the identical bytes.
+
+See ``docs/outofcore.md`` "Two-phase global aggregates" for the
+per-query partial algebra and the exactness arguments.
+"""
+
+import numpy as np
+import pandas as pd
+
+from cylon_tpu.tpch.dbgen import date_int
+
+__all__ = ["PLANS", "TwoPhasePlan"]
+
+
+class TwoPhasePlan:
+    """One query's decomposition: ``phase1(tables, **params)`` →
+    associative partial frame; ``merge(partials, **params)`` → the
+    journaled global frame; optional ``phase2(tables, partial1, merged,
+    **params)`` → apply-pass partial; ``reduce(merged, partials2,
+    **params)`` → final host result. ``partials`` lists align with
+    partition index; empty partitions contribute ``None``."""
+
+    __slots__ = ("phase1", "merge", "reduce", "phase2")
+
+    def __init__(self, phase1, merge, reduce, phase2=None):
+        self.phase1 = phase1
+        self.merge = merge
+        self.reduce = reduce
+        self.phase2 = phase2
+
+
+# ------------------------------------------------------------- helpers
+def _year_of(days) -> np.ndarray:
+    """Host mirror of ``ops.datetime_ops.year_of`` (Hinnant
+    civil-from-days, proleptic Gregorian) — same integer arithmetic,
+    same answers, no jax import on the degraded path."""
+    z = np.asarray(days).astype(np.int64) + 719468
+    era = z // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    m = np.where(mp < 10, mp + 3, mp - 9)
+    return np.where(m <= 2, y + 1, y).astype(np.int32)
+
+
+def _like_seq_mask(vals, w1: str, w2: str) -> np.ndarray:
+    """Host mirror of ``queries._like_seq``: LIKE '%w1%w2%' — w2 must
+    appear AFTER the first w1."""
+    def hit(v):
+        if v is None:
+            return False
+        s = str(v)
+        if w1 not in s:
+            return False
+        return w2 in s[s.index(w1) + len(w1):]
+
+    return np.fromiter((hit(v) for v in vals), bool,
+                       count=len(np.asarray(vals, dtype=object)))
+
+
+def _str_col(cols, name) -> np.ndarray:
+    return np.asarray(cols[name], dtype=object)
+
+
+def _revenue(li, mask) -> np.ndarray:
+    ext = np.asarray(li["l_extendedprice"])[mask]
+    disc = np.asarray(li["l_discount"])[mask]
+    return ext * (1.0 - disc)
+
+
+def _frames(partials):
+    return [f for f in partials if f is not None and len(f)]
+
+
+def _empty(schema: "dict[str, object]") -> pd.DataFrame:
+    return pd.DataFrame({c: np.empty(0, d) for c, d in schema.items()})
+
+
+def _passthrough(merged, _partials2, **_params):
+    return merged
+
+
+# ------------------------------------------------------------------ q14
+def _q14_phase1(t, date_from=None, date_to=None):
+    if date_from is None:
+        date_from = date_int(1995, 9, 1)
+    if date_to is None:
+        date_to = date_int(1995, 10, 1)
+    li, part = t["lineitem"], t["part"]
+    sd = np.asarray(li["l_shipdate"])
+    m = (sd >= date_from) & (sd < date_to)
+    lp = pd.DataFrame({"l_partkey": np.asarray(li["l_partkey"])[m],
+                       "revenue": _revenue(li, m)})
+    pf = pd.DataFrame({"p_partkey": np.asarray(part["p_partkey"]),
+                       "p_type": _str_col(part, "p_type")})
+    j = lp.merge(pf, left_on="l_partkey", right_on="p_partkey",
+                 how="inner")
+    promo = np.fromiter(
+        (v is not None and str(v).startswith("PROMO")
+         for v in j["p_type"]), bool, count=len(j))
+    rev = j["revenue"].to_numpy()
+    return pd.DataFrame({"promo_rev": [float(rev[promo].sum())],
+                         "total_rev": [float(rev.sum())]})
+
+
+def _q14_merge(partials, **_params):
+    fs = _frames(partials)
+    promo = float(sum(float(f["promo_rev"].iloc[0]) for f in fs))
+    total = float(sum(float(f["total_rev"].iloc[0]) for f in fs))
+    # same zero-denominator guard as the in-core query
+    value = 0.0 if total == 0 else 100.0 * promo / total
+    return pd.DataFrame({"value": [value]})
+
+
+def _q14_reduce(merged, _partials2, **_params):
+    return float(merged["value"].iloc[0])
+
+
+# ------------------------------------------------------------------- q8
+def _q8_phase1(t, nation="BRAZIL", region="AMERICA",
+               ptype="ECONOMY ANODIZED STEEL"):
+    part, sup, cust = t["part"], t["supplier"], t["customer"]
+    nat, reg = t["nation"], t["region"]
+    li, ords = t["lineitem"], t["orders"]
+
+    pkeys = np.asarray(part["p_partkey"])[
+        np.fromiter((v is not None and str(v) == ptype
+                     for v in part["p_type"]), bool,
+                    count=len(np.asarray(part["p_partkey"])))]
+    regk = {int(k) for k, nm in zip(reg["r_regionkey"], reg["r_name"])
+            if str(nm) == region}
+    n1 = {int(k) for k, rk in zip(nat["n_nationkey"], nat["n_regionkey"])
+          if int(rk) in regk}
+    ckeys = np.asarray(cust["c_custkey"])[
+        np.fromiter((int(k) in n1 for k in cust["c_nationkey"]), bool,
+                    count=len(np.asarray(cust["c_custkey"])))]
+    natname = {int(k): str(nm)
+               for k, nm in zip(nat["n_nationkey"], nat["n_name"])}
+    supdf = pd.DataFrame({
+        "s_suppkey": np.asarray(sup["s_suppkey"]),
+        "supp_nation": pd.array(
+            [natname[int(k)] for k in sup["s_nationkey"]],
+            dtype=object)})
+
+    od = np.asarray(ords["o_orderdate"])
+    om = ((od >= date_int(1995, 1, 1)) & (od <= date_int(1996, 12, 31)))
+    odf = pd.DataFrame({"o_orderkey": np.asarray(ords["o_orderkey"])[om],
+                        "o_custkey": np.asarray(ords["o_custkey"])[om],
+                        "o_year": _year_of(od[om])})
+
+    lm = np.isin(np.asarray(li["l_partkey"]), pkeys)
+    ldf = pd.DataFrame({"l_orderkey": np.asarray(li["l_orderkey"])[lm],
+                        "l_suppkey": np.asarray(li["l_suppkey"])[lm],
+                        "revenue": _revenue(li, lm)})
+    j = ldf.merge(odf, left_on="l_orderkey", right_on="o_orderkey",
+                  how="inner")
+    j = j[j["o_custkey"].isin(ckeys)]
+    j = j.merge(supdf, left_on="l_suppkey", right_on="s_suppkey",
+                how="inner")
+    nat_rev = np.where(j["supp_nation"].to_numpy(dtype=object) == nation,
+                       j["revenue"].to_numpy(), 0.0)
+    work = pd.DataFrame({"o_year": j["o_year"].to_numpy(),
+                         "total": j["revenue"].to_numpy(),
+                         "nation_total": nat_rev})
+    return work.groupby("o_year", as_index=False, sort=False).agg(
+        total=("total", "sum"), nation_total=("nation_total", "sum"))
+
+
+def _q8_merge(partials, **_params):
+    fs = _frames(partials)
+    if not fs:
+        return _empty({"o_year": np.int32, "mkt_share": np.float64})
+    df = pd.concat(fs, ignore_index=True)
+    g = df.groupby("o_year", as_index=False, sort=False).agg(
+        total=("total", "sum"), nation_total=("nation_total", "sum"))
+    g["mkt_share"] = g["nation_total"] / g["total"]
+    return g.sort_values("o_year", kind="stable", ignore_index=True)[
+        ["o_year", "mkt_share"]]
+
+
+# ------------------------------------------------------------------ q11
+def _q11_phase1(t, nation="GERMANY", fraction=0.0001):
+    ps, sup, nat = t["partsupp"], t["supplier"], t["nation"]
+    natk = {int(k) for k, nm in zip(nat["n_nationkey"], nat["n_name"])
+            if str(nm) == nation}
+    skeys = np.asarray(sup["s_suppkey"])[
+        np.fromiter((int(k) in natk for k in sup["s_nationkey"]), bool,
+                    count=len(np.asarray(sup["s_suppkey"])))]
+    m = np.isin(np.asarray(ps["ps_suppkey"]), skeys)
+    work = pd.DataFrame({
+        "ps_partkey": np.asarray(ps["ps_partkey"])[m],
+        "value": (np.asarray(ps["ps_supplycost"])[m]
+                  * np.asarray(ps["ps_availqty"])[m]).astype(np.float64)})
+    return work.groupby("ps_partkey", as_index=False, sort=False).agg(
+        value=("value", "sum"))
+
+
+def _q11_merge(partials, **_params):
+    total = float(sum(float(f["value"].sum()) for f in _frames(partials)))
+    return pd.DataFrame({"total": [total]})
+
+
+def _q11_phase2(t, partial1, merged, nation="GERMANY", fraction=0.0001):
+    total = float(merged["total"].iloc[0])
+    keep = partial1["value"].to_numpy() > (fraction * total)
+    return partial1[keep].reset_index(drop=True)
+
+
+def _q11_reduce(merged, partials2, **_params):
+    fs = _frames(partials2)
+    if not fs:
+        return _empty({"ps_partkey": np.int64, "value": np.float64})
+    df = pd.concat(fs, ignore_index=True)
+    return df.sort_values("value", ascending=False, kind="stable",
+                          ignore_index=True)[["ps_partkey", "value"]]
+
+
+# ------------------------------------------------------------------ q15
+def _q15_phase1(t, date_from=None, date_to=None):
+    if date_from is None:
+        date_from = date_int(1996, 1, 1)
+    if date_to is None:
+        date_to = date_int(1996, 4, 1)
+    li = t["lineitem"]
+    sd = np.asarray(li["l_shipdate"])
+    m = (sd >= date_from) & (sd < date_to)
+    work = pd.DataFrame({"l_suppkey": np.asarray(li["l_suppkey"])[m],
+                         "total_revenue": _revenue(li, m)})
+    return work.groupby("l_suppkey", as_index=False, sort=False).agg(
+        total_revenue=("total_revenue", "sum"))
+
+
+def _q15_merge(partials, **_params):
+    vals = [float(f["total_revenue"].max()) for f in _frames(partials)]
+    # empty view -> NaN threshold -> every >= comparison is False ->
+    # empty result, matching the in-core empty-grouped semantics
+    mx = max(vals) if vals else float("nan")
+    return pd.DataFrame({"max_revenue": [mx]})
+
+
+def _q15_phase2(t, partial1, merged, date_from=None, date_to=None):
+    mx = float(merged["max_revenue"].iloc[0])
+    top = partial1[partial1["total_revenue"].to_numpy() >= mx]
+    sup = t["supplier"]
+    supdf = pd.DataFrame({"s_suppkey": np.asarray(sup["s_suppkey"]),
+                          "s_name": _str_col(sup, "s_name")})
+    out = top.merge(supdf, left_on="l_suppkey", right_on="s_suppkey",
+                    how="inner")
+    return out[["s_suppkey", "s_name", "total_revenue"]]
+
+
+def _q15_reduce(merged, partials2, **_params):
+    fs = _frames(partials2)
+    if not fs:
+        return _empty({"s_suppkey": np.int64, "s_name": object,
+                       "total_revenue": np.float64})
+    df = pd.concat(fs, ignore_index=True)
+    return df.sort_values("s_suppkey", kind="stable",
+                          ignore_index=True)[
+        ["s_suppkey", "s_name", "total_revenue"]]
+
+
+# ------------------------------------------------------------------ q16
+def _q16_phase1(t, brand="Brand#45", type_prefix="MEDIUM POLISHED",
+                sizes=(49, 14, 23, 45, 19, 3, 36, 9)):
+    part, ps, sup = t["part"], t["partsupp"], t["supplier"]
+    # good suppliers of THIS partition: supplier is co-partitioned with
+    # partsupp by suppkey, so the NOT IN semi-join is partition-local
+    bad = _like_seq_mask(sup["s_comment"], "Customer", "Complaints")
+    goodk = np.asarray(sup["s_suppkey"])[~bad]
+
+    pb, ptype = _str_col(part, "p_brand"), _str_col(part, "p_type")
+    psz = np.asarray(part["p_size"])
+    pmask = (np.fromiter((v is None or str(v) != brand for v in pb),
+                         bool, count=len(pb))
+             & np.fromiter(
+                 (not (v is not None
+                       and str(v).startswith(type_prefix))
+                  for v in ptype), bool, count=len(ptype))
+             & np.isin(psz, np.asarray(sizes)))
+    pf = pd.DataFrame({"p_partkey": np.asarray(part["p_partkey"])[pmask],
+                       "p_brand": pb[pmask], "p_type": ptype[pmask],
+                       "p_size": psz[pmask]})
+    psdf = pd.DataFrame({"ps_partkey": np.asarray(ps["ps_partkey"]),
+                         "ps_suppkey": np.asarray(ps["ps_suppkey"])})
+    psdf = psdf[psdf["ps_suppkey"].isin(goodk)]
+    j = psdf.merge(pf, left_on="ps_partkey", right_on="p_partkey",
+                   how="inner")
+    # distinct suppliers per group, counted HERE: partitions split by
+    # suppkey, so per-partition distinct sets are disjoint and the
+    # merge may SUM them — the exactness this plan partitions for
+    d = j.drop_duplicates(["p_brand", "p_type", "p_size", "ps_suppkey"])
+    return d.groupby(["p_brand", "p_type", "p_size"], as_index=False,
+                     sort=False).agg(supplier_cnt=("ps_suppkey", "count"))
+
+
+def _q16_merge(partials, **_params):
+    fs = _frames(partials)
+    if not fs:
+        return _empty({"p_brand": object, "p_type": object,
+                       "p_size": np.int64, "supplier_cnt": np.int64})
+    df = pd.concat(fs, ignore_index=True)
+    g = df.groupby(["p_brand", "p_type", "p_size"], as_index=False,
+                   sort=False).agg(supplier_cnt=("supplier_cnt", "sum"))
+    return g.sort_values(
+        ["supplier_cnt", "p_brand", "p_type", "p_size"],
+        ascending=[False, True, True, True], kind="stable",
+        ignore_index=True)[
+        ["p_brand", "p_type", "p_size", "supplier_cnt"]]
+
+
+# ------------------------------------------------------------------ q22
+_Q22_CODES = ("13", "31", "23", "29", "30", "18", "17")
+
+
+def _q22_codes(cust, codes):
+    phone = _str_col(cust, "c_phone")
+    code = np.array([str(v)[:2] for v in phone], dtype=object)
+    return code, np.isin(code, np.asarray(codes, dtype=object))
+
+
+def _q22_phase1(t, codes=_Q22_CODES):
+    cust = t["customer"]
+    _, m = _q22_codes(cust, codes)
+    bal = np.asarray(cust["c_acctbal"])[m]
+    pos = bal[bal > 0.0]
+    return pd.DataFrame({"bal_sum": [float(pos.sum())],
+                         "bal_cnt": [int(len(pos))]})
+
+
+def _q22_merge(partials, **_params):
+    fs = _frames(partials)
+    s = float(sum(float(f["bal_sum"].iloc[0]) for f in fs))
+    c = int(sum(int(f["bal_cnt"].iloc[0]) for f in fs))
+    # no positive-balance customers -> NaN average -> every > avg
+    # comparison False -> empty result, same as the in-core mean
+    avg = (s / c) if c else float("nan")
+    return pd.DataFrame({"avg_bal": [avg]})
+
+
+def _q22_phase2(t, partial1, merged, codes=_Q22_CODES):
+    avg = float(merged["avg_bal"].iloc[0])
+    cust, ords = t["customer"], t["orders"]
+    code, m = _q22_codes(cust, codes)
+    bal = np.asarray(cust["c_acctbal"])
+    cm = m & (bal > avg)
+    cand = pd.DataFrame({"c_custkey": np.asarray(cust["c_custkey"])[cm],
+                         "c_acctbal": bal[cm],
+                         "cntrycode": code[cm]})
+    # NOT EXISTS anti-join is partition-local: orders co-partitioned
+    # by o_custkey with customers by c_custkey
+    idle = cand[~cand["c_custkey"].isin(
+        np.asarray(ords["o_custkey"]))]
+    return idle.groupby("cntrycode", as_index=False, sort=False).agg(
+        numcust=("c_custkey", "count"), totacctbal=("c_acctbal", "sum"))
+
+
+def _q22_reduce(merged, partials2, **_params):
+    fs = _frames(partials2)
+    if not fs:
+        return _empty({"cntrycode": object, "numcust": np.int64,
+                       "totacctbal": np.float64})
+    df = pd.concat(fs, ignore_index=True)
+    g = df.groupby("cntrycode", as_index=False, sort=False).agg(
+        numcust=("numcust", "sum"), totacctbal=("totacctbal", "sum"))
+    return g.sort_values("cntrycode", kind="stable", ignore_index=True)[
+        ["cntrycode", "numcust", "totacctbal"]]
+
+
+PLANS: "dict[str, TwoPhasePlan]" = {
+    "q8": TwoPhasePlan(_q8_phase1, _q8_merge, _passthrough),
+    "q11": TwoPhasePlan(_q11_phase1, _q11_merge, _q11_reduce,
+                        phase2=_q11_phase2),
+    "q14": TwoPhasePlan(_q14_phase1, _q14_merge, _q14_reduce),
+    "q15": TwoPhasePlan(_q15_phase1, _q15_merge, _q15_reduce,
+                        phase2=_q15_phase2),
+    "q16": TwoPhasePlan(_q16_phase1, _q16_merge, _passthrough),
+    "q22": TwoPhasePlan(_q22_phase1, _q22_merge, _q22_reduce,
+                        phase2=_q22_phase2),
+}

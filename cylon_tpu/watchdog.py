@@ -110,6 +110,13 @@ SECTIONS: "dict[str, bool]" = {
     # possibly-dying HTTP endpoint, and the router's whole failure
     # model is "retry, then declare the engine dead"
     "router_poll": True,
+    # the two-phase fallback's global merge (cylon_tpu.fallback):
+    # the blocking scalar between the partial pass and the apply
+    # pass — never retryable on its own: the merge is deterministic
+    # host compute over durable partials, so a deadline there means
+    # the partials (or the journal write) are wedged, and a blind
+    # re-merge would just wedge again; resume via the checkpoint
+    "fallback_merge": False,
 }
 
 # the retryability registry here and the budget-defaults registry in

@@ -535,18 +535,25 @@ def test_trace_record_schema_pins_dropped_count():
 
 # ------------------------------------------- spill-fallback guards
 def test_fallback_manifest_covers_every_query():
-    """ISSUE 10 satellite: every TPC-H query has a FALLBACK entry whose
+    """ISSUE 10 satellite, tightened by ISSUE 16: every TPC-H query has
+    a FALLBACK entry with a NON-None plan (the ``why`` escape hatch is
+    retired — no query is allowed to be non-decomposable), whose
     partition plan is consistent with the projection manifest — the
     partitioned tables are tables the query reads, and every partition
     key survives the manifest-pruned ingest (a dropped key would make
     the spill path KeyError at scale, invisibly at test SF)."""
     from cylon_tpu.tpch.manifest import FALLBACK, MANIFEST
+    from cylon_tpu.tpch.twophase import PLANS
 
     assert set(FALLBACK) == set(MANIFEST), (
         "FALLBACK and MANIFEST must cover the same 22 queries")
-    kinds = {"concat", "groupby", "sum", None}
+    kinds = {"concat", "groupby", "sum", "twophase"}
     for q, spec in FALLBACK.items():
-        assert spec.get("merge") in kinds, (q, spec.get("merge"))
+        assert spec.get("merge") in kinds, (
+            f"{q}: merge {spec.get('merge')!r} — every query must "
+            "carry a real plan (None retired by ISSUE 16)")
+        assert "why" not in spec, (
+            f"{q}: the 'why' non-decomposable escape hatch is retired")
         assert spec.get("partition"), f"{q}: no partition plan"
         for table, key in spec["partition"].items():
             assert table in MANIFEST[q], (
@@ -564,12 +571,17 @@ def test_fallback_manifest_covers_every_query():
                     assert kind == "wmean" and weight in spec["aggs"]
                 else:
                     assert how in ("sum", "min", "max"), (q, col, how)
-        if spec["merge"] is None:
-            assert spec.get("why"), (
-                f"{q}: an unsupported plan must name its blocker")
+        if spec["merge"] == "twophase":
+            assert q in PLANS, (
+                f"{q}: merge='twophase' but tpch.twophase.PLANS has "
+                "no entry — tpch_fallback would die at run time")
         if spec.get("sort"):
             asc = spec.get("ascending")
             assert asc is None or len(asc) == len(spec["sort"]), q
+    # and the executor agrees: all 22 are supported end to end
+    from cylon_tpu.fallback import supports
+
+    assert all(supports(q) for q in FALLBACK)
 
 
 def test_serve_replay_queries_have_usable_fallback():
@@ -587,10 +599,32 @@ def test_serve_replay_queries_have_usable_fallback():
 
 def test_required_bench_keys_pin_fallback_counter():
     """ISSUE 10 satellite: ooc.fallbacks rides every bench record's
-    metrics block, so the trajectory shows WHICH runs degraded."""
+    metrics block, so the trajectory shows WHICH runs degraded.
+    ISSUE 16 adds the two-phase accounting: merge phases run and
+    checkpoint units resumed (the ``op=fallback_merge`` label rides
+    the summed counter) are pinned alongside."""
     from cylon_tpu.telemetry import REQUIRED_BENCH_KEYS
 
-    assert "ooc.fallbacks" in REQUIRED_BENCH_KEYS
+    assert {"ooc.fallbacks", "ooc.merge_phases",
+            "ooc.units_resumed"} <= set(REQUIRED_BENCH_KEYS)
+
+
+def test_scale_race_legs_pinned():
+    """ISSUE 16 satellite: the three at-scale race configs the paper's
+    claim is about (SF10 full suite, the 1B-row join, SF100 Q3/Q5) are
+    named bench_suite legs, each pinning the single-chip HBM ceiling
+    so in_core-vs-ooc_fallback routing matches the real chip."""
+    import bench_suite
+
+    legs = dict(bench_suite.SCALE_LEGS)
+    assert set(legs) == {"tpch_sf10_full", "join_1b",
+                         "tpch_sf100_q3q5"}
+    assert legs["tpch_sf10_full"]["CYLON_BENCH_TPCH_SF"] == "10"
+    assert legs["join_1b"]["CYLON_BENCH_ROWS"] == "1000000000"
+    assert legs["tpch_sf100_q3q5"]["CYLON_BENCH_TPCH_QUERIES"] == "q3,q5"
+    for name, env in legs.items():
+        assert int(env["CYLON_TPU_HBM_BUDGET_BYTES"]) == 16 * 2**30, (
+            f"{name}: the race must pin the v5e 16 GiB ceiling")
 
 
 def test_profile_schema_pins_degradation_columns():

@@ -11,7 +11,14 @@ router failing over. Proven:
 * the dead engine's journaled-but-incomplete requests replayed on the
   surviving peer exactly once, and an idempotent retry that lands
   after the failover does not double-execute (cross-journal
-  done-line audit == 0 doubles).
+  done-line audit == 0 doubles);
+* (ISSUE 16) the mix carries a TWO-PHASE global-aggregate query
+  (q14), the survivor runs under ``CHAOS_OOM`` so every dispatch
+  degrades through its registered two-phase spill fallback, and the
+  replayed q14 request completes on the survivor with its merge
+  scalar RECOMPUTED there (``merge_phase`` events in the survivor's
+  journal) — never trusted from the dead engine's journal (which has
+  no done line for it).
 """
 
 import time
@@ -28,7 +35,7 @@ from cylon_tpu.serve.fleet import (FleetLayout, FleetRouter,
                                    audit_double_executions,
                                    spawn_engine)
 
-MIX = ("q1", "q6")
+MIX = ("q1", "q6", "q14")  # q14: two-phase global aggregate (ISSUE 16)
 SF, SEED = 0.001, 0
 
 
@@ -77,8 +84,14 @@ def test_kill_one_engine_mid_tpch_run_loses_nothing(tmp_path):
         f0 = ex.submit(spawn_engine, root, "e0", SF, SEED, MIX,
                        {"JAX_PLATFORMS": "cpu",
                         "CHAOS_KILL": "plan:2"})
+        # the SURVIVOR exhausts memory on every compiled dispatch:
+        # each of its completions — including the dead engine's
+        # replayed requests — must degrade through the registered
+        # spill fallback (q14's is the two-phase plan, so its merge
+        # scalar is recomputed on THIS engine)
         f1 = ex.submit(spawn_engine, root, "e1", SF, SEED, MIX,
-                       {"JAX_PLATFORMS": "cpu"})
+                       {"JAX_PLATFORMS": "cpu",
+                        "CHAOS_OOM": "plan:1"})
         p0, p1 = f0.result(), f1.result()
     router = FleetRouter([p0.client, p1.client], poll_interval=0.2,
                          fail_threshold=3, unhealthy_dwell=2.0)
@@ -146,6 +159,32 @@ def test_kill_one_engine_mid_tpch_run_loses_nothing(tmp_path):
                    and e.get("state") == "done"}
         for rk in rep["replayed_keys"]:
             assert rk in done_e1, (rk, done_e1)
+
+        # ISSUE 16: a replayed TWO-PHASE request completed on the
+        # survivor with the merge scalar RECOMPUTED there. e0 died
+        # on its 2nd dispatch (a q1 — each tenant submits q1 first),
+        # so both of its tenants' q14 requests were journaled but
+        # incomplete: they must be in the replayed set, absent from
+        # the dead engine's done lines, and — because every e1
+        # dispatch OOMs into the two-phase fallback — covered by
+        # `merge_phase` events in the survivor's journal.
+        key_q = {key: q for key, q, _ in tickets}
+        replayed_q14 = [k for k in rep["replayed_keys"]
+                        if key_q.get(k) == "q14"]
+        assert replayed_q14, (rep["replayed_keys"], key_q)
+        done_e0 = {e.get("key") for e in
+                   RequestJournal.read(lay.engine_dir("e0"))
+                   if e["kind"] == "done"
+                   and e.get("state") == "done"}
+        assert not set(replayed_q14) & done_e0, (replayed_q14,
+                                                 done_e0)
+        merge_evts = [e for e in p1.client.events_since(0)["events"]
+                      if e["kind"] == "merge_phase"
+                      and e.get("op") == "q14"]
+        q14_done_e1 = [k for k in done_e1 if key_q.get(k) == "q14"]
+        assert set(replayed_q14) <= set(q14_done_e1)
+        assert len(merge_evts) >= len(q14_done_e1) >= 1, (
+            merge_evts, q14_done_e1)
     finally:
         router.close()
         p1.terminate()
