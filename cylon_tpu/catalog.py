@@ -12,6 +12,20 @@ queries :func:`pin` them for their lifetime (refcounted per holder),
 :func:`drop` refuses pinned tables with a
 :class:`~cylon_tpu.errors.FailedPrecondition` naming the holders, and
 :func:`stats` reports per-table rows/bytes/pins.
+
+Since the views subsystem (:mod:`cylon_tpu.views`), resident tables
+are also **appendable and versioned**: :func:`append` folds a host
+delta frame into a registered table under an ATOMIC swap (a concurrent
+reader holds the old :class:`~cylon_tpu.table.Table` object and never
+observes a half-applied delta), every mutation bumps a **monotone
+generation number**, and :func:`table_version` exposes
+``{generation, digest}`` where the digest is the content fingerprint
+the fallback layer already uses to guard broadcast inputs
+(:func:`cylon_tpu.fallback._cols_fingerprint`). Appended deltas are
+retained in a bounded per-table log (:func:`deltas_since`) so a
+materialized view can refresh from exactly the rows it has not applied
+yet — and a watermark older than the retention window answers ``None``
+(full recompute), never a silently truncated delta.
 """
 
 import collections
@@ -31,6 +45,24 @@ _catalog: dict[str, Table] = {}
 #: fails loudly at the drop site (naming the holders) instead of as a
 #: confusing late KeyError inside whichever query lost the race.
 _pins: "dict[str, collections.Counter]" = {}
+#: table id -> {"generation": int, "digest": str | None}. Every
+#: registration/append bumps the monotone generation; the content
+#: digest is computed LAZILY (first :func:`table_version` call per
+#: generation) because it hashes the table's host bytes.
+_versions: "dict[str, dict]" = {}
+#: table id -> [(generation, host pandas delta frame)] — the bounded
+#: delta log :func:`deltas_since` serves incremental view refreshes
+#: from (newest ``CYLON_TPU_CATALOG_DELTA_KEEP`` appends retained).
+_deltas: "dict[str, list]" = {}
+#: append listeners: ``cb(table_id, generation)`` after every
+#: successful append — how the views layer invalidates result memos
+#: keyed on the now-stale version without catalog importing views.
+_append_listeners: list = []
+#: serializes whole append operations (host gather + concat + swap);
+#: the swap itself still happens under ``_lock``.
+_append_mu = threading.Lock()
+
+DEFAULT_DELTA_KEEP = 64
 
 
 def put_table(table_id: str, table: Table) -> None:
@@ -42,6 +74,19 @@ def put_table(table_id: str, table: Table) -> None:
     with _lock:
         _require_unpinned(table_id, "overwrite")
         _catalog[table_id] = table
+        _bump_version_locked(table_id)
+        # a full overwrite restarts delta history: nothing in the old
+        # log describes the new content, so views must full-recompute
+        _deltas.pop(table_id, None)
+
+
+def _bump_version_locked(table_id: str) -> int:
+    """Advance ``table_id``'s monotone generation (digest recomputes
+    lazily). Caller holds ``_lock``. Returns the new generation."""
+    ent = _versions.get(table_id)
+    gen = (int(ent["generation"]) + 1) if ent else 1
+    _versions[table_id] = {"generation": gen, "digest": None}
+    return gen
 
 
 def get_table(table_id: str, pin_for: "str | None" = None) -> Table:
@@ -122,6 +167,8 @@ def drop(table_id: str, *, if_exists: bool = True) -> None:
             raise KeyError_(f"no table registered under {table_id!r}")
         _require_unpinned(table_id, "drop")
         del _catalog[table_id]
+        _versions.pop(table_id, None)
+        _deltas.pop(table_id, None)
 
 
 def remove_table(table_id: str) -> None:
@@ -186,6 +233,17 @@ def stats() -> "dict[str, dict]":
             rows = int(np.asarray(t.nrows).sum())
         except Exception:
             rows = None
+        try:
+            version = table_version(tid)
+        except Exception:
+            # racing drop, or a table whose bytes are not
+            # host-reachable (e.g. under trace) — report the
+            # generation without a digest rather than failing stats
+            with _lock:
+                ent = _versions.get(tid) or {"generation": 1,
+                                             "digest": None}
+            version = {"generation": int(ent["generation"]),
+                       "digest": ent["digest"]}
         holders = pin_view.get(tid, {})
         out[tid] = {
             "rows": rows,
@@ -196,6 +254,10 @@ def stats() -> "dict[str, dict]":
             "distributed": bool(dtable.is_distributed(t)),
             "pins": sum(holders.values()),
             "holders": sorted(holders),
+            # the version column (views subsystem): monotone
+            # generation + content digest — what /tables shows and the
+            # result-cache layers key invalidation on
+            "version": version,
         }
     return out
 
@@ -206,6 +268,222 @@ def clear() -> None:
     with _lock:
         _catalog.clear()
         _pins.clear()
+        _versions.clear()
+        _deltas.clear()
+
+
+# -------------------------------------------------- versioned appends
+def _table_digest(table: Table) -> str:
+    """Content digest of a resident table — the SAME fingerprint the
+    resumable fallback uses to guard changed broadcast inputs
+    (:func:`cylon_tpu.fallback._cols_fingerprint`). Local tables hash
+    their trimmed host content (two tables with identical logical rows
+    digest identically regardless of capacity padding); distributed
+    tables hash the raw shard buffers plus the per-shard row counts
+    (no env is available here to gather, and any append changes the
+    buffers, which is what versioning needs)."""
+    import numpy as np
+
+    from cylon_tpu.fallback import _cols_fingerprint
+    from cylon_tpu.parallel import dtable
+
+    if dtable.is_distributed(table):
+        cols = {name: np.asarray(c.data)
+                for name, c in table.columns.items()}
+        cols["__nrows__"] = np.asarray(table.nrows)
+        return _cols_fingerprint(cols)
+    pdf = table.to_pandas()
+    return _cols_fingerprint({c: pdf[c].to_numpy() for c in pdf.columns})
+
+
+def generation(table_id: str) -> int:
+    """The table's monotone generation number — one cheap dict read,
+    no digest computation (the hot accessor view refreshes and
+    generation-consistent serve reads poll)."""
+    with _lock:
+        if table_id not in _catalog:
+            raise KeyError_(f"no table registered under {table_id!r}")
+        ent = _versions.get(table_id)
+        return int(ent["generation"]) if ent else 1
+
+
+def table_version(table_id: str) -> dict:
+    """``{"generation": int, "digest": str}`` for a resident table.
+    The digest is computed lazily (it hashes the table's host bytes)
+    and cached per generation — repeated calls between mutations are
+    one dict read."""
+    with _lock:
+        if table_id not in _catalog:
+            raise KeyError_(f"no table registered under {table_id!r}")
+        t = _catalog[table_id]
+        ent = _versions.setdefault(
+            table_id, {"generation": 1, "digest": None})
+        gen, digest = int(ent["generation"]), ent["digest"]
+    if digest is None:
+        digest = _table_digest(t)
+        with _lock:
+            cur = _versions.get(table_id)
+            # only cache onto the generation we hashed — a racing
+            # append's newer generation must not inherit a stale digest
+            if cur is not None and int(cur["generation"]) == gen:
+                cur["digest"] = digest
+    return {"generation": gen, "digest": digest}
+
+
+def restore_version(table_id: str, gen: int) -> None:
+    """Reinstate a table's generation after a snapshot restore
+    (:meth:`cylon_tpu.serve.ServeEngine.recover`): the recovered
+    process must serve the POST-append generation the snapshot was
+    taken at, not restart at 1 and silently alias the pre-append
+    version."""
+    with _lock:
+        if table_id not in _catalog:
+            raise KeyError_(f"no table registered under {table_id!r}")
+        _versions[table_id] = {"generation": max(int(gen), 1),
+                               "digest": None}
+
+
+def _as_host_frame(delta):
+    """Normalize an append delta (pandas frame, cylon DataFrame/Table,
+    or a {col: array} mapping) to a host pandas frame."""
+    import numpy as np
+    import pandas as pd
+
+    if isinstance(delta, pd.DataFrame):
+        return delta.reset_index(drop=True)
+    t = getattr(delta, "table", delta)
+    if isinstance(t, Table):
+        return t.to_pandas().reset_index(drop=True)
+    if isinstance(delta, Mapping):
+        return pd.DataFrame({k: np.asarray(v) for k, v in delta.items()})
+    raise InvalidArgument(
+        f"cannot append a {type(delta).__name__}: pass a pandas frame, "
+        "a DataFrame/Table, or a column mapping")
+
+
+def _delta_keep() -> int:
+    import os
+
+    try:
+        return int(os.environ.get("CYLON_TPU_CATALOG_DELTA_KEEP",
+                                  str(DEFAULT_DELTA_KEEP)))
+    except ValueError:
+        return DEFAULT_DELTA_KEEP
+
+
+def on_append(cb) -> None:
+    """Register ``cb(table_id, generation)`` to run after every
+    successful :func:`append` — the invalidation hook the views layer
+    uses to evict memos keyed on the now-stale version. Callbacks run
+    outside the catalog locks; exceptions are swallowed (an observer
+    must never fail a mutation)."""
+    _append_listeners.append(cb)
+
+
+def append(table_id: str, delta, *, env=None) -> dict:
+    """Fold ``delta`` rows into resident table ``table_id`` under an
+    atomic swap, bumping its generation.
+
+    Unlike :func:`put_table`'s overwrite, append is legal while the
+    table is PINNED: an in-flight reader holds the old
+    :class:`~cylon_tpu.table.Table` object, which is immutable — it
+    finishes against the generation it started on and never observes a
+    half-applied delta. The swap publishes the merged table and the
+    new generation in one ``_lock`` hold.
+
+    ``delta`` may be a pandas frame, a DataFrame/Table, or a
+    ``{col: array}`` mapping; its columns must match the resident
+    schema. Distributed targets need ``env=`` (gather → concat →
+    re-scatter). The host delta is retained in the bounded per-table
+    log (:func:`deltas_since`) for incremental view refresh. Returns
+    ``{"generation", "delta_rows", "rows"}``.
+    """
+    import pandas as pd
+
+    from cylon_tpu import telemetry
+    from cylon_tpu.parallel import dtable
+    from cylon_tpu.telemetry import events as _events
+
+    pdf = _as_host_frame(delta)
+    with _append_mu:
+        with _lock:
+            if table_id not in _catalog:
+                raise KeyError_(
+                    f"no table registered under {table_id!r}")
+            cur = _catalog[table_id]
+        distributed = bool(dtable.is_distributed(cur))
+        if distributed:
+            if env is None:
+                raise InvalidArgument(
+                    f"append to distributed table {table_id!r} needs "
+                    "env= (gather + re-scatter run on the mesh)")
+            from cylon_tpu.parallel import dist_to_pandas
+
+            base = dist_to_pandas(env, cur)
+        else:
+            base = cur.to_pandas()
+        if set(pdf.columns) != set(base.columns):
+            raise InvalidArgument(
+                f"append({table_id!r}): delta columns "
+                f"{sorted(pdf.columns)} != resident schema "
+                f"{sorted(base.columns)}")
+        pdf = pdf[list(base.columns)]
+        merged = (pd.concat([base, pdf], ignore_index=True)
+                  if len(pdf) else base)
+        new = Table.from_pydict(
+            {c: merged[c].to_numpy() for c in merged.columns},
+            capacity=None if len(merged) else 1)
+        if distributed:
+            from cylon_tpu.parallel import scatter_table
+
+            new = scatter_table(env, new)
+        # the build above happened OUTSIDE _lock (readers kept going);
+        # the swap itself is one lock hold: table, generation and the
+        # delta-log entry publish together
+        with _lock:
+            if table_id not in _catalog:
+                raise KeyError_(
+                    f"table {table_id!r} dropped during append")
+            _catalog[table_id] = new
+            gen = _bump_version_locked(table_id)
+            log = _deltas.setdefault(table_id, [])
+            log.append((gen, pdf.reset_index(drop=True)))
+            keep = _delta_keep()
+            if keep >= 0 and len(log) > keep:
+                del log[:len(log) - keep]
+    telemetry.counter("catalog.appends", table=table_id).inc()
+    _events.emit("append", table=table_id, generation=gen,
+                 delta_rows=int(len(pdf)))
+    for cb in list(_append_listeners):
+        try:
+            cb(table_id, gen)
+        except Exception:  # pragma: no cover - observer must not fail
+            pass
+    return {"generation": gen, "delta_rows": int(len(pdf)),
+            "rows": int(len(merged))}
+
+
+def deltas_since(table_id: str, gen: int) -> "list | None":
+    """Host delta frames appended after generation ``gen``, oldest
+    first — the exact rows a view at watermark ``gen`` has not applied
+    yet. Returns ``[]`` when the watermark is current, and ``None``
+    when the retention window (or an intervening full
+    :func:`put_table` overwrite) no longer covers the span — the
+    caller must full-recompute, never silently under-apply."""
+    with _lock:
+        if table_id not in _catalog:
+            raise KeyError_(f"no table registered under {table_id!r}")
+        ent = _versions.get(table_id)
+        cur = int(ent["generation"]) if ent else 1
+        log = list(_deltas.get(table_id, ()))
+    gen = int(gen)
+    if gen >= cur:
+        return []
+    got = {g: f for g, f in log}
+    want = range(gen + 1, cur + 1)
+    if any(g not in got for g in want):
+        return None
+    return [got[g] for g in want]
 
 
 # ---------------------------------------------------------------- id ops

@@ -445,6 +445,19 @@ class CompiledQuery:
             lambda buckets, out: _apply_buckets(out, buckets),
             static_argnums=0)
 
+    def invalidate(self) -> None:
+        """Drop the scale/shape/size memos (one lock hold). The views
+        layer calls this through ``query_fn.invalidate()`` when a
+        source table's generation advances: the memos key on buffer
+        shapes, and an append that grows a table past its pow2
+        capacity bucket would otherwise replay a stale size memo.
+        jax's executable cache is untouched — identical shapes recompile
+        for free; only the bookkeeping resets."""
+        with self._mu:
+            self._scale_memo.clear()
+            self._compiled.clear()
+            self._size_memo.clear()
+
     def __call__(self, *args, **kwargs):
         import numpy as np
 

@@ -23,6 +23,15 @@ Knobs: ``--requests`` per client (default 2), ``--sf`` scale factor
 (default 0.002), ``--schedule roundrobin|priority``, ``--slo`` seconds
 (default unbounded), ``--max-queue``, ``--seed``, plus the
 ``CYLON_TPU_SERVE_*`` env family (``docs/serving.md``).
+
+``--refresh`` runs the incremental-view leg instead (ISSUE 18,
+``docs/views.md``): RF1-style append rounds (``--appends``,
+``--delta-sf``) interleaved with concurrent ``read_view`` readers
+against registered q1/q3/q5/q6 materialized views — every read audited
+post-hoc against a pinned-generation oracle — emitting one record
+pinned by :data:`REQUIRED_REFRESH_FIELDS` (incremental refresh wall vs
+full-recompute wall, gated ``speedup >= 2``, ``oracle_mismatches``
+gated 0).
 """
 
 import argparse
@@ -72,12 +81,34 @@ REQUIRED_FLEET_FIELDS = frozenset({
     "errors", "p99_before_s", "p99_during_s", "p99_after_s",
 })
 
+#: refresh-record fields (ISSUE 18): the ``--refresh`` acceptance is
+#: only auditable if every record pins the incremental-refresh wall
+#: against the from-scratch recompute wall (their ratio is the
+#: ``speedup`` the acceptance gates at >= 2x), the generation lag the
+#: concurrent readers observed, and the oracle audit (MUST be 0
+#: mismatches). ``tests/test_bench_guard.py`` pins the set; main()
+#: asserts it before emitting.
+REQUIRED_REFRESH_FIELDS = frozenset({
+    "metric", "sf", "delta_sf", "views", "appends", "refreshes",
+    "delta_rows_total", "refresh_wall_s", "recompute_wall_s",
+    "speedup", "generation_lag", "oracle_mismatches", "reads_total",
+    "errors",
+})
+
 #: default mixed workload: groupby-heavy scan, 3-way join + top-k,
 #: 6-way join, a scalar aggregate, and a two-phase global aggregate
 #: (q14's promo ratio needs a global merge scalar — its spill path is
 #: the ISSUE 16 two-phase plan) — five distinct shapes so the schedule
 #: interleaves genuinely different pipelines
 DEFAULT_MIX = ("q1", "q3", "q5", "q6", "q14")
+
+#: the ``--refresh`` workload (ISSUE 18): the four mix shapes whose
+#: fallback merge is directly view-maintainable — groupby+wmean (q1),
+#: concat+resort top-k (q3), associative groupby (q5), scalar sum
+#: (q6). Two-phase views keep a phase-1 partial as state and need a
+#: partial-returning query fn — they ride tests/test_views.py, not
+#: this leg.
+REFRESH_MIX = ("q1", "q3", "q5", "q6")
 
 
 def _emit_record(line: dict):
@@ -469,6 +500,261 @@ def run_bench(clients: int = 8, requests: int = 2, sf: float = 0.002,
     return record
 
 
+def _refresh_keep(mix) -> dict:
+    """Per-table column keep-sets for the refresh workload: the union
+    of the mix's manifests plus the order keys the RF1 append stream
+    offsets — SF1 stays host-feasible because unreferenced columns
+    (the wide comment strings above all) never generate."""
+    from cylon_tpu.tpch.manifest import MANIFEST
+
+    keep: dict = {}
+    for q in mix:
+        for t, cols in MANIFEST[q].items():
+            keep.setdefault(t, set()).update(cols)
+    keep.setdefault("orders", set()).add("o_orderkey")
+    keep.setdefault("lineitem", set()).add("l_orderkey")
+    return {t: frozenset(c) for t, c in keep.items()}
+
+
+def _mk_view_query(q):
+    """The view query fn for one mix query: the engine's partitioned
+    EAGER fallback over whatever tables it is handed — the same
+    execution path for the delta run, the initial materialization and
+    the from-scratch oracle, so the refresh-vs-recompute walls compare
+    like with like. Small inputs (a delta) skip the partition split."""
+    from cylon_tpu import fallback
+
+    def qf(tables):
+        data = {name: {c: df[c].to_numpy() for c in df.columns}
+                for name, df in tables.items()}
+        li = data.get("lineitem")
+        rows = len(next(iter(li.values()))) if li else 0
+        return fallback.tpch_fallback(
+            q, data, compiled=False,
+            n_partitions=1 if rows < 100_000 else None)
+
+    return qf
+
+
+def run_refresh_bench(sf: float = 0.05, delta_sf: "float | None" = None,
+                      rounds: int = 2, clients: int = 4, seed: int = 0,
+                      mix=REFRESH_MIX) -> dict:
+    """The ISSUE 18 acceptance harness: TPC-H RF1-style appends (new
+    orders arriving WITH their lineitems — join-closed by
+    construction) interleaved with the q1/q3/q5/q6 mix served as
+    incremental materialized views.
+
+    Per round: one key-offset dbgen delta appends to the resident
+    ``orders`` and ``lineitem`` tables (generation bumps), every view
+    refreshes INCREMENTALLY (query over the delta + combiner merge,
+    timed), and a from-scratch recompute at the same pinned
+    generations runs as the oracle (timed — the denominator of
+    ``speedup``). ``clients`` reader threads hammer
+    ``engine.read_view`` throughout; every read's
+    ``(generations, result)`` pair is verified post-hoc against the
+    from-scratch oracle at exactly those generations — the
+    generation-consistency proof (``oracle_mismatches`` MUST be 0).
+    """
+    import pandas as pd
+
+    import cylon_tpu as ct
+    from cylon_tpu import tpch, views, watchdog
+    from cylon_tpu.fallback import _resolve_limit
+    from cylon_tpu.serve import ServeEngine
+    from cylon_tpu.tpch import dbgen
+    from cylon_tpu.tpch.manifest import FALLBACK, MANIFEST
+
+    if delta_sf is None:
+        delta_sf = max(sf / 100.0, 1e-4)
+    keep = _refresh_keep(mix)
+    env = ct.CylonEnv(ct.TPUConfig())
+    base = dbgen.generate(sf, seed, keep=keep)
+    # resident tables stay LOCAL (host-backed Tables): each RF1 append
+    # rebuilds the table host-side, and the eager fallback gathers to
+    # host anyway — a per-round mesh re-scatter would only add noise
+    # to the walls being compared
+    resident = tpch.ingest(base)
+    engine = ServeEngine(env)
+    for name, df in resident.items():
+        engine.register_table(f"tpch/{name}", df)
+
+    query_fns = {q: _mk_view_query(q) for q in mix}
+    limits = {}
+    for q in mix:
+        spec = FALLBACK[q]
+        if spec["merge"] == "twophase":
+            from cylon_tpu.errors import InvalidArgument
+
+            raise InvalidArgument(
+                f"--refresh mix cannot include two-phase query {q!r}: "
+                "its view state is a phase-1 partial, which needs a "
+                "partial-returning query fn (see tests/test_views.py);"
+                f" maintainable here: {REFRESH_MIX}")
+        limits[q] = _resolve_limit(getattr(tpch, q), spec, {})
+        engine.register_view(
+            f"view/{q}", query_fns[q], spec,
+            sources={t: f"tpch/{t}" for t in MANIFEST[q]},
+            delta_source="lineitem", limit=limits[q])
+
+    # the bench-side delta history: content at ANY generation rebuilds
+    # as base + deltas[:gen-1] — what the oracle recomputes from
+    host_frames = {t: df.to_pandas() for t, df in resident.items()}
+    delta_hist: "dict[str, list]" = {"orders": [], "lineitem": []}
+    n_base_ord = int(len(host_frames["orders"]))
+
+    def content_at(tname: str, gen: int):
+        hist = delta_hist.get(tname, ())
+        parts = [host_frames[tname]] + list(hist[:max(gen - 1, 0)])
+        return (parts[0] if len(parts) == 1
+                else pd.concat(parts, ignore_index=True))
+
+    oracle_cache: dict = {}
+    oracle_mu = threading.Lock()
+
+    def oracle_for(q: str, gens: dict):
+        """(result, wall_s, fresh) of the from-scratch recompute at
+        exactly ``gens`` — cached per pinned-generation combo."""
+        combo = tuple(sorted(gens.items()))
+        with oracle_mu:
+            hit = oracle_cache.get((q, combo))
+        if hit is not None:
+            return hit[0], hit[1], False
+        # view generations are keyed by query ALIAS (== the TPC-H
+        # table name here; the catalog id is tpch/<alias>)
+        tabs = {a: content_at(a, g) for a, g in gens.items()}
+        t0 = time.perf_counter()
+        out = query_fns[q](tabs)
+        wall = time.perf_counter() - t0
+        res = views.present(out, FALLBACK[q], limits[q])
+        with oracle_mu:
+            oracle_cache[(q, combo)] = (res, wall)
+        return res, wall, True
+
+    refresh_walls = {q: 0.0 for q in mix}
+    recompute_walls = {q: 0.0 for q in mix}
+    mismatches: list = []
+    errors: list = []
+    samples: list = []  # (q, generations, result, lag)
+    lock = threading.Lock()
+    stop_readers = threading.Event()
+    refreshes = [0]
+    full_recomputes = [0]
+    delta_rows_total = [0]
+
+    def reader(i: int):
+        while not stop_readers.is_set():
+            for q in mix:
+                try:
+                    r = engine.read_view(f"view/{q}")
+                except Exception as e:
+                    with lock:
+                        errors.append((f"read view/{q}",
+                                       f"{type(e).__name__}: {e}"))
+                    continue
+                with lock:
+                    samples.append((q, dict(r["generations"]),
+                                    r["result"], int(r["lag"])))
+            time.sleep(0.01)
+
+    t0 = time.perf_counter()
+    with watchdog.watched_section("serve_request",
+                                  detail="refresh_bench"):
+        threads = [threading.Thread(target=reader, args=(i,),
+                                    name=f"refresh-reader-{i}")
+                   for i in range(clients)]
+        for th in threads:
+            th.start()
+        try:
+            for r in range(rounds):
+                d = dbgen.generate(delta_sf, seed + 1 + r, keep=keep)
+                # RF1 key offset: this round's new orders (and their
+                # lineitems) land in a key range disjoint from the
+                # base AND every other round; dimension keys
+                # (custkey/suppkey/partkey) stay inside the base
+                # ranges because delta_sf < sf
+                n_d = int(len(d["orders"]["o_orderkey"]))
+                off = n_base_ord + r * n_d
+                d["orders"]["o_orderkey"] = (
+                    d["orders"]["o_orderkey"] + off)
+                d["lineitem"]["l_orderkey"] = (
+                    d["lineitem"]["l_orderkey"] + off)
+                for t in ("orders", "lineitem"):
+                    engine.append_table(f"tpch/{t}", d[t])
+                    delta_hist[t].append(pd.DataFrame(
+                        {c: np.asarray(v) for c, v in d[t].items()}))
+                delta_rows_total[0] += int(
+                    len(d["lineitem"]["l_orderkey"]))
+                for q in mix:
+                    out = engine.refresh_view(f"view/{q}")
+                    refreshes[0] += 1
+                    refresh_walls[q] += out["wall_s"]
+                    if out["full_recompute"]:
+                        full_recomputes[0] += 1
+                    want, wall, fresh = oracle_for(
+                        q, out["generations"])
+                    if fresh:
+                        recompute_walls[q] += wall
+                    got = engine.read_view(f"view/{q}")
+                    if (got["generations"] == out["generations"]
+                            and not _results_match(got["result"],
+                                                   want)):
+                        mismatches.append(
+                            (q, dict(out["generations"]),
+                             "post-refresh mismatch"))
+        finally:
+            stop_readers.set()
+            for th in threads:
+                th.join()
+    wall = time.perf_counter() - t0
+
+    # post-hoc audit: EVERY concurrent read must equal the
+    # from-scratch recompute at its pinned generations (distinct
+    # combos are few — state only changes under refresh — so the
+    # oracle cache absorbs the volume)
+    lag_max = 0
+    for q, gens, result, lag in samples:
+        lag_max = max(lag_max, lag)
+        want, _, _ = oracle_for(q, gens)
+        if not _results_match(result, want):
+            mismatches.append((q, gens, "concurrent read mismatch"))
+    engine.close(wait=True)
+
+    refresh_wall = sum(refresh_walls.values())
+    recompute_wall = sum(recompute_walls.values())
+    record = {
+        "metric": "refresh_bench_tpch_rf1",
+        "sf": sf,
+        "delta_sf": delta_sf,
+        "rounds": rounds,
+        "clients": clients,
+        "views": [f"view/{q}" for q in mix],
+        # one RF1 round appends to BOTH orders and lineitem
+        "appends": rounds * 2,
+        "refreshes": refreshes[0],
+        "full_recomputes": full_recomputes[0],
+        "delta_rows_total": delta_rows_total[0],
+        "refresh_wall_s": round(refresh_wall, 4),
+        "recompute_wall_s": round(recompute_wall, 4),
+        "speedup": (round(recompute_wall / refresh_wall, 2)
+                    if refresh_wall > 0 else None),
+        "per_view": {q: {
+            "refresh_wall_s": round(refresh_walls[q], 4),
+            "recompute_wall_s": round(recompute_walls[q], 4),
+            "speedup": (round(recompute_walls[q] / refresh_walls[q], 2)
+                        if refresh_walls[q] > 0 else None),
+        } for q in mix},
+        "generation_lag": lag_max,
+        "reads_total": len(samples),
+        "oracle_mismatches": len(mismatches),
+        "mismatch_detail": mismatches[:8],
+        "errors": len(errors),
+        "error_detail": errors[:8],
+        "wall_s": round(wall, 3),
+        "view_stats": engine.view_stats(),
+    }
+    return record
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--clients", type=int, default=8)
@@ -481,8 +767,10 @@ def main(argv=None):
                    help="per-request SLO seconds (0 = unbounded)")
     p.add_argument("--max-queue", type=int, default=None)
     p.add_argument("--seed", type=int, default=0)
-    p.add_argument("--mix", default=",".join(DEFAULT_MIX),
-                   help="comma-separated TPC-H query names")
+    p.add_argument("--mix", default=None,
+                   help="comma-separated TPC-H query names (default: "
+                        f"{','.join(DEFAULT_MIX)}; --refresh default: "
+                        f"{','.join(REFRESH_MIX)})")
     p.add_argument("--slo-target", type=float, default=0.0,
                    help="per-tenant success objective for burn-rate "
                         "accounting (e.g. 0.99; 0 = policy/env default)")
@@ -504,7 +792,38 @@ def main(argv=None):
                    help="engine process count for --fleet (>= 2)")
     p.add_argument("--no-kill", action="store_true",
                    help="--fleet without the mid-run kill (baseline)")
+    p.add_argument("--refresh", action="store_true",
+                   help="incremental-view mode (ISSUE 18): drive "
+                        "TPC-H RF1-style appends interleaved with the "
+                        "mix served as materialized views, and gate "
+                        "on refresh wall <= 0.5x the from-scratch "
+                        "recompute wall with 0 oracle mismatches on "
+                        "concurrent generation-pinned reads")
+    p.add_argument("--appends", type=int, default=2,
+                   help="RF1 append rounds for --refresh")
+    p.add_argument("--delta-sf", type=float, default=0.0,
+                   help="scale factor of each RF1 delta (0 = sf/100)")
     args = p.parse_args(argv)
+    mix_arg = (tuple(q.strip() for q in args.mix.split(",")
+                     if q.strip()) if args.mix else None)
+
+    if args.refresh:
+        record = run_refresh_bench(
+            sf=args.sf,
+            delta_sf=args.delta_sf if args.delta_sf > 0 else None,
+            rounds=args.appends, clients=args.clients,
+            seed=args.seed, mix=mix_arg or REFRESH_MIX)
+        missing = REQUIRED_REFRESH_FIELDS - record.keys()
+        assert not missing, f"refresh record dropped fields {missing}"
+        _emit_record(record)
+        # the acceptance gate: a stale or wrong read (oracle mismatch)
+        # or an incremental refresh that is not at least 2x cheaper
+        # than recomputing from scratch is a FAILED bench
+        if record["oracle_mismatches"] or record["errors"]:
+            return 1
+        if record["speedup"] is None or record["speedup"] < 2.0:
+            return 1
+        return 0
 
     if args.fleet:
         from cylon_tpu.serve.fleet import run_fleet_bench
@@ -513,8 +832,7 @@ def main(argv=None):
             clients=args.clients,
             requests=max(args.requests, 2), sf=args.sf,
             seed=args.seed, engines=args.engines,
-            mix=tuple(q.strip() for q in args.mix.split(",")
-                      if q.strip()),
+            mix=mix_arg or DEFAULT_MIX,
             kill_mid_run=not args.no_kill)
         missing = REQUIRED_FLEET_FIELDS - record.keys()
         assert not missing, f"fleet record dropped fields {missing}"
@@ -539,7 +857,7 @@ def main(argv=None):
         clients=args.clients, requests=args.requests, sf=args.sf,
         schedule=args.schedule, slo=args.slo,
         max_queue=args.max_queue, seed=args.seed,
-        mix=tuple(q.strip() for q in args.mix.split(",") if q.strip()),
+        mix=mix_arg or DEFAULT_MIX,
         slo_target=args.slo_target if args.slo_target > 0 else None,
         slo_latency=args.slo_latency if args.slo_latency > 0 else None,
         storm=args.storm)

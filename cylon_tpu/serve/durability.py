@@ -463,10 +463,18 @@ class CatalogSnapshot:
     def tables(self) -> "list[str]":
         return sorted(self._map["tables"])
 
-    def save(self, table_id: str, table, env=None) -> None:
+    def save(self, table_id: str, table, env=None,
+             generation: "int | None" = None) -> None:
         """Snapshot one table's host content (distributed tables
         gather to host first). Data lands durably BEFORE the map names
-        it — a kill mid-save leaves the previous snapshot intact."""
+        it — a kill mid-save leaves the previous snapshot intact.
+
+        ``generation`` stamps the catalog's monotone version into the
+        map entry: a :meth:`restore` after an append must reinstate
+        the POST-append generation, or the recovered process would
+        serve generation-1 content under a generation-1 label and
+        every version-keyed memo/view watermark would silently alias
+        the stale version (ISSUE 18 fix)."""
         pdf = self._host_frame(table, env)
         if not len(pdf.columns):
             get_logger().warning(
@@ -483,9 +491,19 @@ class CatalogSnapshot:
             bucket, {c: pdf[c].to_numpy() for c in pdf.columns},
             max(len(pdf), 1), meta={"table_id": table_id,
                                     "rows": int(len(pdf))})
-        self._map["tables"][table_id] = {"bucket": bucket,
-                                         "rows": int(len(pdf))}
+        entry = {"bucket": bucket, "rows": int(len(pdf))}
+        if generation is not None:
+            entry["generation"] = int(generation)
+        self._map["tables"][table_id] = entry
         self._flush_map()
+
+    def generations(self) -> "dict[str, int]":
+        """Per-table generation stamps recorded at save time (tables
+        snapshotted before the versioning era are absent — restore
+        treats them as generation 1)."""
+        return {tid: int(ent["generation"])
+                for tid, ent in self._map["tables"].items()
+                if "generation" in ent}
 
     @staticmethod
     def _host_frame(table, env=None):

@@ -318,6 +318,19 @@ class FleetLayout:
             return []
 
 
+def snapshot_generations(root: str) -> "dict[str, int]":
+    """Per-table generation stamps in the fleet's SHARED snapshot
+    store (ISSUE 18 fix): every engine's ``register_table``/
+    ``append_table`` writes its catalog generation into the snapshot
+    map, so a failover target's :meth:`ServeEngine.recover` — and this
+    router-side audit — sees the POST-append generation, not a
+    silently stale one. Reads the store at ``<root>/catalog-store``;
+    tables snapshotted before the versioning era are absent."""
+    from cylon_tpu.serve.durability import CatalogSnapshot
+
+    return CatalogSnapshot(FleetLayout(root).snapshot_dir).generations()
+
+
 # --------------------------------------------------------- gateway
 class EngineGateway:
     """The per-engine-process submission surface the router talks to.
@@ -1680,6 +1693,9 @@ def _drive_fleet_bench(router, procs, layout, oracles, *, clients,
         "p99_during_s": None,
         "p99_after_s": None,
         "fleet_root": root,
+        # the shared store's per-table generation stamps (quiescent —
+        # engines are down): what a failover recover() would restore
+        "table_generations": snapshot_generations(root),
     }
     phases = _phase_p99s(samples, kill_ts[0], recovered_ts)
     record.update(p99_before_s=phases["before"],

@@ -46,7 +46,10 @@ path                       payload
                            the engine is blocked on RIGHT NOW)
 ``/tenants``               ``ServeEngine.tenant_stats()``
 ``/tables``                resident catalog: rows/bytes/pins/holders +
-                           the per-device byte split
+                           the per-device byte split and the
+                           generation/digest version column
+``/views``                 materialized views: sources, generation
+                           watermarks, state digests, refresh counts
 ``/profiles/<rid>``        one retired-or-live request's ANALYZE
                            profile (``QueryTicket.profile()``)
 =========================  ============================================
@@ -67,7 +70,7 @@ __all__ = ["maybe_start", "IntrospectServer", "ENDPOINTS",
 
 #: the read-only surface (for docs and the landing page)
 ENDPOINTS = ("/healthz", "/health", "/metrics", "/metrics/window",
-             "/events", "/queries", "/tenants", "/tables",
+             "/events", "/queries", "/tenants", "/tables", "/views",
              "/profiles/<rid>")
 
 #: /health status thresholds over the composite score (1.0 = pristine)
@@ -373,6 +376,8 @@ class IntrospectServer:
             self._send(h, 200, eng.tenant_stats())
         elif path == "/tables":
             self._send(h, 200, eng.table_stats())
+        elif path == "/views":
+            self._send(h, 200, eng.view_stats())
         elif path.startswith("/profiles/"):
             rid = path.rsplit("/", 1)[1]
             ticket = eng.ticket(int(rid)) if rid.isdigit() else None

@@ -404,7 +404,24 @@ class ServeEngine:
         t = getattr(table, "table", table)
         catalog.put_table(table_id, t)
         if self._snapshot is not None:
-            self._snapshot.save(table_id, t, env=self._env)
+            self._snapshot.save(table_id, t, env=self._env,
+                                generation=catalog.generation(table_id))
+
+    def append_table(self, table_id: str, delta) -> dict:
+        """Fold delta rows into a resident table under the catalog's
+        atomic swap (:func:`cylon_tpu.catalog.append`) — legal while
+        the table is pinned (in-flight readers finish against the
+        generation they started on). On a durable engine the merged
+        table re-snapshots WITH its new generation stamped into the
+        snapshot map, so :meth:`recover` after the append restores the
+        post-append generation instead of silently serving the stale
+        one. Returns ``{"generation", "delta_rows", "rows"}``."""
+        res = catalog.append(table_id, delta, env=self._env)
+        if self._snapshot is not None:
+            self._snapshot.save(table_id, catalog.get_table(table_id),
+                                env=self._env,
+                                generation=res["generation"])
+        return res
 
     def drop_table(self, table_id: str) -> None:
         """Pin-respecting drop: raises
@@ -429,8 +446,51 @@ class ServeEngine:
         self._queries[str(name)] = (fn, fallback)
 
     def table_stats(self) -> dict:
-        """Per-table rows/bytes/pins of the resident catalog."""
+        """Per-table rows/bytes/pins/version of the resident catalog
+        (the ``version`` column carries the monotone generation +
+        content digest the views subsystem keys on)."""
         return catalog.stats()
+
+    # --------------------------------------------- materialized views
+    def register_view(self, name: str, query_fn, refresh_plan: dict,
+                      *, sources, delta_source: "str | None" = None,
+                      limit=None):
+        """Register an incremental materialized view over this
+        engine's resident tables
+        (:func:`cylon_tpu.views.register_view`, bound to the engine's
+        env so distributed sources gather correctly)."""
+        from cylon_tpu import views
+
+        return views.register_view(
+            name, query_fn, refresh_plan, sources=sources,
+            delta_source=delta_source, limit=limit, env=self._env)
+
+    def refresh_view(self, name: str, *,
+                     resume_dir: "str | None" = None,
+                     full: bool = False) -> dict:
+        """Bring a view up to date with its sources
+        (:func:`cylon_tpu.views.refresh`); ``resume_dir`` makes the
+        refresh checkpointable across a kill."""
+        from cylon_tpu import views
+
+        return views.refresh(name, resume_dir=resume_dir, full=full)
+
+    def read_view(self, name: str) -> dict:
+        """Generation-consistent view read
+        (:func:`cylon_tpu.views.read`): the returned ``result`` is
+        exactly the view at the returned ``generations`` — an append
+        racing the read lands entirely before or entirely after it,
+        never inside."""
+        from cylon_tpu import views
+
+        return views.read(name)
+
+    def view_stats(self) -> dict:
+        """Per-view watermarks/digests/refresh counts
+        (:func:`cylon_tpu.views.stats`)."""
+        from cylon_tpu import views
+
+        return views.stats()
 
     def session(self, tenant: str, priority: int = 1, tables=()):
         """Open a :class:`cylon_tpu.serve.session.Session` bound to
@@ -934,8 +994,15 @@ class ServeEngine:
         telemetry.counter("serve.recoveries").inc()
         _trace.instant("serve.recover", cat="serve", dir=durable_dir)
         restored = engine._snapshot.restore()
+        gens = engine._snapshot.generations()
         for tid, table in restored.items():
             catalog.put_table(tid, table)
+            # reinstate the generation the snapshot was taken at: a
+            # recovered engine must serve post-append content under the
+            # post-append generation, not restart the counter at 1 and
+            # alias every version-keyed memo (ISSUE 18 fix)
+            if tid in gens:
+                catalog.restore_version(tid, gens[tid])
         replayable, unreplayable = RequestJournal.incomplete(durable_dir)
         tickets: dict = {}
         if replay:

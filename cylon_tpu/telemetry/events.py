@@ -81,6 +81,11 @@ EVENT_KINDS: "dict[str, tuple]" = {
     # fleet router (ISSUE 15; engine-less process — no tenant/rid)
     "failover": ("engine", "reason", "replayed", "lost"),
     "fence": ("engine", "owner"),
+    # appendable tables + materialized views (ISSUE 18): a delta
+    # landed on a resident table / a view folded its pending deltas in
+    "append": ("table", "generation", "delta_rows"),
+    "view_refresh": ("view", "generation", "delta_rows", "wall_s",
+                     "full_recompute"),
 }
 
 

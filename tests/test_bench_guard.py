@@ -453,10 +453,12 @@ def test_write_ahead_invariant_journal_before_dispatch():
 
 
 def test_durable_mutations_maintain_catalog_snapshot():
-    """register_table/drop_table on a durable engine must keep the
-    snapshot in sync (the tables recover() restores)."""
+    """register_table/append_table/drop_table on a durable engine must
+    keep the snapshot in sync (the tables — and, since ISSUE 18, the
+    generation stamps — recover() restores)."""
     methods = {m.name: m for m in _serve_engine_methods()}
     assert _method_calls(methods["register_table"], "save")
+    assert _method_calls(methods["append_table"], "save")
     assert _method_calls(methods["drop_table"], "drop")
 
 
@@ -469,6 +471,10 @@ _INTROSPECT_FORBIDDEN = frozenset({
     "drop_table", "drop", "remove_table", "put_table", "pin", "unpin",
     "clear", "reset", "close", "recover", "session", "read_csv",
     "join_tables", "sort_table", "unique_table",
+    # views subsystem mutators (ISSUE 18): /views reads stats only
+    # (catalog.append itself can't be named here — the attr lint
+    # would trip on every list.append)
+    "append_table", "register_view", "refresh_view", "drop_view",
 })
 
 
@@ -944,3 +950,43 @@ def test_profile_schema_pins_join_routing():
     assert "join" in REQUIRED_PROFILE_FIELDS
     assert "join.algorithm" in _COUNTERS
     assert "join.overflow_fallbacks" in _COUNTERS
+
+
+# ------------------------------------------------- views guards
+def test_refresh_record_schema_pinned():
+    """ISSUE 18 satellite: the --refresh record must keep the
+    incremental-vs-recompute walls, the speedup ratio, the generation
+    lag and the oracle audit (main() asserts the set before emitting,
+    so the pin is enforced at bench runtime too)."""
+    from cylon_tpu.serve.bench import REQUIRED_REFRESH_FIELDS
+
+    assert {"refresh_wall_s", "recompute_wall_s", "speedup",
+            "generation_lag", "oracle_mismatches", "delta_rows_total",
+            "appends", "refreshes", "views"} <= REQUIRED_REFRESH_FIELDS
+    src = (REPO / "cylon_tpu" / "serve" / "bench.py").read_text()
+    assert "REQUIRED_REFRESH_FIELDS - record.keys()" in src
+
+
+def test_view_event_kinds_registered_and_emitted():
+    """ISSUE 18 satellite: the append / view_refresh kinds are in the
+    typed schema AND actually wired at their owning call sites — the
+    rglob-based emit lint above covers cylon_tpu/views/ by
+    construction, this pins that the sites exist at all."""
+    from cylon_tpu.telemetry.events import EVENT_KINDS
+
+    assert {"append", "view_refresh"} <= set(EVENT_KINDS)
+    sites = _emit_call_kinds()
+    by_kind = {}
+    for p, _, k in sites:
+        by_kind.setdefault(k, set()).add(p)
+    assert "cylon_tpu/catalog.py" in by_kind.get("append", set())
+    assert ("cylon_tpu/views/materialized.py"
+            in by_kind.get("view_refresh", set()))
+
+
+def test_views_endpoint_routed_through_introspect():
+    """The /views payload rides the same read-only introspection
+    surface the ops-plane lint walks."""
+    from cylon_tpu.serve import introspect
+
+    assert "/views" in introspect.ENDPOINTS

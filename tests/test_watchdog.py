@@ -177,7 +177,7 @@ def test_retryable_classification_per_section():
                         "overflow_fetch": False, "spill_io": True,
                         "ooc_pass": False, "ooc_prefetch": False,
                         "exchange": False, "serve_request": False,
-                        "router_poll": True}
+                        "router_poll": True, "fallback_merge": False}
 
 
 def test_retrying_absorbs_retryable_deadline():
