@@ -374,9 +374,12 @@ def _delta_keep() -> int:
 def on_append(cb) -> None:
     """Register ``cb(table_id, generation)`` to run after every
     successful :func:`append` — the invalidation hook the views layer
-    uses to evict memos keyed on the now-stale version. Callbacks run
-    outside the catalog locks; exceptions are swallowed (an observer
-    must never fail a mutation)."""
+    uses to evict memos keyed on the now-stale version, and (ISSUE 19)
+    how the serve plane's versioned result caches drop exactly the
+    cached results whose version vector names the appended table
+    (:func:`cylon_tpu.serve.result_cache.hook_on_append`). Callbacks
+    run outside the catalog locks; exceptions are swallowed (an
+    observer must never fail a mutation)."""
     _append_listeners.append(cb)
 
 

@@ -46,7 +46,8 @@ from cylon_tpu.telemetry import trace as _trace
 __all__ = ["capacity_scale", "current_scale", "compile_query",
            "CompiledQuery", "MAX_SCALE", "note_overflow",
            "tight_enabled", "current_row_hint", "row_hint",
-           "shared_compiled", "plan_cache_stats"]
+           "shared_compiled", "plan_cache_stats",
+           "query_fingerprint"]
 
 #: regrow ceiling: 2^10 = 1024x the default budget. Buffers grow only as
 #: far as the retry that fits (geometric, ~10 re-dispatches worst case);
@@ -654,6 +655,32 @@ def plan_cache_stats() -> dict:
         "hit_rate": (hits / looked) if looked else 0.0,
         "shared_queries": len(_SHARED),
     }
+
+
+def query_fingerprint(name: str, args=(), kwargs=None) -> "str | None":
+    """Stable fingerprint of a REGISTERED query invocation — the first
+    half of the serve layer's result-cache key ``(fingerprint,
+    table-version vector)``.
+
+    Keyed on the query NAME (the durable, cross-process identity the
+    journal already records) plus the canonical-JSON form of its
+    arguments, hashed with sha256 — so two processes (an engine and a
+    fleet router, or two engines behind one router) derive the SAME
+    fingerprint for the same logical request without sharing any
+    in-memory state. Returns None when the arguments are not
+    JSON-canonicalizable (closures, arrays, ...): such an invocation
+    has no stable identity and must never be coalesced or cached."""
+    import hashlib
+    import json
+
+    try:
+        blob = json.dumps(
+            {"name": str(name), "args": list(args),
+             "kwargs": dict(kwargs or {})},
+            sort_keys=True, separators=(",", ":"), allow_nan=False)
+    except (TypeError, ValueError):
+        return None
+    return hashlib.sha256(blob.encode("utf-8")).hexdigest()
 
 
 def _is_dynamic(x) -> bool:

@@ -61,6 +61,15 @@ env                                meaning                      default
 ``CYLON_TPU_SERVE_BURN_CRITICAL``  burn rate at which /health
                                    turns unhealthy              ``10``
 ================================== ============================ =========
+
+Two admission *bypasses* ride in front of this module (ISSUE 19; see
+``docs/serving.md`` → "Coalescing & the result cache"): a versioned
+result-cache hit (``CYLON_TPU_SERVE_RESULT_CACHE_BYTES``) and a
+coalesced attach to an identical in-flight request
+(``CYLON_TPU_SERVE_COALESCE``). Neither takes an admission slot,
+feeds the breaker, nor observes ``serve.queue_wait_seconds`` — a
+dedup'd request carries no signal about engine health. The split is
+labeled ``serve.admitted{path=executed|cache_hit|coalesced}``.
 """
 
 import dataclasses

@@ -66,6 +66,28 @@ REQUIRED_SERVE_FIELDS = frozenset({
     # the worst SLO burn rate any tenant reached (0 when burn
     # accounting is unarmed)
     "windowed_p99_s", "slo_burn",
+    # dedup-layer columns (ISSUE 19): the versioned result cache and
+    # micro-batched dispatch counters — 0 on the bare-callable replay
+    # (no fingerprints), live on the --hot-mix leg — pinned so a
+    # refactor cannot silently drop the dedup plane's accounting
+    "result_cache_hits", "result_cache_misses",
+    "result_cache_invalidations", "coalesced",
+})
+
+#: hot-mix-record fields (ISSUE 19): the ``--hot-mix`` acceptance is
+#: only auditable if every record pins the measured hot-path QPS
+#: against the single-engine uncached baseline (their ratio is the
+#: ``qps_multiplier`` the acceptance gates at >= 10x), the hot-phase
+#: cache hit rate, the dedup counters, and the staleness audit (an
+#: append between submissions MUST force a re-execution — 0 stale
+#: results). ``tests/test_bench_guard.py`` pins the set; main()
+#: asserts it before emitting.
+REQUIRED_HOTMIX_FIELDS = frozenset({
+    "metric", "engines", "clients", "requests_total", "completed",
+    "baseline_qps", "hot_qps", "qps_multiplier", "p50_s", "p99_s",
+    "cache_hit_rate", "shed", "coalesced", "result_cache_hits",
+    "result_cache_misses", "result_cache_invalidations",
+    "oracle_mismatches", "stale_results", "errors",
 })
 
 #: fleet-record fields (ISSUE 15): the ``--fleet`` acceptance is only
@@ -473,6 +495,17 @@ def run_bench(clients: int = 8, requests: int = 2, sf: float = 0.002,
         "cache_hit_rate": round(cache["hit_rate"], 4),
         "cache_hits": cache["hits"],
         "cache_misses": cache["misses"],
+        # dedup-layer counters (ISSUE 19): result-cache traffic and
+        # coalesced fan-outs — structurally 0 here (this replay
+        # submits bare callables, which have no fingerprint), live on
+        # the --hot-mix leg; pinned so the columns always ride
+        "result_cache_hits": int(
+            telemetry.total("serve.result_cache_hits")),
+        "result_cache_misses": int(
+            telemetry.total("serve.result_cache_misses")),
+        "result_cache_invalidations": int(
+            telemetry.total("serve.result_cache_invalidations")),
+        "coalesced": int(telemetry.total("serve.coalesced")),
         "oracle_mismatches": len(mismatches),
         "mismatch_detail": mismatches[:8],
         "resident_tables": len(resident),
@@ -497,6 +530,229 @@ def run_bench(clients: int = 8, requests: int = 2, sf: float = 0.002,
         record["storm"] = storm_block
     if http_addr is not None:
         record["http_url"] = "http://%s:%d" % http_addr
+    return record
+
+
+def run_hotmix_bench(clients: int = 64, requests: int = 4,
+                     sf: float = 0.002, seed: int = 0,
+                     mix=DEFAULT_MIX, engines: int = 2) -> dict:
+    """The ISSUE 19 measured acceptance: N concurrent clients replay a
+    HOT mix (identical fingerprints, stable tables) through the
+    FleetRouter twice — once against a single uncached engine
+    (coalescing and both result caches disabled: every request
+    executes), once against the full dedup plane (engine + router
+    caches on, coalescing on, warmed) — and the record gates the
+    hot-over-baseline QPS multiplier at >= 10x. A mid-probe append
+    then proves the staleness contract: the very next submission of an
+    affected query must MISS and re-execute (0 stale results). Every
+    result, both phases, is oracle-checked."""
+    import cylon_tpu as ct
+    from cylon_tpu import catalog, telemetry, tpch
+    from cylon_tpu.errors import ResourceExhausted
+    from cylon_tpu.serve import ServeEngine
+    from cylon_tpu.serve.fleet import (QUERY_READ_SETS, EngineUnavailable,
+                                       FleetRouter, LocalEngineClient,
+                                       _mk_fleet_query)
+    from cylon_tpu.tpch import dbgen
+
+    env = ct.CylonEnv(ct.TPUConfig())
+    data = dbgen.generate(sf, seed)
+    resident = _mk_resident(env, data)
+    for name, df in resident.items():
+        catalog.put_table(f"tpch/{name}", df.table)
+    mix = tuple(mix)
+    # oracles warm the shared compiled-plan cache for BOTH phases
+    # equally — the multiplier measures the dedup plane, not compile
+    # amortisation
+    compiled = {q: tpch.compiled(q) for q in mix}
+    oracles = {q: _materialize(compiled[q](resident, env=env))
+               for q in mix}
+
+    def mk_fleet(n_engines: int):
+        engs, clis = [], []
+        for i in range(n_engines):
+            e = ServeEngine(env)
+            for q in mix:
+                reads = QUERY_READ_SETS.get(q, tuple(resident))
+                e.register_query(
+                    q, _mk_fleet_query(compiled[q], resident, env),
+                    tables=[f"tpch/{nm}" for nm in reads
+                            if nm in resident])
+            engs.append(e)
+            clis.append(LocalEngineClient(e, f"hot{i}"))
+        return engs, FleetRouter(clis, poll_interval=0.25)
+
+    def drive(router, n_requests: int, label: str) -> dict:
+        mismatches: list = []
+        errors: list = []
+        shed = [0]
+        walls: "list[float]" = []
+        lock = threading.Lock()
+
+        def client(i: int):
+            tenant = f"tenant{i}"
+            for r in range(n_requests):
+                q = mix[(i + r) % len(mix)]
+                s0 = time.monotonic()
+                try:
+                    got = router.submit(q, tenant=tenant).result(600)
+                except Exception as e:
+                    with lock:
+                        if isinstance(e, (ResourceExhausted,
+                                          EngineUnavailable)):
+                            shed[0] += 1
+                        errors.append(
+                            (tenant, q, f"{type(e).__name__}: {e}"))
+                    continue
+                w = time.monotonic() - s0
+                with lock:
+                    walls.append(w)
+                if not _results_match(got, oracles[q]):
+                    with lock:
+                        mismatches.append((tenant, q, label))
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=client, args=(i,),
+                                    name=f"hotmix-{label}-{i}")
+                   for i in range(clients)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        wall = time.perf_counter() - t0
+        ws = sorted(walls)
+        return {
+            "wall_s": wall, "completed": len(walls),
+            "qps": (len(walls) / wall) if wall > 0 else None,
+            "p50_s": (float(np.quantile(np.asarray(ws), 0.5))
+                      if ws else None),
+            "p99_s": (float(np.quantile(np.asarray(ws), 0.99))
+                      if ws else None),
+            "shed": shed[0], "mismatches": mismatches,
+            "errors": errors,
+        }
+
+    knobs = {"CYLON_TPU_SERVE_RESULT_CACHE_BYTES": "0",
+             "CYLON_TPU_SERVE_COALESCE": "0",
+             "CYLON_TPU_FLEET_RESULT_CACHE_BYTES": "0"}
+    saved = {k: os.environ.get(k) for k in knobs}
+
+    # ---- phase 1: the single-engine uncached baseline (dedup plane
+    # OFF end to end — every submission executes). Fewer requests per
+    # client than the hot phase: QPS is a rate, and the baseline only
+    # needs a stable one
+    base_requests = max(1, requests // 2)
+    os.environ.update(knobs)
+    try:
+        engs, router = mk_fleet(1)
+        try:
+            base = drive(router, base_requests, "baseline")
+        finally:
+            router.close()
+            for e in engs:
+                e.close(wait=True)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+    # ---- phase 2: the full dedup plane (engine + router caches,
+    # coalescing), warmed with one execution per mix query so the
+    # measured window is the HOT path
+    engs, router = mk_fleet(engines)
+    try:
+        for q in mix:
+            got = router.submit(q, tenant="warmup").result(600)
+            if not _results_match(got, oracles[q]):
+                base["mismatches"].append(("warmup", q, "warmup"))
+        hits0 = telemetry.total("fleet.result_cache_hits") + \
+            telemetry.total("serve.result_cache_hits")
+        hot = drive(router, requests, "hot")
+        hits1 = telemetry.total("fleet.result_cache_hits") + \
+            telemetry.total("serve.result_cache_hits")
+        hit_rate = ((hits1 - hits0) / hot["completed"]
+                    if hot["completed"] else 0.0)
+
+        # ---- the staleness probe: append one row to lineitem, then
+        # re-submit a lineitem query — the dedup plane must MISS
+        # (invalidation reached both caches) and the re-execution must
+        # still match the oracle. A hit here would be a STALE RESULT.
+        probe_q = next((q for q in mix if "lineitem"
+                        in QUERY_READ_SETS.get(q, ("lineitem",))),
+                       mix[0])
+        cols = catalog.get_table("tpch/lineitem").column_names
+        row = {c: np.asarray(data["lineitem"][c][:1]) for c in cols}
+        misses0 = telemetry.total("fleet.result_cache_misses") + \
+            telemetry.total("serve.result_cache_misses")
+        catalog.append("tpch/lineitem", row, env=env)
+        stale = 0
+        try:
+            got = router.submit(probe_q, tenant="probe").result(600)
+        except Exception as e:
+            hot["errors"].append(("probe", probe_q,
+                                  f"{type(e).__name__}: {e}"))
+        else:
+            misses1 = telemetry.total("fleet.result_cache_misses") + \
+                telemetry.total("serve.result_cache_misses")
+            # resident inputs are engine-side frames (the catalog
+            # entry only versions them), so the re-run still matches
+            # the oracle; what MUST have changed is the miss count
+            if misses1 <= misses0:
+                stale += 1
+            if not _results_match(got, oracles[probe_q]):
+                hot["mismatches"].append(("probe", probe_q, "probe"))
+    finally:
+        router.close()
+        for e in engs:
+            e.close(wait=True)
+
+    mismatches = base["mismatches"] + hot["mismatches"]
+    errors = base["errors"] + hot["errors"]
+    record = {
+        "metric": "serve_hotmix_fleet",
+        "engines": engines,
+        "clients": clients,
+        "requests_total": clients * requests,
+        "completed": hot["completed"],
+        "sf": sf,
+        "mix": list(mix),
+        "baseline_requests_total": clients * base_requests,
+        "baseline_completed": base["completed"],
+        "baseline_wall_s": round(base["wall_s"], 3),
+        "baseline_qps": (round(base["qps"], 3)
+                         if base["qps"] else None),
+        "baseline_p50_s": (round(base["p50_s"], 4)
+                           if base["p50_s"] is not None else None),
+        "baseline_p99_s": (round(base["p99_s"], 4)
+                           if base["p99_s"] is not None else None),
+        "wall_s": round(hot["wall_s"], 3),
+        "hot_qps": round(hot["qps"], 3) if hot["qps"] else None,
+        "qps_multiplier": (round(hot["qps"] / base["qps"], 2)
+                           if hot["qps"] and base["qps"] else None),
+        "p50_s": (round(hot["p50_s"], 4)
+                  if hot["p50_s"] is not None else None),
+        "p99_s": (round(hot["p99_s"], 4)
+                  if hot["p99_s"] is not None else None),
+        "cache_hit_rate": round(hit_rate, 4),
+        "shed": base["shed"] + hot["shed"],
+        "coalesced": int(telemetry.total("serve.coalesced")),
+        "result_cache_hits": int(
+            telemetry.total("fleet.result_cache_hits")
+            + telemetry.total("serve.result_cache_hits")),
+        "result_cache_misses": int(
+            telemetry.total("fleet.result_cache_misses")
+            + telemetry.total("serve.result_cache_misses")),
+        "result_cache_invalidations": int(
+            telemetry.total("fleet.result_cache_invalidations")
+            + telemetry.total("serve.result_cache_invalidations")),
+        "stale_results": stale,
+        "oracle_mismatches": len(mismatches),
+        "mismatch_detail": mismatches[:8],
+        "errors": len(errors),
+        "error_detail": errors[:8],
+    }
     return record
 
 
@@ -792,6 +1048,15 @@ def main(argv=None):
                    help="engine process count for --fleet (>= 2)")
     p.add_argument("--no-kill", action="store_true",
                    help="--fleet without the mid-run kill (baseline)")
+    p.add_argument("--hot-mix", action="store_true",
+                   help="hot-mix dedup mode (ISSUE 19): replay a hot "
+                        "mix (identical fingerprints) through the "
+                        "FleetRouter against a single uncached "
+                        "baseline engine, then against the warmed "
+                        "coalescing + versioned-result-cache plane, "
+                        "and gate the QPS multiplier at >= 10x with "
+                        "0 oracle mismatches and 0 stale results "
+                        "across a mid-probe append")
     p.add_argument("--refresh", action="store_true",
                    help="incremental-view mode (ISSUE 18): drive "
                         "TPC-H RF1-style appends interleaved with the "
@@ -806,6 +1071,25 @@ def main(argv=None):
     args = p.parse_args(argv)
     mix_arg = (tuple(q.strip() for q in args.mix.split(",")
                      if q.strip()) if args.mix else None)
+
+    if args.hot_mix:
+        record = run_hotmix_bench(
+            clients=args.clients, requests=max(args.requests, 2),
+            sf=args.sf, seed=args.seed, mix=mix_arg or DEFAULT_MIX,
+            engines=args.engines)
+        missing = REQUIRED_HOTMIX_FIELDS - record.keys()
+        assert not missing, f"hot-mix record dropped fields {missing}"
+        _emit_record(record)
+        # the acceptance gate: a stale result served across an append,
+        # an oracle mismatch, or a dedup plane that is not at least
+        # 10x the uncached baseline's QPS is a FAILED bench
+        if record["oracle_mismatches"] or record["errors"] \
+                or record["stale_results"]:
+            return 1
+        if record["qps_multiplier"] is None \
+                or record["qps_multiplier"] < 10.0:
+            return 1
+        return 0
 
     if args.refresh:
         record = run_refresh_bench(

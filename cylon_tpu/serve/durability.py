@@ -14,7 +14,12 @@ so ``ServeEngine.recover(dir)`` can rebuild both after a hard kill:
   Client-supplied **idempotency keys** make the replay exactly-once: a
   client retrying a request it never got an answer for reuses its key,
   and the engine dedups against both live and replayed requests instead
-  of double-executing.
+  of double-executing. Dedup'd admissions (ISSUE 19) journal the same
+  way: a result-cache hit writes its admit line and then an immediate
+  ``done`` line, and every coalesced follower writes its OWN admit
+  line before it can be answered — so ``recover()`` never replays an
+  answer a client already holds, and a killed leader's followers are
+  each independently replayable.
 
 * :class:`CatalogSnapshot` — the resident tables, spilled through the
   same fsync-then-rename :class:`~cylon_tpu.resilience.SpillStore`

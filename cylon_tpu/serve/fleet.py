@@ -112,11 +112,14 @@ import time
 import urllib.error
 import urllib.request
 
-from cylon_tpu import resilience, telemetry, watchdog
+from cylon_tpu import plan, resilience, telemetry, watchdog
 from cylon_tpu.errors import (Code, CylonError, DataLossError,
                               DeadlineExceeded, InvalidArgument,
                               ResourceExhausted)
 from cylon_tpu.serve.durability import RequestJournal, fence_journal
+from cylon_tpu.serve.result_cache import (ResultCache,
+                                          cache_bytes_from_env,
+                                          hook_on_append)
 from cylon_tpu.telemetry import events as _events
 from cylon_tpu.utils.logging import get_logger
 
@@ -130,6 +133,27 @@ __all__ = [
 #: default mixed workload for fleet engine processes (mirrors
 #: serve.bench.DEFAULT_MIX without importing the bench at module load)
 DEFAULT_MIX = ("q1", "q3", "q5", "q6", "q14")
+
+#: per-query TPC-H read sets — the version-vector half of the result-
+#: cache key each fleet engine declares at register_query time (ISSUE
+#: 19). Precise sets mean precise invalidation: an orders append must
+#: not evict a cached q1 (lineitem-only). A query not listed here
+#: falls back to the FULL resident set — over-invalidation is merely
+#: slower, under-invalidation would serve stale bytes.
+QUERY_READ_SETS = {
+    "q1": ("lineitem",),
+    "q3": ("customer", "orders", "lineitem"),
+    "q4": ("orders", "lineitem"),
+    "q5": ("customer", "orders", "lineitem", "supplier", "nation",
+           "region"),
+    "q6": ("lineitem",),
+    "q7": ("supplier", "lineitem", "orders", "customer", "nation"),
+    "q10": ("customer", "orders", "lineitem", "nation"),
+    "q12": ("orders", "lineitem"),
+    "q14": ("lineitem", "part"),
+    "q18": ("customer", "orders", "lineitem"),
+    "q19": ("lineitem", "part"),
+}
 
 
 def _poll_interval() -> float:
@@ -450,7 +474,13 @@ class EngineGateway:
                     "kind": type(ticket.error).__name__})
                 return
             h._reply(200, {"state": "done", "rid": ticket.rid,
-                           "value": encode_value(ticket.value)})
+                           "value": encode_value(ticket.value),
+                           # the (fingerprint, version-vector) the
+                           # engine published this result under —
+                           # None when uncacheable; the router's
+                           # fleet-scoped cache keys on it verbatim
+                           "cache_key": getattr(ticket, "cache_key",
+                                                None)})
             return
         h._reply(404, {"error": f"unknown path {path!r}",
                        "kind": "NotFound"})
@@ -658,7 +688,8 @@ class LocalEngineClient:
                     "error": str(t.error),
                     "kind": type(t.error).__name__}
         return {"state": "done", "rid": rid,
-                "value": encode_value(t.value)}
+                "value": encode_value(t.value),
+                "cache_key": getattr(t, "cache_key", None)}
 
     def health(self) -> dict:
         if self.engine.closing:
@@ -805,6 +836,8 @@ class RouterTicket:
             state = res.get("state")
             if state == "done":
                 value = decode_value(res.get("value"))
+                self._router._store_result(res.get("cache_key"),
+                                           res.get("value"))
                 self._router._record_ack(self.key, value)
                 return value
             if state == "failed":
@@ -846,6 +879,21 @@ class FleetRouter:
                                 if unhealthy_dwell is not None
                                 else _dwell())
         self._retry_policy = retry_policy
+        # the FLEET-scoped versioned result cache (ISSUE 19): keyed
+        # exactly like the engine-side cache — (query fingerprint,
+        # table-version vector) — but holding the ENCODED value
+        # envelopes the gateways reply with, so a hit on any engine
+        # serves every engine, and the cache survives the engine the
+        # result first ran on. The router only learns a key from a
+        # done reply's ``cache_key`` (it cannot version remote
+        # tables itself), so ``_vv_by_fp`` maps fingerprint -> the
+        # last vector an engine answered with; a stale mapping can
+        # only cause a MISS (the entry under the old vector was
+        # already invalidated), never a stale hit.
+        self._result_cache = hook_on_append(ResultCache(
+            cache_bytes_from_env("CYLON_TPU_FLEET_RESULT_CACHE_BYTES"),
+            metric_prefix="fleet"))
+        self._vv_by_fp: "dict[str, tuple]" = {}
         self._tickets: "dict[str, RouterTicket]" = {}
         self._acks: "dict[str, object]" = {}
         self._failures: "dict[str, dict]" = {}
@@ -940,6 +988,22 @@ class FleetRouter:
                 return existing
             ticket = RouterTicket(self, key, name, tenant)
             self._tickets[key] = ticket
+        # fleet-scoped cache check BEFORE any engine is touched: the
+        # fingerprint is computed router-side (same canonical JSON the
+        # engines hash), the version vector is the one an engine last
+        # answered this fingerprint with — an append anywhere in the
+        # fleet invalidated the entry under it, so a hit is provably
+        # current. The hit resolves through the ack ledger, exactly
+        # like a delivered result (0 engine round-trips).
+        if self._result_cache.enabled:
+            fp = plan.query_fingerprint(name, args, kwargs)
+            if fp is not None:
+                with self._mu:
+                    vv = self._vv_by_fp.get(fp)
+                hit, env = self._result_cache.lookup(fp, vv)
+                if hit:
+                    self._record_ack(key, decode_value(env))
+                    return ticket
         # a submit that lands in an engine's death window (killed but
         # not yet declared dead — _pick_locked can still select it)
         # walks the affinity ring to the next peer instead of erroring
@@ -992,6 +1056,36 @@ class FleetRouter:
         with self._mu:
             self._acks[key] = value
 
+    def _store_result(self, cache_key: "dict | None", env) -> None:
+        """Publish one delivered result envelope into the fleet cache
+        under the ``(fingerprint, version-vector)`` the ENGINE stamped
+        on it (an engine only stamps a key when its read set was still
+        at the admitted versions at retirement — the staleness guard
+        already ran there). Local-fleet belt-and-braces: when the
+        router process itself holds a vector table (in-process
+        engines share the catalog), a version that moved since the
+        stamp drops the store instead of publishing a dead entry."""
+        if not cache_key or not self._result_cache.enabled:
+            return
+        fp = cache_key.get("fingerprint")
+        vv = tuple(tuple(v) for v in cache_key.get("versions", ()))
+        if fp is None or not vv:
+            return
+        from cylon_tpu import catalog
+        from cylon_tpu.errors import KeyError_
+
+        for tid, gen, dig in vv:
+            try:
+                cur = catalog.table_version(str(tid))
+            except (KeyError, KeyError_):
+                continue  # remote table: /events invalidation governs
+            if (int(cur["generation"]) != int(gen)
+                    or str(cur["digest"]) != str(dig)):
+                return
+        self._result_cache.store(fp, vv, env)
+        with self._mu:
+            self._vv_by_fp[fp] = vv
+
     def _acked(self, key: str) -> "tuple[bool, object]":
         with self._mu:
             if key in self._acks:
@@ -1036,6 +1130,15 @@ class FleetRouter:
                 self._cursors[st.name] = ev.get(
                     "cursor", self._cursors[st.name])
                 st.events_seen += len(ev.get("events", ()))
+                # fleet-cache invalidation rides the same cursor: an
+                # append ANY engine journals evicts exactly the cached
+                # results whose version vector read that table (for
+                # in-process fleets the catalog hook already fired —
+                # re-invalidating an evicted table is a no-op)
+                for e in ev.get("events", ()):
+                    if e.get("kind") == "append" and e.get("table"):
+                        self._result_cache.invalidate_table(
+                            e["table"])
                 st.last_window = st.client.metrics_window()
             except Exception:
                 # the health verdict landed; a flaky events/window read
@@ -1304,9 +1407,12 @@ def _engine_main(args) -> int:
         engine.register_table(f"tpch/{nm}", df)
     mix = tuple(q.strip() for q in args.mix.split(",") if q.strip())
     for q in mix:
+        reads = QUERY_READ_SETS.get(q, tuple(resident))
         engine.register_query(q, _mk_fleet_query(tpch.compiled(q),
                                                  resident, env),
-                              fallback=_mk_fleet_fallback(q, data))
+                              fallback=_mk_fleet_fallback(q, data),
+                              tables=[f"tpch/{nm}" for nm in reads
+                                      if nm in resident])
     gateway = EngineGateway(engine, port=args.gateway_port)
     ready = {"name": args.name, "pid": os.getpid(),
              "gateway": list(gateway.address),

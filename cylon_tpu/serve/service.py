@@ -69,6 +69,18 @@ Design — an assembly of the subsystems the previous PRs built:
   instead of wedging the engine: new work sheds fast, in-flight work
   drains.
 
+* **Coalescing + the versioned result cache**
+  (:mod:`cylon_tpu.serve.result_cache`): same-``(query fingerprint,
+  table-version vector)`` requests dedup at admission — a completed
+  result is served straight from the byte-budgeted cache
+  (``serve.admitted{path="cache_hit"}``, invalidated precisely by
+  :func:`cylon_tpu.catalog.append`), and requests identical to one
+  already in flight attach to it as followers of ONE scheduler op
+  (``path="coalesced"``) fanned back to N tickets at retirement. Each
+  ticket keeps its own tenant label, SLO deadline, journal entry and
+  profile (``coalesced: leader|follower``); dedup'd paths never feed
+  the circuit breaker and never count queue wait — they never ran.
+
 * **Graceful degradation** (:mod:`cylon_tpu.fallback`): a request
   submitted with a ``fallback=`` spill path whose step dies with an
   allocation failure re-runs ONCE through that path instead of
@@ -97,6 +109,10 @@ from cylon_tpu.ops_graph.execution import (PriorityExecution,
 from cylon_tpu.ops_graph.op import Op
 from cylon_tpu.serve.admission import AdmissionController, ServePolicy
 from cylon_tpu.serve import introspect
+from cylon_tpu.serve.result_cache import (ResultCache,
+                                          cache_bytes_from_env,
+                                          hook_on_append,
+                                          version_vector)
 from cylon_tpu.serve.slo import SloTracker
 from cylon_tpu.telemetry import events as _events
 from cylon_tpu.telemetry import memory as _memory
@@ -132,6 +148,16 @@ class QueryTicket:
         #: did this request complete through the OOM→spill fallback?
         #: (set by the scheduler's degrade path; rides ``profile()``)
         self.degraded = False
+        #: dedup attribution: ``leader``/``follower`` when this request
+        #: coalesced with identical in-flight work (rides ``profile()``
+        #: as the ``coalesced`` marker), True when it was served
+        #: straight from the versioned result cache
+        self.coalesced_role: "str | None" = None
+        self.cache_hit = False
+        #: ``{"fingerprint", "versions"}`` the result is cacheable
+        #: under (set at retirement IFF the version vector was still
+        #: current) — the fleet router's cross-engine cache key
+        self.cache_key: "dict | None" = None
         self._event = threading.Event()
         #: ANALYZE profiler (telemetry.profile.RequestProfiler), set
         #: at admission unless CYLON_TPU_SERVE_PROFILE=0
@@ -160,7 +186,13 @@ class QueryTicket:
         profiling is disabled (``CYLON_TPU_SERVE_PROFILE=0``)."""
         if self._profiler is None:
             return None
-        return self._profiler.render(self)
+        prof = self._profiler.render(self)
+        if isinstance(prof, dict):
+            if self.coalesced_role is not None:
+                prof["coalesced"] = self.coalesced_role
+            if self.cache_hit:
+                prof["cache_hit"] = True
+        return prof
 
     def result(self, timeout: "float | None" = None):
         """Block for the result; re-raise the request's failure."""
@@ -217,6 +249,9 @@ class _QueryOp(Op):
         # /health's scheduler-age probe must not read a long single
         # step mid-sweep as a wedged scheduler
         self._engine._last_sweep = time.monotonic()
+        # followers never run steps, so the per-step SLO check above
+        # cannot expire them — sweep the attached tickets here
+        self._engine._expire_followers(self)
         try:
             rem = t.remaining()
             if rem is not None and rem <= 0:
@@ -367,6 +402,16 @@ class ServeEngine:
         #: idempotency-key -> ticket (live AND retired): a retried key
         #: returns the existing ticket instead of double-executing
         self._idem: "dict[str, QueryTicket]" = {}
+        #: versioned result cache (byte-budgeted LRU, precise
+        #: catalog.on_append invalidation) — the admission-time fast
+        #: path that keeps hot queries off the mesh entirely
+        self._result_cache = hook_on_append(ResultCache(
+            cache_bytes_from_env("CYLON_TPU_SERVE_RESULT_CACHE_BYTES"),
+            metric_prefix="serve"))
+        #: (fingerprint, version-vector) -> live leader op: identical
+        #: in-queue requests attach here as followers of ONE scheduler
+        #: op instead of executing N times (under ``_cond``)
+        self._coalesce: "dict[tuple, _QueryOp]" = {}
         self._journal = self._snapshot = None
         if durable_dir is not None:
             from cylon_tpu.serve.durability import (CatalogSnapshot,
@@ -431,7 +476,8 @@ class ServeEngine:
         if self._snapshot is not None:
             self._snapshot.drop(table_id)
 
-    def register_query(self, name: str, fn, fallback=None) -> None:
+    def register_query(self, name: str, fn, fallback=None,
+                       tables=()) -> None:
         """Name a query function for :meth:`submit_named` — the
         REPLAYABLE submission surface: only named queries (with
         JSON-able args) can be re-run by :meth:`recover`, because the
@@ -442,8 +488,17 @@ class ServeEngine:
         INCLUDING a journal replay after :meth:`recover` — arms it
         automatically, so graceful degradation survives a crash (the
         journal can name the query but could never serialize a
-        per-submit fallback closure)."""
-        self._queries[str(name)] = (fn, fallback)
+        per-submit fallback closure).
+
+        ``tables`` declares the query's READ SET (resident catalog
+        ids): it is what makes the query coalescible and cacheable —
+        the version vector half of the ``(fingerprint, versions)``
+        dedup key is computed over exactly these tables (plus any
+        per-submit pins), so an append to any of them invalidates the
+        cached result precisely. A query registered WITHOUT tables has
+        no versionable read set and is never deduped."""
+        self._queries[str(name)] = (fn, fallback,
+                                    tuple(str(t) for t in tables))
 
     def table_stats(self) -> dict:
         """Per-table rows/bytes/pins/version of the resident catalog
@@ -506,6 +561,8 @@ class ServeEngine:
                idempotency_key: "str | None" = None,
                fallback=None, predicted_bytes: "int | None" = None,
                _journal_name: "str | None" = None,
+               _fingerprint: "str | None" = None,
+               _read_tables=None,
                **kwargs) -> QueryTicket:
         """Admit one query for scheduled execution.
 
@@ -550,6 +607,33 @@ class ServeEngine:
             slo = self._policy.default_slo
         elif slo <= 0:
             slo = None
+        # the two-level dedup (fingerprinted submits only — bare
+        # callables have no stable identity): a completed result under
+        # this exact (fingerprint, table-version vector) is served
+        # straight from the cache; failing that, identical in-flight
+        # work adopts this request as a follower. Both paths bypass
+        # the scheduler entirely.
+        fp, vv = _fingerprint, None
+        if fp is not None and (self._result_cache.enabled
+                               or self._coalesce_on()):
+            vv = version_vector(_read_tables)
+        if vv is not None and self._result_cache.enabled:
+            hit, cached = self._result_cache.lookup(fp, vv)
+            if hit:
+                return self._admit_cache_hit(
+                    cached, fp, vv, tenant=tenant, priority=priority,
+                    slo=slo, slo_raw=slo_raw, key=key,
+                    journal_name=_journal_name, args=args,
+                    kwargs=kwargs, tables=tables)
+        if vv is not None and self._coalesce_on():
+            follower = self._maybe_attach_follower(
+                fp, vv, fn=fn, args=args, kwargs=kwargs,
+                tenant=tenant, priority=priority, slo=slo,
+                slo_raw=slo_raw, key=key, tables=tables,
+                fault_plan=fault_plan, fallback=fallback,
+                journal_name=_journal_name)
+            if follower is not None:
+                return follower
         # may raise ResourceExhausted (queue cap, breaker, or the
         # memory-aware predicted-bytes shed)
         self._admission.admit(tenant, predicted_bytes=predicted_bytes)
@@ -572,6 +656,14 @@ class ServeEngine:
                       kwargs, fault_plan, pinned, fallback=fallback)
         op._holder = holder
         op._idem_key = key
+        # dedup bookkeeping: a fingerprinted op is the (potential)
+        # leader of its (fp, vv) coalesce group and publishes its
+        # result to the cache at retirement; followers re-run through
+        # _requeue_follower if it fails, which needs the journal name
+        op._fp, op._vv = fp, vv
+        op._followers = []
+        op._coalesce_closed = False
+        op._admitted = True
         if key is not None:
             with self._cond:
                 existing = self._idem.get(key)
@@ -583,10 +675,12 @@ class ServeEngine:
                 self._idem[key] = ticket
                 self._evict_idem_locked()
         telemetry.counter("serve.requests", tenant=ticket.tenant).inc()
+        telemetry.counter("serve.admitted", path="executed",
+                          tenant=ticket.tenant).inc()
         _trace.instant("serve.admit", cat="serve", tenant=ticket.tenant,
                        rid=ticket.rid, slo=slo)
         _events.emit("admit", tenant=ticket.tenant, rid=ticket.rid,
-                     slo=slo)
+                     slo=slo, path="executed")
         # WRITE-AHEAD: the journal records the admission durably BEFORE
         # the scheduler can touch it — a kill at any later instant
         # leaves the request recoverable (bench-guard lints this order).
@@ -627,7 +721,10 @@ class ServeEngine:
                 catalog.unpin(tid, holder=op._holder)
             except Exception:  # pragma: no cover - unpin best-effort
                 pass
-        self._admission.release()
+        if getattr(op, "_admitted", True):
+            # a requeued follower never took an admission slot; undoing
+            # it must not release one it doesn't hold
+            self._admission.release()
         if op._idem_key is not None and \
                 self._idem.get(op._idem_key) is op.ticket:
             self._idem.pop(op._idem_key, None)
@@ -635,20 +732,319 @@ class ServeEngine:
     def _evict_idem_locked(self) -> None:
         """Bound the idempotency map (always-on engines would otherwise
         grow it — and every retained result — forever): past the cap,
-        drop the oldest RETIRED entries; live tickets are never
-        evicted. Caller holds ``self._cond``. An evicted key loses its
-        dedup guarantee, which is why the cap is generous and
-        env-tunable (``CYLON_TPU_SERVE_IDEM_ENTRIES``)."""
-        import os
-
-        cap = int(os.environ.get("CYLON_TPU_SERVE_IDEM_ENTRIES",
-                                 "65536"))
+        drop retired entries OLDEST-RETIRED-FIRST (by finish time), so
+        a recently completed ticket's result survives the bound
+        instead of being dropped in arbitrary dict-insertion order;
+        live tickets are never evicted. Caller holds ``self._cond``.
+        An evicted key loses its dedup guarantee, which is why the cap
+        is generous and env-tunable
+        (``CYLON_TPU_SERVE_IDEM_ENTRIES``)."""
+        try:
+            cap = int(os.environ.get("CYLON_TPU_SERVE_IDEM_ENTRIES",
+                                     "65536"))
+        except ValueError:
+            cap = 65536
         if cap <= 0 or len(self._idem) <= cap:
             return
-        for k in [k for k, t in self._idem.items() if t.done]:
+        retired = sorted(
+            ((t.finished if t.finished is not None else 0.0, k)
+             for k, t in self._idem.items() if t.done))
+        for _finished, k in retired:
             if len(self._idem) <= cap:
                 break
             del self._idem[k]
+
+    # ------------------------------------------------ dedup fast paths
+    @staticmethod
+    def _coalesce_on() -> bool:
+        """Micro-batched dispatch knob (``CYLON_TPU_SERVE_COALESCE``;
+        on by default, ``0``/``off`` disables)."""
+        return os.environ.get("CYLON_TPU_SERVE_COALESCE",
+                              "1") not in ("0", "off")
+
+    def _record_recent_locked(self, ticket: QueryTicket) -> None:
+        """Bounded rid->ticket history insert (caller holds
+        ``_cond``): the /profiles + ticket() lookup surface."""
+        self._recent[ticket.rid] = ticket
+        try:
+            cap = int(os.environ.get(
+                "CYLON_TPU_SERVE_RECENT_ENTRIES", "1024"))
+        except ValueError:
+            cap = 1024
+        while cap > 0 and len(self._recent) > cap:
+            self._recent.popitem(last=False)
+
+    def _admit_cache_hit(self, value, fp, vv, *, tenant, priority,
+                         slo, slo_raw, key, journal_name, args,
+                         kwargs, tables) -> QueryTicket:
+        """Serve one admission straight from the versioned result
+        cache: the ticket retires DONE before submit() returns — no
+        admission slot, no scheduler op, no mesh work. The request is
+        still journaled (admit line THEN an immediate done line) so a
+        :meth:`recover` after a kill never replays an answer the
+        client already has. Cache hits never feed the circuit breaker
+        and never observe ``serve.queue_wait_seconds`` — they never
+        queued (the satellite-2 contract); they count
+        ``serve.admitted{path="cache_hit"}``."""
+        ticket = QueryTicket(next(self._ids), str(tenant),
+                             int(priority), slo)
+        ticket.cache_hit = True
+        ticket.cache_key = {"fingerprint": fp,
+                            "versions": [list(v) for v in vv]}
+        if _profile.profiling_enabled():
+            ticket._profiler = _profile.RequestProfiler()
+        if key is not None:
+            with self._cond:
+                existing = self._idem.get(key)
+                if existing is not None:  # lost a submit race
+                    telemetry.counter("serve.idempotent_hits",
+                                      tenant=tenant).inc()
+                    return existing
+                self._idem[key] = ticket
+                self._evict_idem_locked()
+        telemetry.counter("serve.requests", tenant=ticket.tenant).inc()
+        telemetry.counter("serve.admitted", path="cache_hit",
+                          tenant=ticket.tenant).inc()
+        _trace.instant("serve.admit", cat="serve",
+                       tenant=ticket.tenant, rid=ticket.rid, slo=slo)
+        _events.emit("admit", tenant=ticket.tenant, rid=ticket.rid,
+                     slo=slo, path="cache_hit")
+        try:
+            self._journal_admit(ticket, journal_name, args, kwargs,
+                                key, slo_raw, tables)
+        except BaseException:
+            with self._cond:
+                if key is not None and \
+                        self._idem.get(key) is ticket:
+                    self._idem.pop(key, None)
+            raise
+        with self._cond:
+            self._record_recent_locked(ticket)
+        self._finish_ticket(ticket, value=value, idem_key=key)
+        return ticket
+
+    def _maybe_attach_follower(self, fp, vv, *, fn, args, kwargs,
+                               tenant, priority, slo, slo_raw, key,
+                               tables, fault_plan, fallback,
+                               journal_name) -> "QueryTicket | None":
+        """Micro-batched dispatch: if an identical ``(fp, vv)`` op is
+        already in the queue, attach this request to it as a FOLLOWER
+        — its own ticket (tenant label, SLO deadline, journal entry,
+        profile marked ``coalesced: follower``) but no scheduler op
+        and no admission slot: the leader's one execution fans back to
+        every attached ticket at retirement. Returns None when there
+        is no open leader (the caller proceeds down the normal
+        admission path and becomes one)."""
+        with self._cond:
+            leader = self._coalesce.get((fp, vv))
+            if (leader is None or leader._coalesce_closed
+                    or leader.ticket.done):
+                return None
+            ticket = QueryTicket(next(self._ids), str(tenant),
+                                 int(priority), slo)
+            ticket.coalesced_role = "follower"
+            if _profile.profiling_enabled():
+                ticket._profiler = _profile.RequestProfiler()
+            holder = f"{tenant}/req{ticket.rid}"
+            pinned: list = []
+            try:
+                for tid in tables:
+                    catalog.pin(tid, holder=holder)
+                    pinned.append(tid)
+            except Exception:
+                for tid in pinned:
+                    catalog.unpin(tid, holder=holder)
+                raise
+            if key is not None:
+                existing = self._idem.get(key)
+                if existing is not None:  # lost a submit race
+                    for tid in pinned:
+                        catalog.unpin(tid, holder=holder)
+                    telemetry.counter("serve.idempotent_hits",
+                                      tenant=tenant).inc()
+                    return existing
+                self._idem[key] = ticket
+                self._evict_idem_locked()
+            leader.ticket.coalesced_role = "leader"
+            telemetry.counter("serve.requests",
+                              tenant=ticket.tenant).inc()
+            telemetry.counter("serve.admitted", path="coalesced",
+                              tenant=ticket.tenant).inc()
+            telemetry.counter("serve.coalesced",
+                              tenant=ticket.tenant).inc()
+            _trace.instant("serve.admit", cat="serve",
+                           tenant=ticket.tenant, rid=ticket.rid,
+                           slo=slo)
+            _events.emit("admit", tenant=ticket.tenant,
+                         rid=ticket.rid, slo=slo, path="coalesced")
+            # WRITE-AHEAD: the follower journals its OWN admit line
+            # before it can be answered — recover() after a kill
+            # replays it independently of the leader's fate
+            try:
+                self._journal_admit(ticket, journal_name, args,
+                                    kwargs, key, slo_raw, tables)
+            except BaseException:
+                for tid in pinned:
+                    catalog.unpin(tid, holder=holder)
+                if key is not None and self._idem.get(key) is ticket:
+                    self._idem.pop(key, None)
+                raise
+            leader._followers.append({
+                "ticket": ticket, "key": key, "fn": fn, "args": args,
+                "kwargs": kwargs, "fault_plan": fault_plan,
+                "fallback": fallback, "pins": pinned,
+                "holder": holder, "name": journal_name,
+                "slo_raw": slo_raw, "tables": tables, "fp": fp,
+                "vv": vv})
+            self._record_recent_locked(ticket)
+            return ticket
+
+    def _expire_followers(self, op: "_QueryOp") -> None:
+        """Retire attached followers whose SLO budget ran out
+        mid-flight (the scheduler's per-step expiry check cannot see
+        them — they have no op). Counted ``serve.expired`` like any
+        expiry, but NEVER fed to the circuit breaker: a coalesced
+        ticket did no work that could indicate engine distress."""
+        if not getattr(op, "_followers", None):
+            return
+        expired: list = []
+        with self._cond:
+            keep = []
+            for rec in op._followers:
+                rem = rec["ticket"].remaining()
+                (expired if rem is not None and rem <= 0
+                 else keep).append(rec)
+            op._followers = keep
+        for rec in expired:
+            t = rec["ticket"]
+            telemetry.counter("serve.expired", tenant=t.tenant).inc()
+            self._finish_ticket(
+                t, error=DeadlineExceeded(
+                    f"coalesced request {t.rid} (tenant {t.tenant!r}) "
+                    f"missed its {t.slo:.3f}s SLO while attached to "
+                    f"leader {op.ticket.rid}", section="serve_request"),
+                idem_key=rec["key"], pins=rec["pins"],
+                holder=rec["holder"])
+
+    def _fanout_follower(self, rec: dict, value) -> None:
+        """Deliver the leader's result to one attached follower (or
+        expire it, if its deadline passed between the last step and
+        retirement — a stale answer is still a missed SLO)."""
+        t = rec["ticket"]
+        rem = t.remaining()
+        if rem is not None and rem <= 0:
+            telemetry.counter("serve.expired", tenant=t.tenant).inc()
+            self._finish_ticket(
+                t, error=DeadlineExceeded(
+                    f"coalesced request {t.rid} (tenant {t.tenant!r}) "
+                    f"missed its {t.slo:.3f}s SLO awaiting its "
+                    "leader's result", section="serve_request"),
+                idem_key=rec["key"], pins=rec["pins"],
+                holder=rec["holder"])
+            return
+        self._finish_ticket(t, value=value, idem_key=rec["key"],
+                            pins=rec["pins"], holder=rec["holder"])
+
+    def _requeue_follower(self, rec: dict) -> None:
+        """The leader FAILED but this follower still has SLO budget:
+        re-run it as its own scheduler op (a leader failure fails only
+        the tickets that cannot re-run within SLO). The write-ahead
+        invariant holds here like every submission path: the re-run
+        journals a fresh admit line BEFORE ``_dispatch`` (the journal
+        dedups by key/rid, so the replay stays exactly-once)."""
+        t = rec["ticket"]
+        op = _QueryOp(next(self._op_ids), self, t, rec["fn"],
+                      rec["args"], rec["kwargs"], rec["fault_plan"],
+                      rec["pins"], fallback=rec["fallback"])
+        op._holder = rec["holder"]
+        op._idem_key = rec["key"]
+        op._fp, op._vv = rec["fp"], rec["vv"]
+        op._followers = []
+        op._coalesce_closed = False
+        #: no admission slot was ever taken for a follower — its
+        #: retirement must not release one
+        op._admitted = False
+        try:
+            self._journal_admit(t, rec["name"], rec["args"],
+                                rec["kwargs"], rec["key"],
+                                rec["slo_raw"], rec["tables"])
+            self._dispatch(op, t)
+        except BaseException as e:  # noqa: BLE001 - fail THIS ticket
+            self._finish_ticket(t, error=e, idem_key=rec["key"],
+                                pins=rec["pins"],
+                                holder=rec["holder"])
+
+    def _finish_ticket(self, ticket: QueryTicket, value=None,
+                       error: "BaseException | None" = None, *,
+                       idem_key: "str | None" = None, pins=(),
+                       holder: "str | None" = None,
+                       release_slot: bool = False,
+                       feed_breaker: bool = False,
+                       set_event: bool = True) -> None:
+        """Shared retirement bookkeeping: outcome + latency + SLO
+        accounting, journal done line, pin/slot release, waiter
+        wake-up. Cache hits and coalesced followers retire through
+        this directly (no slot, no breaker feed — they never ran);
+        :meth:`_retire` routes executed ops through it with
+        ``release_slot``/``feed_breaker`` armed."""
+        t = ticket
+        if getattr(t, "_retired", False):
+            return
+        t._retired = True
+        t.finished = time.monotonic()
+        wall = t.finished - t.submitted
+        if error is None:
+            t.state, t.value = DONE, value
+            telemetry.counter("serve.completed", tenant=t.tenant).inc()
+            if feed_breaker:
+                self._admission.breaker.record_success()
+        else:
+            t.state, t.error = FAILED, error
+            telemetry.counter("serve.errors", tenant=t.tenant,
+                              kind=type(error).__name__).inc()
+            if feed_breaker:
+                # dedup'd retirements never reach here with
+                # feed_breaker: a cache/coalesce failure says nothing
+                # about engine health (satellite-2 contract)
+                self._admission.breaker.record_failure(
+                    type(error).__name__)
+        self._slo.record(t.tenant, ok=error is None, latency_s=wall)
+        _events.emit("retire", tenant=t.tenant, rid=t.rid,
+                     state=t.state, wall_s=round(wall, 6),
+                     error=type(error).__name__ if error else None)
+        if self._journal is not None:
+            try:
+                self._journal.done(rid=t.rid, key=idem_key,
+                                   state=t.state)
+            except OSError:  # pragma: no cover - journal best-effort
+                pass  # a full disk must not wedge retirement
+            except FailedPrecondition as e:
+                # journal FENCED mid-flight: a router declared this
+                # engine dead and is replaying its journal on a peer.
+                # The retirement still completes locally (the client
+                # holding this ticket gets its answer) but the done
+                # line must NOT race the replay — log loudly instead.
+                from cylon_tpu.utils.logging import get_logger
+
+                get_logger().error(
+                    "request %d retired but its journal is fenced "
+                    "(%s); a fleet router has failed this engine over",
+                    t.rid, e)
+        telemetry.timer("serve.request_seconds",
+                        tenant=t.tenant).observe(wall)
+        _trace.instant("serve.done" if error is None else "serve.error",
+                       cat="serve", tenant=t.tenant, rid=t.rid,
+                       wall=wall,
+                       error=type(error).__name__ if error else None)
+        for tid in pins:
+            try:
+                catalog.unpin(tid, holder=holder)
+            except Exception:  # pragma: no cover - unpin best-effort
+                pass
+        if release_slot:
+            self._admission.release()
+        if set_event:
+            t._event.set()
 
     #: submit()'s control keywords — everything else in a
     #: submit_named(**kwargs) belongs to the query function itself
@@ -675,15 +1071,23 @@ class ServeEngine:
                 f"no query registered under {name!r}; "
                 f"register_query() it first (known: "
                 f"{sorted(self._queries)})")
-        fn, reg_fb = entry
+        fn, reg_fb, reg_tables = entry
+        qkw = {k: v for k, v in kwargs.items()
+               if k not in self._CONTROL_KW}
         # "fallback" ABSENT arms the registry's; an explicit
         # fallback=None is a per-request opt-out of degradation
         if reg_fb is not None and "fallback" not in kwargs:
-            qkw = {k: v for k, v in kwargs.items()
-                   if k not in self._CONTROL_KW}
             kwargs["fallback"] = functools.partial(reg_fb, *args, **qkw)
+        # the dedup identity: the stable fingerprint over name + query
+        # args (None for non-JSON-able args — no stable identity, no
+        # dedup) plus the read set the version vector is computed over
+        read = set(reg_tables) | {str(t) for t in kwargs.get("tables",
+                                                             ())}
+        fp = (plan.query_fingerprint(name, args, qkw)
+              if read else None)
         return self.submit(fn, *args, idempotency_key=idempotency_key,
-                           _journal_name=str(name), **kwargs)
+                           _journal_name=str(name), _fingerprint=fp,
+                           _read_tables=tuple(sorted(read)), **kwargs)
 
     def _journal_admit(self, ticket: QueryTicket,
                        name: "str | None", args, kwargs,
@@ -713,6 +1117,14 @@ class ServeEngine:
             # was parked in cond.wait), and /health polled before the
             # first post-idle sweep must not read that as a stall
             self._last_sweep = time.monotonic()
+            if (self._coalesce_on()
+                    and getattr(op, "_fp", None) is not None
+                    and getattr(op, "_vv", None) is not None):
+                # open the coalesce window: identical (fingerprint,
+                # version-vector) submissions attach to this op as
+                # followers until it retires. setdefault — an already
+                # open leader for the key keeps the window
+                self._coalesce.setdefault((op._fp, op._vv), op)
             if self._policy.schedule == "priority":
                 self._exec.add_op(op, ticket.priority)
             else:
@@ -745,77 +1157,80 @@ class ServeEngine:
     def _retire(self, op: _QueryOp, value=None,
                 error: "BaseException | None" = None) -> None:
         """Finish one request: record outcome + latency, release pins
-        and the admission slot, wake waiters. Runs on the scheduler
-        thread (once per request — ops retire exactly once)."""
+        and the admission slot, wake waiters; then settle the op's
+        coalesced followers and (on success) publish the result into
+        the versioned cache. Runs on the scheduler thread (once per
+        request — ops retire exactly once)."""
         t = op.ticket
         if getattr(t, "_retired", False):
             # a request that retired successfully can still raise on
             # scope exit (a deadline verdict from watched_section);
             # the first retirement's outcome stands
             return
-        t._retired = True
-        t.finished = time.monotonic()
-        wall = t.finished - t.submitted
-        if error is None:
-            t.state, t.value = DONE, value
-            if getattr(op, "_degraded", False):
-                # the degrade COMPLETED: this is the moment the
-                # request earns degraded=true and the tenant counter
-                t.degraded = True
-                telemetry.counter("serve.degraded",
-                                  tenant=t.tenant).inc()
-            telemetry.counter("serve.completed", tenant=t.tenant).inc()
-            self._admission.breaker.record_success()
-        else:
-            t.state, t.error = FAILED, error
-            telemetry.counter("serve.errors", tenant=t.tenant,
-                              kind=type(error).__name__).inc()
-            # feed the circuit breaker: a sustained storm of systemic
-            # failures (SLO expiries, resource exhaustion) trips it
-            # and new admissions shed while this in-flight set drains
-            self._admission.breaker.record_failure(type(error).__name__)
-        # SLO accounting (ISSUE 14): every retirement is a good/bad
-        # event against the tenant's objectives — burn-rate gauges
-        # serve.slo_burn{tenant,window} refresh here (no-op when the
-        # policy sets no slo_target)
-        self._slo.record(t.tenant, ok=error is None, latency_s=wall)
-        _events.emit("retire", tenant=t.tenant, rid=t.rid,
-                     state=t.state, wall_s=round(wall, 6),
-                     error=type(error).__name__ if error else None)
-        if self._journal is not None:
-            try:
-                self._journal.done(rid=t.rid,
-                                   key=getattr(op, "_idem_key", None),
-                                   state=t.state)
-            except OSError:  # pragma: no cover - journal best-effort
-                pass  # a full disk must not wedge retirement
-            except FailedPrecondition as e:
-                # journal FENCED mid-flight: a router declared this
-                # engine dead and is replaying its journal on a peer.
-                # The retirement still completes locally (the client
-                # holding this ticket gets its answer) but the done
-                # line must NOT race the replay — log loudly instead.
-                from cylon_tpu.utils.logging import get_logger
-
-                get_logger().error(
-                    "request %d retired but its journal is fenced "
-                    "(%s); a fleet router has failed this engine over",
-                    t.rid, e)
-        telemetry.timer("serve.request_seconds",
-                        tenant=t.tenant).observe(wall)
-        _trace.instant("serve.done" if error is None else "serve.error",
-                       cat="serve", tenant=t.tenant, rid=t.rid,
-                       wall=wall,
-                       error=type(error).__name__ if error else None)
-        holder = getattr(op, "_holder", None)
-        for tid in op._pins:
-            try:
-                catalog.unpin(tid, holder=holder)
-            except Exception:  # pragma: no cover - unpin best-effort
-                pass
-        self._admission.release()
-        # NOTE: t._event is set by _QueryOp.progress() after the step
+        fp = getattr(op, "_fp", None)
+        vv = getattr(op, "_vv", None)
+        followers: "list[dict]" = []
+        if fp is not None and vv is not None:
+            with self._cond:
+                # close the coalesce window FIRST: a submit racing
+                # this retirement must become a fresh leader (or a
+                # cache hit), never attach to an op that will no
+                # longer sweep
+                op._coalesce_closed = True
+                if self._coalesce.get((fp, vv)) is op:
+                    self._coalesce.pop((fp, vv), None)
+                followers = list(getattr(op, "_followers", ()))
+                op._followers = []
+        if error is None and getattr(op, "_degraded", False):
+            # the degrade COMPLETED: this is the moment the
+            # request earns degraded=true and the tenant counter
+            t.degraded = True
+            telemetry.counter("serve.degraded", tenant=t.tenant).inc()
+        # executed retirements feed the breaker and release the slot
+        # they took at admission (re-queued followers took none); the
+        # waiter event is set by _QueryOp.progress() after the step
         # scopes unwind (see there) — not here, which runs inside them
+        self._finish_ticket(
+            t, value=value, error=error,
+            idem_key=getattr(op, "_idem_key", None), pins=op._pins,
+            holder=getattr(op, "_holder", None),
+            release_slot=getattr(op, "_admitted", True),
+            feed_breaker=True, set_event=False)
+        if error is None:
+            ck = None
+            if fp is not None and vv is not None \
+                    and self._result_cache.enabled:
+                # store-at-retirement staleness guard: only publish
+                # if the read set is STILL at the admitted versions —
+                # an append that landed mid-flight makes this result
+                # answer data that no longer exists
+                cur = version_vector([tid for tid, _g, _d in vv])
+                if cur == vv:
+                    self._result_cache.store(fp, vv, value)
+                    ck = {"fingerprint": fp,
+                          "versions": [list(v) for v in vv]}
+                    t.cache_key = ck
+            for rec in followers:
+                if ck is not None:
+                    # the router learns (fp, vv) from whichever
+                    # ticket it polled — followers advertise the
+                    # SAME publishable key as their leader
+                    rec["ticket"].cache_key = ck
+                self._fanout_follower(rec, value)
+        else:
+            # leader failed: followers with SLO budget left re-run as
+            # their own ops; the rest fail cleanly (never silently)
+            for rec in followers:
+                rem = rec["ticket"].remaining()
+                if rem is None or rem > 0:
+                    self._requeue_follower(rec)
+                else:
+                    t2 = rec["ticket"]
+                    telemetry.counter("serve.expired",
+                                      tenant=t2.tenant).inc()
+                    self._finish_ticket(
+                        t2, error=error, idem_key=rec["key"],
+                        pins=rec["pins"], holder=rec["holder"])
 
     # ------------------------------------------------------- reporting
     @property

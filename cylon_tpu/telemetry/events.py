@@ -59,8 +59,9 @@ DEFAULT_CAPACITY = 8192
 #: call in the tree against this table — an unregistered kind fails
 #: tier-1 before it can ship an unparseable journal.
 EVENT_KINDS: "dict[str, tuple]" = {
-    # serve admission / lifecycle
-    "admit": ("slo",),
+    # serve admission / lifecycle (``path`` since ISSUE 19: how the
+    # request was answered — executed | cache_hit | coalesced)
+    "admit": ("slo", "path"),
     "retire": ("state", "wall_s", "error"),
     "shed": ("reason",),
     "degraded": ("error",),

@@ -386,6 +386,69 @@ def test_serve_record_schema_pins_robustness_columns():
             "recoveries"} <= REQUIRED_SERVE_FIELDS
 
 
+def test_serve_record_schema_pins_dedup_columns():
+    """ISSUE 19 satellite: the dedup plane's counters — result-cache
+    traffic and coalesced fan-outs — are part of the pinned serve
+    record, and the --hot-mix record pins the full acceptance surface
+    (the baseline-vs-hot QPS multiplier, the hot-phase hit rate, and
+    the staleness audit)."""
+    from cylon_tpu.serve.bench import (REQUIRED_HOTMIX_FIELDS,
+                                       REQUIRED_SERVE_FIELDS)
+
+    dedup = {"result_cache_hits", "result_cache_misses",
+             "result_cache_invalidations", "coalesced"}
+    assert dedup <= REQUIRED_SERVE_FIELDS
+    assert dedup | {"baseline_qps", "hot_qps", "qps_multiplier",
+                    "cache_hit_rate", "stale_results",
+                    "shed"} <= REQUIRED_HOTMIX_FIELDS
+
+
+def _result_cache_call_sites(path: pathlib.Path) -> list:
+    """Every ``<cache>.lookup(...)`` / ``<cache>.store(...)`` call in
+    ``path`` whose receiver is a result cache (a name containing
+    ``result_cache``, or the bare name ``cache``), as
+    ``(lineno, method, positional_argc)`` triples."""
+    tree = ast.parse(path.read_text(), filename=str(path))
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not isinstance(f, ast.Attribute) \
+                or f.attr not in ("lookup", "store"):
+            continue
+        recv = f.value
+        rname = (recv.attr if isinstance(recv, ast.Attribute)
+                 else getattr(recv, "id", ""))
+        if "result_cache" not in str(rname) and str(rname) != "cache":
+            continue
+        out.append((node.lineno, f.attr, len(node.args)))
+    return out
+
+
+def test_result_cache_calls_always_pass_version_vector():
+    """ISSUE 19 satellite: NO result-cache call site may key on the
+    query fingerprint alone — the table-version vector is the half of
+    the key that makes serving pre-append bytes after an append
+    unrepresentable. Both halves are required POSITIONAL arguments of
+    ``ResultCache.lookup``/``store``, so the lint walks every call in
+    the tree and asserts the vector is actually passed (lookup needs
+    >= 2 positional args, store >= 3: fingerprint, versions, value)."""
+    found = 0
+    for path in sorted((REPO / "cylon_tpu").rglob("*.py")):
+        for lineno, meth, argc in _result_cache_call_sites(path):
+            found += 1
+            need = 2 if meth == "lookup" else 3
+            assert argc >= need, (
+                f"{path.relative_to(REPO)}:{lineno} calls result-cache "
+                f".{meth}() with {argc} positional arg(s) — the "
+                "version vector must ride the key (fingerprint-only "
+                "keying would serve stale bytes across appends)")
+    # the engine admission path and the fleet router both hit the
+    # cache — if the lint finds neither, it is walking nothing
+    assert found >= 3, f"expected >=3 result-cache call sites, {found}"
+
+
 # ----------------------------------------- checkpoint/journal guards
 def test_every_ooc_entrypoint_accepts_resume_dir():
     """ISSUE 8 satellite: every public out-of-core entrypoint must

@@ -183,7 +183,14 @@ def test_kill_one_engine_mid_tpch_run_loses_nothing(tmp_path):
                       and e.get("op") == "q14"]
         q14_done_e1 = [k for k in done_e1 if key_q.get(k) == "q14"]
         assert set(replayed_q14) <= set(q14_done_e1)
-        assert len(merge_evts) >= len(q14_done_e1) >= 1, (
+        # at least one q14 EXECUTED on e1 and recomputed the scalar
+        # there; the identical repeats may legitimately share that
+        # execution through the versioned dedup plane (ISSUE 19:
+        # same fingerprint, same table-version vector — the cached
+        # value was itself computed on the survivor, post-failover,
+        # never trusted from the dead engine's journal), so the event
+        # count is >= 1, not >= one-per-done-line
+        assert len(q14_done_e1) >= 1 and len(merge_evts) >= 1, (
             merge_evts, q14_done_e1)
     finally:
         router.close()

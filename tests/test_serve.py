@@ -512,3 +512,281 @@ def test_serve_bench_record_schema_and_oracle_gate(env8):
     assert rec["completed"] == 4
     assert rec["cache_hit_rate"] > 0  # clients share the plan cache
     assert rec["p99_s"] is not None
+
+
+# ===================================================================
+# ISSUE 19: request coalescing + the versioned result cache
+# ===================================================================
+def _vsum_query(execs):
+    def q():
+        execs.append(1)
+        d = catalog.table_to_pydict("t")
+        return float(np.asarray(d["v"]).sum())
+    return q
+
+
+def test_result_cache_hit_is_byte_identical_and_journaled(tmp_path):
+    """A repeat submission under an unchanged table-version vector is
+    answered from the versioned result cache — byte-identical payload,
+    zero executions — and STILL journals admit+done lines, so a
+    recover() after a kill never replays an answer the client already
+    has."""
+    from cylon_tpu.serve.durability import RequestJournal
+
+    catalog.put_table("t", _t(16))
+    eng = ServeEngine(policy=ServePolicy(max_queue=8),
+                      durable_dir=str(tmp_path))
+    execs = []
+
+    def q():
+        execs.append(1)
+        d = catalog.table_to_pydict("t")
+        return np.asarray(d["v"], dtype=np.float64) * 3.0
+
+    eng.register_query("triple", q, tables=["t"])
+    t1 = eng.submit_named("triple", tenant="a")
+    v1 = t1.result(30)
+    t2 = eng.submit_named("triple", tenant="b")
+    v2 = t2.result(30)
+    assert execs == [1]  # ONE execution answered both tickets
+    assert t2.cache_hit and not t1.cache_hit
+    assert v2.tobytes() == v1.tobytes() and v2.dtype == v1.dtype
+    # both tickets advertise the SAME publishable (fp, versions) key
+    assert t1.cache_key is not None and t2.cache_key == t1.cache_key
+    assert telemetry.counter("serve.admitted", path="executed",
+                             tenant="a").value == 1
+    assert telemetry.counter("serve.admitted", path="cache_hit",
+                             tenant="b").value == 1
+    assert telemetry.total("serve.result_cache_hits") == 1
+    eng.close()
+    lines = RequestJournal.read(str(tmp_path))
+    admit_rids = {e["rid"] for e in lines if e["kind"] == "admit"}
+    done_rids = {e["rid"] for e in lines if e["kind"] == "done"}
+    assert {t1.rid, t2.rid} <= admit_rids
+    assert admit_rids == done_rids  # the cache hit journaled its done
+    eng2 = ServeEngine.recover(str(tmp_path), env=object(),
+                               queries={"triple": q})
+    assert eng2.recovery_report["replayed"] == {}
+    assert execs == [1]  # recovery re-ran NOTHING
+    eng2.close()
+
+
+def test_append_between_submissions_forces_miss_never_stale():
+    """The staleness contract: an append between two identical
+    submissions bumps the table's version vector, so the second
+    submission MISSES (precise invalidation) and recomputes against
+    the appended data — the stale sum is never served."""
+    catalog.put_table("t", _t(4))  # v = 0..3 -> 6.0
+    eng = ServeEngine(policy=ServePolicy(max_queue=8))
+    execs = []
+    eng.register_query("vsum", _vsum_query(execs), tables=["t"])
+    assert eng.submit_named("vsum").result(30) == 6.0
+    hit = eng.submit_named("vsum")
+    assert hit.result(30) == 6.0 and hit.cache_hit
+    misses0 = telemetry.total("serve.result_cache_misses")
+    catalog.append("t", {"k": np.asarray([100], dtype=np.int64),
+                         "v": np.asarray([10.0], dtype=np.float64)})
+    assert telemetry.total("serve.result_cache_invalidations") >= 1
+    t3 = eng.submit_named("vsum")
+    assert t3.result(30) == 16.0  # recomputed, not the stale 6.0
+    assert not t3.cache_hit
+    assert execs == [1, 1]
+    assert telemetry.total("serve.result_cache_misses") > misses0
+    eng.close()
+
+
+def test_append_mid_flight_blocks_stale_store():
+    """Store-at-retirement guard: an append landing while the query is
+    IN FLIGHT means the result no longer answers the admitted version
+    vector — it must not be published (and the ticket advertises no
+    cache_key), so the next submission re-executes."""
+    catalog.put_table("t", _t(4))
+    gate = threading.Event()
+    eng = ServeEngine(policy=ServePolicy(max_queue=8))
+    execs = []
+
+    def q():
+        execs.append(1)
+        while not gate.is_set():
+            yield
+            time.sleep(0.001)
+        d = catalog.table_to_pydict("t")
+        return float(np.asarray(d["v"]).sum())
+
+    eng.register_query("vsum", q, tables=["t"])
+    t1 = eng.submit_named("vsum")
+    catalog.append("t", {"k": np.asarray([100], dtype=np.int64),
+                         "v": np.asarray([10.0], dtype=np.float64)})
+    gate.set()
+    assert t1.result(30) == 16.0  # the step read post-append data...
+    assert t1.cache_key is None   # ...so the guard refused to publish
+    t2 = eng.submit_named("vsum")
+    assert t2.result(30) == 16.0 and not t2.cache_hit
+    assert execs == [1, 1]
+    eng.close()
+
+
+def test_coalesced_fanout_byte_identical_to_independent_runs(
+        monkeypatch):
+    """THE coalescing oracle: N identical in-flight submissions from
+    DIFFERENT tenants collapse to one scheduler op whose fan-out is
+    byte-identical to N independent (dedup-disabled) runs; a short-SLO
+    follower expires MID-FLIGHT with a clean DeadlineExceeded; nobody
+    but the leader observes queue wait; none of it feeds the circuit
+    breaker."""
+    catalog.put_table("t", _t(32))
+
+    def mk_query(execs, gate=None):
+        def q():
+            execs.append(1)
+            if gate is not None:
+                while not gate.is_set():
+                    yield
+                    time.sleep(0.001)
+            d = catalog.table_to_pydict("t")
+            return np.asarray(d["v"], dtype=np.float64) * 2.0
+        return q
+
+    # baseline: every dedup layer OFF -> three genuinely independent runs
+    monkeypatch.setenv("CYLON_TPU_SERVE_RESULT_CACHE_BYTES", "0")
+    monkeypatch.setenv("CYLON_TPU_SERVE_COALESCE", "0")
+    base_execs = []
+    eng0 = ServeEngine(policy=ServePolicy(max_queue=16))
+    eng0.register_query("double", mk_query(base_execs), tables=["t"])
+    baseline = [eng0.submit_named("double", tenant=t).result(30)
+                for t in ("a", "b", "c")]
+    eng0.close()
+    assert len(base_execs) == 3
+    telemetry.reset("serve.")  # counters below cover the hot phase only
+    # hot path: coalescing ON (cache stays off to isolate the layer)
+    monkeypatch.setenv("CYLON_TPU_SERVE_COALESCE", "1")
+    gate = threading.Event()
+    hot_execs = []
+    eng = ServeEngine(policy=ServePolicy(max_queue=16))
+    eng.register_query("double", mk_query(hot_execs, gate),
+                       tables=["t"])
+    leader = eng.submit_named("double", tenant="a")
+    f1 = eng.submit_named("double", tenant="b")
+    f2 = eng.submit_named("double", tenant="c", slo=30.0)
+    fx = eng.submit_named("double", tenant="d", slo=0.15)
+    assert leader.coalesced_role == "leader"
+    assert (f1.coalesced_role, f2.coalesced_role,
+            fx.coalesced_role) == ("follower",) * 3
+    # the short-SLO follower expires while the leader is still gated
+    # open and sweeping: it has no op of its own, yet its deadline fires
+    deadline = time.monotonic() + 10
+    while not fx.done and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert fx.done
+    with pytest.raises(DeadlineExceeded):
+        fx.result(1)
+    assert telemetry.counter("serve.expired", tenant="d").value == 1
+    gate.set()
+    got = [leader.result(30), f1.result(30), f2.result(30)]
+    assert len(hot_execs) == 1  # FOUR tickets, ONE execution
+    for g in got:
+        assert g.tobytes() == baseline[0].tobytes()
+        assert g.dtype == baseline[0].dtype
+    assert telemetry.total("serve.coalesced") == 3
+    for tn in ("b", "c", "d"):
+        assert telemetry.counter("serve.admitted", path="coalesced",
+                                 tenant=tn).value == 1
+        # satellite 2: followers never queued, never observe queue wait
+        assert telemetry.timer("serve.queue_wait_seconds",
+                               tenant=tn).count == 0
+    assert telemetry.counter("serve.admitted", path="executed",
+                             tenant="a").value == 1
+    # satellite 2: neither the expiry nor the fan-out fed the breaker
+    snap = eng._admission.breaker.snapshot()
+    assert snap["window_failures"] == 0 and snap["state"] == "closed"
+    eng.close()
+
+
+def test_leader_failure_requeues_followers_with_budget(monkeypatch):
+    """A failed leader fails ONLY the tickets that cannot re-run
+    within SLO: the budget-holding follower re-runs as its own op (one
+    extra execution, write-ahead journaled) while the expired one gets
+    a clean error — no ticket ever silently hangs."""
+    monkeypatch.setenv("CYLON_TPU_SERVE_RESULT_CACHE_BYTES", "0")
+    catalog.put_table("t", _t(8))
+    gate = threading.Event()
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) == 1:
+            while not gate.is_set():
+                yield
+                time.sleep(0.001)
+            raise TransientError("first run dies")
+        return 42
+
+    eng = ServeEngine(policy=ServePolicy(max_queue=16))
+    eng.register_query("flaky", flaky, tables=["t"])
+    leader = eng.submit_named("flaky", tenant="a")
+    keep = eng.submit_named("flaky", tenant="b")  # unbounded: re-runs
+    doomed = eng.submit_named("flaky", tenant="c", slo=0.15)
+    assert keep.coalesced_role == "follower"
+    assert doomed.coalesced_role == "follower"
+    deadline = time.monotonic() + 10
+    while not doomed.done and time.monotonic() < deadline:
+        time.sleep(0.01)  # burn doomed's budget while the leader spins
+    gate.set()
+    with pytest.raises(TransientError):
+        leader.result(30)
+    assert keep.result(30) == 42  # re-ran as its own scheduler op
+    with pytest.raises((TransientError, DeadlineExceeded)):
+        doomed.result(30)
+    assert len(calls) == 2  # leader + exactly ONE re-run
+    eng.close()
+
+
+def test_cache_hits_never_observe_queue_wait(monkeypatch):
+    """Satellite 2, cache half: a cache hit retires before submit()
+    returns — it never queued, so ``serve.queue_wait_seconds`` must
+    not grow (only the one real execution observed it)."""
+    catalog.put_table("t", _t(8))
+    eng = ServeEngine(policy=ServePolicy(max_queue=8))
+    execs = []
+    eng.register_query("vsum", _vsum_query(execs), tables=["t"])
+    eng.submit_named("vsum", tenant="a").result(30)
+    waits = telemetry.timer("serve.queue_wait_seconds",
+                            tenant="a").count
+    assert waits == 1
+    hit = eng.submit_named("vsum", tenant="a")
+    assert hit.result(30) == 28.0 and hit.cache_hit
+    assert telemetry.timer("serve.queue_wait_seconds",
+                           tenant="a").count == waits
+    assert execs == [1]
+    eng.close()
+
+
+def test_idem_eviction_drops_oldest_retired_first(monkeypatch):
+    """ISSUE 19 satellite 1 regression: past the cap the idempotency
+    map evicts by FINISH time, not dict-insertion order — k1 retires
+    LAST despite being inserted first, so the overflow victim is k2
+    (the oldest-retired), and k1's fresh result survives the bound."""
+    monkeypatch.setenv("CYLON_TPU_SERVE_IDEM_ENTRIES", "3")
+    eng = ServeEngine(policy=ServePolicy(max_queue=8))
+    gates = {k: threading.Event() for k in ("k1", "k2", "k3")}
+
+    def mk(k):
+        def q():
+            while not gates[k].is_set():
+                yield
+                time.sleep(0.001)
+            return k
+        return q
+
+    tks = {k: eng.submit(mk(k), idempotency_key=k)
+           for k in ("k1", "k2", "k3")}
+    for k in ("k2", "k3", "k1"):  # retire order != insertion order
+        gates[k].set()
+        assert tks[k].result(30) == k
+        time.sleep(0.02)  # strictly ordered finish stamps
+    t4 = eng.submit(lambda: 4, idempotency_key="k4")
+    assert t4.result(30) == 4
+    with eng._cond:
+        keys = set(eng._idem)
+    assert keys == {"k1", "k3", "k4"}  # k2 went first, k1 survived
+    eng.close()
