@@ -103,6 +103,17 @@ REQUIRED_FLEET_FIELDS = frozenset({
     "errors", "p99_before_s", "p99_during_s", "p99_after_s",
 })
 
+#: extra fields a ``--fleet-trace`` record must carry (ISSUE 20): the
+#: stitched-timeline artifact is only auditable if the record pins
+#: where the Chrome trace landed, how many spans and engine tracks it
+#: stitched, the clock-handshake jitter bound the alignment rests on,
+#: and the failover replay hops the headline trace id crossed.
+#: ``tests/test_bench_guard.py`` pins the set; main() asserts it.
+REQUIRED_FLEET_TRACE_FIELDS = frozenset({
+    "trace_path", "spans", "engines_stitched", "offset_jitter_s",
+    "replay_hops",
+})
+
 #: refresh-record fields (ISSUE 18): the ``--refresh`` acceptance is
 #: only auditable if every record pins the incremental-refresh wall
 #: against the from-scratch recompute wall (their ratio is the
@@ -1048,6 +1059,14 @@ def main(argv=None):
                    help="engine process count for --fleet (>= 2)")
     p.add_argument("--no-kill", action="store_true",
                    help="--fleet without the mid-run kill (baseline)")
+    p.add_argument("--fleet-trace", action="store_true",
+                   help="with --fleet (ISSUE 20): arm CYLON_TPU_TRACE "
+                        "fleet-wide, stitch the router's and every "
+                        "engine's trace segments onto one clock "
+                        "(ping-handshake offsets) and write the "
+                        "Chrome Trace artifact; the record gains the "
+                        "stitched-request report and the query-profile "
+                        "cost-model audit")
     p.add_argument("--hot-mix", action="store_true",
                    help="hot-mix dedup mode (ISSUE 19): replay a hot "
                         "mix (identical fingerprints) through the "
@@ -1117,9 +1136,14 @@ def main(argv=None):
             requests=max(args.requests, 2), sf=args.sf,
             seed=args.seed, engines=args.engines,
             mix=mix_arg or DEFAULT_MIX,
-            kill_mid_run=not args.no_kill)
+            kill_mid_run=not args.no_kill,
+            fleet_trace=args.fleet_trace)
         missing = REQUIRED_FLEET_FIELDS - record.keys()
         assert not missing, f"fleet record dropped fields {missing}"
+        if args.fleet_trace:
+            missing = REQUIRED_FLEET_TRACE_FIELDS - record.keys()
+            assert not missing, \
+                f"fleet-trace record dropped fields {missing}"
         _emit_record(record)
         # the acceptance gate: an acknowledged request lost, a double
         # execution, an oracle mismatch, or (with the kill armed) a

@@ -290,16 +290,20 @@ class RequestJournal:
     def admit(self, *, rid: int, key: "str | None", name: "str | None",
               args=(), kwargs=None, tenant: str = "default",
               priority: int = 1, slo: "float | None" = None,
-              tables=()) -> None:
+              tables=(), trace_id: "str | None" = None) -> None:
         """Write-ahead record of one admitted request. Falls back to
         ``replayable: false`` (with args dropped) when the payload is
         not JSON-serializable — the journal must never fail a submit
-        that the engine would otherwise accept."""
+        that the engine would otherwise accept. ``trace_id`` (ISSUE
+        20) rides the entry so a failover REPLAY of this request can
+        keep the original fleet trace identity."""
         entry = {"kind": "admit", "rid": int(rid), "key": key,
                  "name": name, "args": list(args),
                  "kwargs": dict(kwargs or {}), "tenant": str(tenant),
                  "priority": int(priority), "slo": slo,
                  "tables": list(tables),
+                 "trace_id": (None if trace_id is None
+                              else str(trace_id)),
                  "replayable": name is not None}
         try:
             self._append(entry)

@@ -68,8 +68,25 @@ The moving parts:
   double-executes.
 
 Telemetry: ``fleet.routed{engine,tenant}``, ``fleet.failovers``,
-``fleet.replayed``, ``fleet.lost_acks``, ``fleet.deduped`` counters
-plus ``failover``/``fence`` entries in the structured event journal.
+``fleet.replayed``, ``fleet.lost_acks``, ``fleet.deduped``,
+``fleet.events_gap{engine}`` counters plus ``failover``/``fence``/
+``events_gap`` entries in the structured event journal.
+
+Fleet tracing (ISSUE 20, armed by ``CYLON_TPU_TRACE`` like the local
+flight recorder — the unarmed router mints nothing and pulls nothing):
+every admitted request gets a ``trace_id`` minted at
+:meth:`FleetRouter.submit` (the outermost entry), carried to the
+engine as ``X-Cylon-Trace-Id``/``X-Cylon-Parent-Span`` headers on
+``POST /submit`` — headers, not body kwargs, so the journaled replay
+entry keeps the query's own arguments — and kept by a failover replay
+(the journal entry records the id; the survivor re-runs under it with
+a ``fleet.replay_hop`` marker). The poll loop additionally drains each
+engine's cursored ``/trace?since=`` segments and estimates per-engine
+clock offsets from the ``/ping`` wall stamp (midpoint method), so
+:meth:`FleetRouter.fleet_trace_buffers` hands
+:func:`cylon_tpu.telemetry.trace.merge_timelines` one aligned
+router+engines timeline per run (the ``--fleet-trace`` bench leg's
+Chrome trace artifact).
 
 Knobs (``docs/serving.md`` knob table):
 
@@ -121,6 +138,7 @@ from cylon_tpu.serve.result_cache import (ResultCache,
                                           cache_bytes_from_env,
                                           hook_on_append)
 from cylon_tpu.telemetry import events as _events
+from cylon_tpu.telemetry import trace as _trace
 from cylon_tpu.utils.logging import get_logger
 
 __all__ = [
@@ -448,7 +466,11 @@ class EngineGateway:
         if path == "/ping":
             h._reply(503 if eng.closing else 200,
                      {"ok": not eng.closing, "closing": eng.closing,
-                      "live": eng.live})
+                      "live": eng.live,
+                      # wall-clock stamp for the router's clock-offset
+                      # handshake (midpoint method): offset =
+                      # ts - (t0 + t1)/2 around the probe
+                      "ts": time.time()})
             return
         if path.startswith("/result/"):
             rid = path.rsplit("/", 1)[1]
@@ -502,6 +524,15 @@ class EngineGateway:
             h._reply(400, {"error": f"malformed submit body: {e}",
                            "kind": "InvalidArgument"})
             return
+        # the fleet trace context crosses the process hop as HTTP
+        # headers, never as body kwargs — the journal must record the
+        # query's OWN kwargs so a replay's fingerprint still matches.
+        # submit_named strips the _trace_* control keywords before
+        # fingerprinting for the same reason.
+        tid = h.headers.get("X-Cylon-Trace-Id")
+        parent = h.headers.get("X-Cylon-Parent-Span")
+        if parent is not None and parent.isdigit():
+            parent = int(parent)
         try:
             ticket = eng.submit_named(
                 str(body["name"]), *body.get("args", ()),
@@ -510,6 +541,7 @@ class EngineGateway:
                 priority=int(body.get("priority", 1)),
                 slo=body.get("slo"),
                 tables=body.get("tables", ()),
+                _trace_id=tid, _parent_span=parent,
                 **body.get("kwargs", {}))
         except ResourceExhausted as e:
             h._reply(429, {"error": str(e),
@@ -555,11 +587,12 @@ class HttpEngineClient:
         self.probe_timeout = probe_timeout
 
     def _request(self, url: str, data: "bytes | None" = None,
-                 timeout: float = 10.0) -> dict:
-        req = urllib.request.Request(
-            url, data=data,
-            headers={"Content-Type": "application/json"} if data
-            else {})
+                 timeout: float = 10.0,
+                 headers: "dict | None" = None) -> dict:
+        hdrs = {"Content-Type": "application/json"} if data else {}
+        if headers:
+            hdrs.update(headers)
+        req = urllib.request.Request(url, data=data, headers=hdrs)
         try:
             with urllib.request.urlopen(req, timeout=timeout) as r:
                 return json.loads(r.read())
@@ -605,14 +638,21 @@ class HttpEngineClient:
     def submit(self, name: str, args=(), kwargs=None,
                tenant: str = "default", priority: int = 1,
                slo=None, key: "str | None" = None,
-               tables=()) -> int:
+               tables=(), trace_id: "str | None" = None,
+               parent_span=None) -> int:
         body = {"name": name, "args": list(args),
                 "kwargs": dict(kwargs or {}), "tenant": tenant,
                 "priority": priority, "slo": slo, "key": key,
                 "tables": list(tables)}
+        headers = {}
+        if trace_id is not None:
+            headers["X-Cylon-Trace-Id"] = str(trace_id)
+            if parent_span is not None:
+                headers["X-Cylon-Parent-Span"] = str(parent_span)
         out = self._request(self.gateway_url + "/submit",
                             data=json.dumps(body).encode(),
-                            timeout=max(self.probe_timeout, 10.0))
+                            timeout=max(self.probe_timeout, 10.0),
+                            headers=headers or None)
         if "rid" not in out:
             raise EngineUnavailable(
                 f"engine {self.name!r} refused submit: {out}")
@@ -635,6 +675,22 @@ class HttpEngineClient:
         return self._request(
             f"{self.introspect_url}/events?since={int(cursor)}",
             timeout=self.probe_timeout)
+
+    def trace_since(self, cursor: int = 0) -> dict:
+        """The engine's cursored ``/trace?since=`` span segment (same
+        payload discipline as :meth:`events_since`)."""
+        if self.introspect_url is None:
+            return {"events": [], "cursor": int(cursor), "dropped": 0,
+                    "armed": False}
+        return self._request(
+            f"{self.introspect_url}/trace?since={int(cursor)}",
+            timeout=self.probe_timeout)
+
+    def ping(self) -> dict:
+        """Raw gateway liveness reply — carries the engine's wall
+        ``ts`` for the router's clock-offset handshake."""
+        return self._request(self.gateway_url + "/ping",
+                             timeout=self.probe_timeout)
 
     def metrics_window(self, window: "float | None" = None) -> dict:
         if self.introspect_url is None:
@@ -663,7 +719,8 @@ class LocalEngineClient:
     def submit(self, name: str, args=(), kwargs=None,
                tenant: str = "default", priority: int = 1,
                slo=None, key: "str | None" = None,
-               tables=()) -> int:
+               tables=(), trace_id: "str | None" = None,
+               parent_span=None) -> int:
         if self.engine.closing:
             e = EngineUnavailable(
                 f"engine {self.name!r} is closing")
@@ -672,6 +729,7 @@ class LocalEngineClient:
         t = self.engine.submit_named(
             name, *args, idempotency_key=key, tenant=tenant,
             priority=priority, slo=slo, tables=tables,
+            _trace_id=trace_id, _parent_span=parent_span,
             **(kwargs or {}))
         return t.rid
 
@@ -698,6 +756,15 @@ class LocalEngineClient:
 
     def events_since(self, cursor: int = 0) -> dict:
         return _events.since(cursor)
+
+    def trace_since(self, cursor: int = 0) -> dict:
+        return _trace.since(cursor)
+
+    def ping(self) -> dict:
+        # in-process: same clock as the router, so the handshake's
+        # midpoint estimate converges on ~0 offset
+        return {"ok": not self.engine.closing,
+                "closing": self.engine.closing, "ts": time.time()}
 
     def metrics_window(self, window: "float | None" = None) -> dict:
         from cylon_tpu.telemetry import timeseries
@@ -733,6 +800,15 @@ class _EngineState:
         self.dead = False
         self.last_window: "dict | None" = None
         self.events_seen = 0
+        # fleet tracing (ISSUE 20): the engine's pulled /trace segment
+        # stream (cursored, bounded like the source ring) plus the
+        # ping-handshake clock estimate — all idle until the router's
+        # recorder is armed
+        self.trace_cursor = 0
+        self.trace_events: list = []
+        self.trace_dropped = 0
+        self.clock_offset: "float | None" = None
+        self.offset_jitter: "float | None" = None
 
     def snapshot(self) -> dict:
         return {"name": self.name, "status": self.status,
@@ -757,6 +833,9 @@ class RouterTicket:
         self.rid: "int | None" = None
         self._lost: "str | None" = None
         self.submitted = time.monotonic()
+        #: the fleet trace id minted for this request (None unarmed) —
+        #: one id names the whole causal chain, failover hops included
+        self.trace_id: "str | None" = None
 
     @property
     def engine(self) -> "str | None":
@@ -870,6 +949,10 @@ class FleetRouter:
         self._mu = threading.RLock()
         self._states = {c.name: _EngineState(c) for c in clients}
         self._cursors = {c.name: 0 for c in clients}
+        # fleet tracing arms off the SAME env as the local recorder:
+        # one check at construction — an unarmed router never mints
+        # ids, opens spans, handshakes clocks or pulls /trace
+        self._trace_armed = _trace.enabled()
         self.poll_interval = (poll_interval if poll_interval is not None
                               else _poll_interval())
         self.fail_threshold = (fail_threshold
@@ -977,7 +1060,35 @@ class FleetRouter:
         survives the engine the original ran on); an unknown key is
         stamped on the engine-side journal, so a failover replay and a
         client retry can never both execute. Keys are generated when
-        the client brings none (the replay path needs one)."""
+        the client brings none (the replay path needs one).
+
+        When tracing is armed this is the request's OUTERMOST entry:
+        it mints the ``trace_id``, opens the router-side
+        ``fleet.submit`` span, and hands both across the HTTP hop —
+        the engine's first span links back here via ``parent_span``."""
+        if not self._trace_armed:
+            return self._submit_routed(
+                name, args, kwargs, tenant=tenant,
+                idempotency_key=idempotency_key, priority=priority,
+                slo=slo, tables=tables, trace_id=None,
+                parent_span=None)
+        trace_id = _trace.new_trace_id()
+        with _trace.trace_context(trace_id):
+            tok = _trace.begin("fleet.submit", cat="fleet",
+                               query=str(name), tenant=str(tenant))
+            try:
+                return self._submit_routed(
+                    name, args, kwargs, tenant=tenant,
+                    idempotency_key=idempotency_key,
+                    priority=priority, slo=slo, tables=tables,
+                    trace_id=trace_id,
+                    parent_span=tok[0] if tok else None)
+            finally:
+                _trace.end(tok)
+
+    def _submit_routed(self, name, args, kwargs, *, tenant,
+                       idempotency_key, priority, slo, tables,
+                       trace_id, parent_span) -> RouterTicket:
         key = idempotency_key or \
             f"fleet-{os.getpid()}-{next(self._kseq)}"
         with self._mu:
@@ -987,6 +1098,7 @@ class FleetRouter:
                                   tenant=tenant).inc()
                 return existing
             ticket = RouterTicket(self, key, name, tenant)
+            ticket.trace_id = trace_id
             self._tickets[key] = ticket
         # fleet-scoped cache check BEFORE any engine is touched: the
         # fingerprint is computed router-side (same canonical JSON the
@@ -1028,7 +1140,8 @@ class FleetRouter:
                 rid = st.client.submit(
                     name, args=args, kwargs=kwargs, tenant=tenant,
                     priority=priority, slo=slo, key=key,
-                    tables=tables)
+                    tables=tables, trace_id=trace_id,
+                    parent_span=parent_span)
             except EngineUnavailable as e:
                 self._note_failure(st.name, reason="submit")
                 if not (getattr(e, "refused", False)
@@ -1130,6 +1243,17 @@ class FleetRouter:
                 self._cursors[st.name] = ev.get(
                     "cursor", self._cursors[st.name])
                 st.events_seen += len(ev.get("events", ()))
+                gap = int(ev.get("dropped", 0) or 0)
+                if gap:
+                    # the engine's journal ring evicted entries before
+                    # this poll read them — the router fell behind.
+                    # Counted per engine and journaled, never silent:
+                    # a gap can hide an append (stale fleet cache) or
+                    # a replayed admit.
+                    telemetry.counter("fleet.events_gap",
+                                      engine=st.name).inc(gap)
+                    _events.emit("events_gap", engine=st.name,
+                                 dropped=gap)
                 # fleet-cache invalidation rides the same cursor: an
                 # append ANY engine journals evicts exactly the cached
                 # results whose version vector read that table (for
@@ -1139,6 +1263,8 @@ class FleetRouter:
                     if e.get("kind") == "append" and e.get("table"):
                         self._result_cache.invalidate_table(
                             e["table"])
+                if self._trace_armed:
+                    self._pull_trace(st)
                 st.last_window = st.client.metrics_window()
             except Exception:
                 # the health verdict landed; a flaky events/window read
@@ -1159,6 +1285,50 @@ class FleetRouter:
         if dwell > self.unhealthy_dwell:
             self._fail_over(st.name,
                             reason=f"{st.status}_past_dwell")
+
+    def _pull_trace(self, st: "_EngineState") -> None:
+        """Advance one engine's ``/trace`` cursor: append its new span
+        segment to the router-side buffer (bounded like the source
+        ring, same eviction-means-gap accounting) and, once per
+        engine, estimate the clock offset from a ping handshake."""
+        if st.clock_offset is None:
+            st.clock_offset, st.offset_jitter = \
+                self._clock_handshake(st.client)
+        tr = st.client.trace_since(st.trace_cursor)
+        st.trace_cursor = tr.get("cursor", st.trace_cursor)
+        st.trace_dropped += int(tr.get("dropped", 0) or 0)
+        st.trace_events.extend(tr.get("events", ()))
+        del st.trace_events[:-_trace.DEFAULT_CAPACITY]
+
+    @staticmethod
+    def _clock_handshake(client,
+                         probes: int = 5) -> "tuple[float, float]":
+        """Estimate ``engine_clock - router_clock`` by the midpoint
+        method: each ping reads the engine's wall ``ts`` between local
+        stamps t0/t1, giving ``offset = ts - (t0 + t1)/2``; the probe
+        with the smallest round trip wins and its half-RTT bounds the
+        asymmetry error (the recorded jitter). A reply with no ``ts``
+        (an older gateway) contributes nothing; all-failed probes fall
+        back to (0, 0) — same-host fleets, the bench topology, are
+        near-0 anyway and the jitter says how much to trust it."""
+        best = None
+        for _ in range(max(int(probes), 1)):
+            t0 = time.time()
+            try:
+                pong = client.ping()
+            except Exception:
+                continue
+            t1 = time.time()
+            ts = pong.get("ts")
+            if not isinstance(ts, (int, float)):
+                continue
+            rtt = max(t1 - t0, 0.0)
+            off = float(ts) - (t0 + t1) / 2.0
+            if best is None or rtt < best[0]:
+                best = (rtt, off)
+        if best is None:
+            return 0.0, 0.0
+        return best[1], best[0] / 2.0
 
     def _is_dead(self, name: "str | None") -> bool:
         with self._mu:
@@ -1197,6 +1367,11 @@ class FleetRouter:
                 fence_journal(durable, owner=f"router:{os.getpid()}")
                 _events.emit("fence", engine=name,
                              owner=f"router:{os.getpid()}")
+                # mark the barrier on the router's trace track too:
+                # the stitched timeline shows the victim go quiet,
+                # THE FENCE, then the survivor's replay hops
+                _trace.instant("fleet.fence", cat="fleet",
+                               engine=name, reason=reason)
             except OSError as e:  # pragma: no cover - fs failure
                 log.error("fleet: could not fence %s: %s", durable, e)
         replayed, lost = self._replay_journal(st, durable)
@@ -1259,14 +1434,26 @@ class FleetRouter:
                                         or key in self._failures):
                     continue  # outcome already delivered via router
             tenant = e.get("tenant", "default")
+            # the replayed request keeps its ORIGINAL trace id (the
+            # dead engine's journal recorded it at admission): one id
+            # names router admission, the dead engine's partial run,
+            # the fence, and the survivor's re-run — with this hop
+            # marker stitching the two engine tracks together
+            tid = e.get("trace_id")
             try:
                 with self._mu:
                     peer = self._pick_locked(tenant)
-                rid = peer.client.submit(
-                    e["name"], args=e.get("args", ()),
-                    kwargs=e.get("kwargs", {}), tenant=tenant,
-                    priority=e.get("priority", 1), slo=e.get("slo"),
-                    key=key, tables=e.get("tables", ()))
+                with _trace.trace_context(tid):
+                    rid = peer.client.submit(
+                        e["name"], args=e.get("args", ()),
+                        kwargs=e.get("kwargs", {}), tenant=tenant,
+                        priority=e.get("priority", 1),
+                        slo=e.get("slo"), key=key,
+                        tables=e.get("tables", ()), trace_id=tid)
+                    if tid is not None:
+                        _trace.instant("fleet.replay_hop",
+                                       cat="fleet", engine=peer.name,
+                                       key=key)
             except Exception as exc:
                 lost += 1
                 get_logger().error(
@@ -1320,6 +1507,38 @@ class FleetRouter:
                 "deduped": telemetry.total("fleet.deduped"),
                 "lost_acks": telemetry.total("fleet.lost_acks"),
             }
+
+    def fleet_trace_buffers(self, drain: bool = True) -> "list[dict]":
+        """Per-PROCESS trace buffers for
+        :func:`cylon_tpu.telemetry.trace.merge_timelines`: the
+        router's own recorder as the reference track (offset 0) plus
+        every engine's pulled ``/trace`` segments on its
+        handshake-estimated clock offset. ``drain`` pulls each
+        engine's cursor once more first, so spans emitted after the
+        last poll tick are included — call BEFORE :meth:`close` while
+        survivors still answer (a dead engine's tail was pulled when
+        it still lived, or is part of the gap accounting)."""
+        with self._mu:
+            states = list(self._states.values())
+        if drain and self._trace_armed:
+            for st in states:
+                try:
+                    self._pull_trace(st)
+                except Exception:
+                    pass  # dead engine: keep what the polls got
+        bufs = [{"proc": "router", "pid": os.getpid(),
+                 "clock_offset": 0.0, "offset_jitter": 0.0,
+                 "dropped": _trace.dropped(),
+                 "events": _trace.events()}]
+        for st in states:
+            bufs.append({
+                "proc": st.name,
+                "pid": getattr(st.client, "pid", None),
+                "clock_offset": st.clock_offset or 0.0,
+                "offset_jitter": st.offset_jitter,
+                "dropped": st.trace_dropped,
+                "events": list(st.trace_events)})
+        return bufs
 
 
 # ----------------------------------------------------- engine process
@@ -1590,12 +1809,106 @@ def audit_double_executions(layout: FleetLayout,
     return len(doubles), doubles
 
 
+def _fleet_trace_artifact(router: "FleetRouter", root: str) -> dict:
+    """Collect and stitch the fleet's per-process trace buffers (call
+    BEFORE the router closes — the final cursor drain wants live
+    survivors) and write the Chrome Trace artifact under ``root``.
+    Returns the ``--fleet-trace`` record fields
+    (:data:`cylon_tpu.serve.bench.REQUIRED_FLEET_TRACE_FIELDS`) plus
+    the stitched report of the headline request — when the chaos kill
+    produced a failover replay, that request's SINGLE trace id spans
+    router admission, the dead engine's partial run, and the
+    survivor's replay hop."""
+    from cylon_tpu.telemetry.export import write_chrome_trace
+
+    bufs = router.fleet_trace_buffers()
+    merged = _trace.merge_timelines(bufs)
+    path = write_chrome_trace(
+        os.path.join(root, "fleet_trace.trace.json"), bufs)
+    jitters = [b.get("offset_jitter") for b in bufs[1:]
+               if isinstance(b.get("offset_jitter"), (int, float))]
+    hops = [e for e in merged if e.get("name") == "fleet.replay_hop"]
+    hop_tids = sorted({e.get("trace_id") for e in hops
+                       if e.get("trace_id")})
+    stitched = None
+    if hop_tids:
+        # several requests may have replayed; headline the one whose
+        # events survive on the MOST process tracks (a dead engine's
+        # unpulled ring segments die with it — some replayed traces
+        # keep the victim's partial run, some don't), ties broken by
+        # event count then tid for determinism
+        def _coverage(tid):
+            evs = [e for e in merged if e.get("trace_id") == tid]
+            return (len({e.get("proc") for e in evs}), len(evs))
+
+        best = max(sorted(hop_tids), key=_coverage)
+        stitched = _trace.fleet_request_report(merged, best)
+    else:  # no failover this run: report the busiest trace instead
+        by_tid: "dict[str, int]" = {}
+        for e in merged:
+            t = e.get("trace_id")
+            if t:
+                by_tid[t] = by_tid.get(t, 0) + 1
+        if by_tid:
+            top = max(sorted(by_tid), key=lambda t: by_tid[t])
+            stitched = _trace.fleet_request_report(merged, top)
+    return {
+        "trace_path": path,
+        "spans": sum(1 for e in merged if e.get("kind") == "begin"),
+        "engines_stitched": sum(1 for b in bufs[1:]
+                                if b.get("events")),
+        "offset_jitter_s": (round(max(jitters), 6) if jitters
+                            else None),
+        "replay_hops": len(hops),
+        "trace_dropped": sum(int(b.get("dropped", 0) or 0)
+                             for b in bufs),
+        "stitched_request": stitched,
+    }
+
+
+def _fleet_history_check(layout: FleetLayout, mix) -> dict:
+    """Audit the query-profile cost model against the run it just
+    learned from: merge the engines' persisted histories (each engine
+    saved ``profile_history.json`` at clean close; a SIGKILLed one
+    never did — the merge reads what survived) and compare each mix
+    query's ``predicted_wall_s`` against the mean of its own executed
+    walls. The ISSUE 20 acceptance gates the prediction within 2x of
+    actual — measured against real executions, not against a probe
+    request that would resolve from the result cache."""
+    from cylon_tpu.telemetry import profile as _profile
+
+    paths = [os.path.join(layout.engine_dir(n), _profile.HISTORY_FILE)
+             for n in layout.engine_names()]
+    paths = [p for p in paths if os.path.exists(p)]
+    hist = _profile.merged_history(paths)
+    checks: "dict[str, dict | None]" = {}
+    for q in mix:
+        # fleet queries take no arguments: the engine-side fingerprint
+        # at record time is the same canonical hash over (name, (), {})
+        fp = plan.query_fingerprint(q, (), {})
+        est = hist.predict(fp) if fp is not None else None
+        if est is None or not est.get("samples"):
+            checks[q] = None
+            continue
+        mean = float(est["mean_wall_s"])
+        pred = float(est["predicted_wall_s"])
+        checks[q] = {
+            "predicted_wall_s": round(pred, 4),
+            "actual_mean_wall_s": round(mean, 4),
+            "samples": est["samples"],
+            "within_2x": bool(mean > 0
+                              and 0.5 <= pred / mean <= 2.0),
+        }
+    return {"history_files": len(paths), "queries": checks}
+
+
 def run_fleet_bench(clients: int = 16, requests: int = 3,
                     sf: float = 0.002, seed: int = 0,
                     mix=DEFAULT_MIX, engines: int = 2,
                     kill_mid_run: bool = True,
                     root: "str | None" = None,
-                    result_timeout: float = 600.0) -> dict:
+                    result_timeout: float = 600.0,
+                    fleet_trace: bool = False) -> dict:
     """The ISSUE 15 measured acceptance: ≥2 engine processes over one
     durable tree, N concurrent clients replaying the TPC-H mix through
     the router, one engine SIGKILLed mid-run. Every ticket the router
@@ -1616,6 +1929,12 @@ def run_fleet_bench(clients: int = 16, requests: int = 3,
     if engines < 2:
         raise InvalidArgument(
             f"a fleet needs >= 2 engines, got {engines}")
+    if fleet_trace:
+        # arm the flight recorder fleet-wide: this (router) process
+        # plus — via env inheritance and the explicit extra below —
+        # every spawned engine. The leg is opt-in, so mutating the
+        # env here mirrors how the storm leg arms CYLON_TPU_EVENTS.
+        os.environ["CYLON_TPU_TRACE"] = "1"
     root = root or os.environ.get("CYLON_BENCH_FLEET_DIR") \
         or tempfile.mkdtemp(prefix="cylon_fleet_")
     layout = FleetLayout(root)
@@ -1636,8 +1955,10 @@ def run_fleet_bench(clients: int = 16, requests: int = 3,
     router = None
     try:
         for i in range(engines):
-            procs.append(spawn_engine(root, f"e{i}", sf=sf,
-                                      seed=seed, mix=mix))
+            procs.append(spawn_engine(
+                root, f"e{i}", sf=sf, seed=seed, mix=mix,
+                env_extra={"CYLON_TPU_TRACE": "1"} if fleet_trace
+                else None))
         # SIGKILL detection rides connection-refused polls (threshold
         # 3 at 0.25s — ~1s to DEAD); the dwell only governs
         # verdict-based failover and is deliberately generous so a
@@ -1650,7 +1971,7 @@ def run_fleet_bench(clients: int = 16, requests: int = 3,
             router, procs, layout, oracles, clients=clients,
             requests=requests, sf=sf, mix=mix,
             kill_mid_run=kill_mid_run, root=root,
-            result_timeout=result_timeout)
+            result_timeout=result_timeout, fleet_trace=fleet_trace)
     finally:
         if router is not None:
             router.close()
@@ -1663,7 +1984,7 @@ def run_fleet_bench(clients: int = 16, requests: int = 3,
 
 def _drive_fleet_bench(router, procs, layout, oracles, *, clients,
                        requests, sf, mix, kill_mid_run, root,
-                       result_timeout) -> dict:
+                       result_timeout, fleet_trace=False) -> dict:
     """The measured body of :func:`run_fleet_bench` (engines/router
     lifecycle owned by the caller's try/finally)."""
     import numpy as np  # noqa: F401  (quantiles in _phase_p99s)
@@ -1761,6 +2082,11 @@ def _drive_fleet_bench(router, procs, layout, oracles, *, clients,
             errors.append(("retry_probe",
                            f"{type(e).__name__}: {e}"))
 
+    # the stitched trace must be collected while survivors still
+    # answer /trace (the final cursor drain) and before the poll
+    # loops stop
+    trace_extra = (_fleet_trace_artifact(router, root)
+                   if fleet_trace else None)
     # stop the poll loop BEFORE terminating survivors (a still-running
     # poll would read the graceful shutdown as one more "failover"),
     # then stop the engines so their journals are quiescent to audit
@@ -1810,6 +2136,12 @@ def _drive_fleet_bench(router, procs, layout, oracles, *, clients,
     for k in ("p99_before_s", "p99_during_s", "p99_after_s"):
         if record[k] is not None:
             record[k] = round(record[k], 4)
+    if trace_extra is not None:
+        record.update(trace_extra)
+        # the engines just closed cleanly (terminate → SIGTERM →
+        # engine.close saves profile_history.json), so the merged
+        # query-profile history is on disk to audit the cost model
+        record["cost_model"] = _fleet_history_check(layout, mix)
     return record
 
 

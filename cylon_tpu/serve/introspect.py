@@ -40,6 +40,12 @@ path                       payload
 ``/events``                the structured event journal replayed in
                            order from ``?since=<cursor>``
                            (:func:`cylon_tpu.telemetry.events.since`)
+``/trace``                 the flight recorder's trace segment from
+                           ``?since=<cursor>``
+                           (:func:`cylon_tpu.telemetry.trace.since` —
+                           same cursor/gap discipline as ``/events``;
+                           ``armed: false`` when ``CYLON_TPU_TRACE``
+                           never armed the recorder)
 ``/queries``               in-flight tickets — tenant, state, elapsed,
                            remaining SLO budget, step count — plus the
                            process's active watchdog sections (what
@@ -70,8 +76,8 @@ __all__ = ["maybe_start", "IntrospectServer", "ENDPOINTS",
 
 #: the read-only surface (for docs and the landing page)
 ENDPOINTS = ("/healthz", "/health", "/metrics", "/metrics/window",
-             "/events", "/queries", "/tenants", "/tables", "/views",
-             "/profiles/<rid>")
+             "/events", "/trace", "/queries", "/tenants", "/tables",
+             "/views", "/profiles/<rid>")
 
 #: /health status thresholds over the composite score (1.0 = pristine)
 _OK_SCORE = 0.8
@@ -309,6 +315,7 @@ class IntrospectServer:
         from cylon_tpu import telemetry, watchdog
         from cylon_tpu.telemetry import events as _events
         from cylon_tpu.telemetry import timeseries as _ts
+        from cylon_tpu.telemetry import trace as _trace
 
         path, _, query = h.path.partition("?")
         path = path.rstrip("/") or "/"
@@ -361,6 +368,15 @@ class IntrospectServer:
                              f"{qs['since'][0]!r}"})
                 return
             self._send(h, 200, _events.since(cursor))
+        elif path == "/trace":
+            try:
+                cursor = int(qs.get("since", ["0"])[0])
+            except ValueError:
+                self._send(h, 400, {
+                    "error": f"malformed since cursor "
+                             f"{qs['since'][0]!r}"})
+                return
+            self._send(h, 200, _trace.since(cursor))
         elif path == "/metrics":
             self._send(h, 200, telemetry.to_prometheus(),
                        content_type="text/plain; version=0.0.4; "

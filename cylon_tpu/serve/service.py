@@ -158,6 +158,13 @@ class QueryTicket:
         #: under (set at retirement IFF the version vector was still
         #: current) — the fleet router's cross-engine cache key
         self.cache_key: "dict | None" = None
+        #: fleet trace identity (ISSUE 20): the one id naming this
+        #: request's whole causal chain — inherited from the router's
+        #: HTTP headers, minted at direct submit when tracing is
+        #: armed, None (zero cost) otherwise. A failover REPLAY keeps
+        #: the original id, so the stitched timeline spans engines.
+        self.trace_id: "str | None" = None
+        self.parent_span = None
         self._event = threading.Event()
         #: ANALYZE profiler (telemetry.profile.RequestProfiler), set
         #: at admission unless CYLON_TPU_SERVE_PROFILE=0
@@ -296,8 +303,12 @@ class _QueryOp(Op):
         # not its fallback callable goes through run_with_fallback.
         telemetry.counter("ooc.fallbacks", op="serve",
                           reason="oom").inc()
-        _trace.instant("serve.degrade", cat="serve", tenant=t.tenant,
-                       rid=t.rid, error=type(e).__name__)
+        with _trace.trace_context(t.trace_id, t.parent_span):
+            # the degrade re-run keeps the SAME trace_id: one id names
+            # admission, the OOM'd attempt AND the spill-path rerun
+            _trace.instant("serve.degrade", cat="serve",
+                           tenant=t.tenant, rid=t.rid,
+                           error=type(e).__name__)
         _events.emit("degraded", tenant=t.tenant, rid=t.rid,
                      error=type(e).__name__)
         from cylon_tpu.utils.logging import get_logger
@@ -323,6 +334,12 @@ class _QueryOp(Op):
             # metric/trace/section carries the label), then the SLO
             # budget, then the request's fault plan — scoped to this
             # step only, which is the whole isolation argument
+            if t.trace_id is not None:
+                # every span/instant the step records carries the
+                # request's fleet trace id (None = unarmed: this
+                # branch costs one attribute read, nothing else)
+                stack.enter_context(_trace.trace_context(
+                    t.trace_id, t.parent_span))
             stack.enter_context(telemetry.tenant_scope(t.tenant))
             if rem is not None:
                 stack.enter_context(watchdog.deadline(
@@ -423,6 +440,15 @@ class ServeEngine:
             # snapshot store while journals stay per-engine
             self._journal = RequestJournal(durable_dir)
             self._snapshot = CatalogSnapshot(snapshot_dir or durable_dir)
+        #: measured query-cost history (ISSUE 20): executed walls
+        #: keyed by (fingerprint, row bucket), persisted under the
+        #: durable tree so explain()'s predicted_wall_s survives a
+        #: restart and merges fleet-wide. Durable engines only — the
+        #: same class of hot-path cost as the write-ahead journal.
+        self._profile_history = None
+        if durable_dir is not None:
+            self._profile_history = _profile.ProfileHistory(
+                os.path.join(durable_dir, _profile.HISTORY_FILE))
         self.durable_dir = durable_dir
         #: bounded rid -> ticket history (live AND retired): the
         #: lookup surface behind /profiles/<rid> and QueryTicket
@@ -563,6 +589,8 @@ class ServeEngine:
                _journal_name: "str | None" = None,
                _fingerprint: "str | None" = None,
                _read_tables=None,
+               _trace_id: "str | None" = None,
+               _parent_span=None,
                **kwargs) -> QueryTicket:
         """Admit one query for scheduled execution.
 
@@ -599,6 +627,15 @@ class ServeEngine:
                 telemetry.counter("serve.idempotent_hits",
                                   tenant=tenant).inc()
                 return existing
+        # fleet trace identity (ISSUE 20): adopt the propagated id
+        # (router → gateway headers → here), else the ambient context,
+        # else mint at this outermost entry — ONLY when tracing is
+        # armed. Unarmed: one env read, trace_id stays None and every
+        # downstream trace hook short-circuits on that None.
+        if _trace_id is None and _trace.enabled():
+            _trace_id = _trace.current_trace_id() or _trace.new_trace_id()
+            if _parent_span is None:
+                _parent_span = _trace.current_parent_span()
         # journal the PRE-normalization slo: an explicit slo<=0
         # ("unbounded") must replay unbounded, not pick up the engine
         # default the way a None would
@@ -624,14 +661,16 @@ class ServeEngine:
                     cached, fp, vv, tenant=tenant, priority=priority,
                     slo=slo, slo_raw=slo_raw, key=key,
                     journal_name=_journal_name, args=args,
-                    kwargs=kwargs, tables=tables)
+                    kwargs=kwargs, tables=tables,
+                    trace_id=_trace_id, parent_span=_parent_span)
         if vv is not None and self._coalesce_on():
             follower = self._maybe_attach_follower(
                 fp, vv, fn=fn, args=args, kwargs=kwargs,
                 tenant=tenant, priority=priority, slo=slo,
                 slo_raw=slo_raw, key=key, tables=tables,
                 fault_plan=fault_plan, fallback=fallback,
-                journal_name=_journal_name)
+                journal_name=_journal_name, trace_id=_trace_id,
+                parent_span=_parent_span)
             if follower is not None:
                 return follower
         # may raise ResourceExhausted (queue cap, breaker, or the
@@ -639,6 +678,7 @@ class ServeEngine:
         self._admission.admit(tenant, predicted_bytes=predicted_bytes)
         ticket = QueryTicket(next(self._ids), str(tenant),
                              int(priority), slo)
+        ticket.trace_id, ticket.parent_span = _trace_id, _parent_span
         if _profile.profiling_enabled():
             ticket._profiler = _profile.RequestProfiler()
         holder = f"{tenant}/req{ticket.rid}"
@@ -677,8 +717,10 @@ class ServeEngine:
         telemetry.counter("serve.requests", tenant=ticket.tenant).inc()
         telemetry.counter("serve.admitted", path="executed",
                           tenant=ticket.tenant).inc()
-        _trace.instant("serve.admit", cat="serve", tenant=ticket.tenant,
-                       rid=ticket.rid, slo=slo)
+        with _trace.trace_context(_trace_id, _parent_span):
+            _trace.instant("serve.admit", cat="serve",
+                           tenant=ticket.tenant, rid=ticket.rid,
+                           slo=slo)
         _events.emit("admit", tenant=ticket.tenant, rid=ticket.rid,
                      slo=slo, path="executed")
         # WRITE-AHEAD: the journal records the admission durably BEFORE
@@ -776,7 +818,8 @@ class ServeEngine:
 
     def _admit_cache_hit(self, value, fp, vv, *, tenant, priority,
                          slo, slo_raw, key, journal_name, args,
-                         kwargs, tables) -> QueryTicket:
+                         kwargs, tables, trace_id=None,
+                         parent_span=None) -> QueryTicket:
         """Serve one admission straight from the versioned result
         cache: the ticket retires DONE before submit() returns — no
         admission slot, no scheduler op, no mesh work. The request is
@@ -789,6 +832,7 @@ class ServeEngine:
         ticket = QueryTicket(next(self._ids), str(tenant),
                              int(priority), slo)
         ticket.cache_hit = True
+        ticket.trace_id, ticket.parent_span = trace_id, parent_span
         ticket.cache_key = {"fingerprint": fp,
                             "versions": [list(v) for v in vv]}
         if _profile.profiling_enabled():
@@ -805,10 +849,16 @@ class ServeEngine:
         telemetry.counter("serve.requests", tenant=ticket.tenant).inc()
         telemetry.counter("serve.admitted", path="cache_hit",
                           tenant=ticket.tenant).inc()
-        _trace.instant("serve.admit", cat="serve",
-                       tenant=ticket.tenant, rid=ticket.rid, slo=slo)
+        with _trace.trace_context(trace_id, parent_span):
+            # the short-circuit is part of the request's causal chain:
+            # its admit/done instants carry the propagated trace_id
+            _trace.instant("serve.admit", cat="serve",
+                           tenant=ticket.tenant, rid=ticket.rid,
+                           slo=slo)
         _events.emit("admit", tenant=ticket.tenant, rid=ticket.rid,
                      slo=slo, path="cache_hit")
+        _events.emit("cache_hit", tenant=ticket.tenant,
+                     rid=ticket.rid, fingerprint=fp)
         try:
             self._journal_admit(ticket, journal_name, args, kwargs,
                                 key, slo_raw, tables)
@@ -826,7 +876,9 @@ class ServeEngine:
     def _maybe_attach_follower(self, fp, vv, *, fn, args, kwargs,
                                tenant, priority, slo, slo_raw, key,
                                tables, fault_plan, fallback,
-                               journal_name) -> "QueryTicket | None":
+                               journal_name, trace_id=None,
+                               parent_span=None
+                               ) -> "QueryTicket | None":
         """Micro-batched dispatch: if an identical ``(fp, vv)`` op is
         already in the queue, attach this request to it as a FOLLOWER
         — its own ticket (tenant label, SLO deadline, journal entry,
@@ -843,6 +895,7 @@ class ServeEngine:
             ticket = QueryTicket(next(self._ids), str(tenant),
                                  int(priority), slo)
             ticket.coalesced_role = "follower"
+            ticket.trace_id, ticket.parent_span = trace_id, parent_span
             if _profile.profiling_enabled():
                 ticket._profiler = _profile.RequestProfiler()
             holder = f"{tenant}/req{ticket.rid}"
@@ -872,11 +925,15 @@ class ServeEngine:
                               tenant=ticket.tenant).inc()
             telemetry.counter("serve.coalesced",
                               tenant=ticket.tenant).inc()
-            _trace.instant("serve.admit", cat="serve",
-                           tenant=ticket.tenant, rid=ticket.rid,
-                           slo=slo)
+            with _trace.trace_context(trace_id, parent_span):
+                _trace.instant("serve.admit", cat="serve",
+                               tenant=ticket.tenant, rid=ticket.rid,
+                               slo=slo)
             _events.emit("admit", tenant=ticket.tenant,
                          rid=ticket.rid, slo=slo, path="coalesced")
+            _events.emit("coalesced", tenant=ticket.tenant,
+                         rid=ticket.rid,
+                         leader_rid=leader.ticket.rid)
             # WRITE-AHEAD: the follower journals its OWN admit line
             # before it can be answered — recover() after a kill
             # replays it independently of the leader's fate
@@ -1032,10 +1089,11 @@ class ServeEngine:
                     t.rid, e)
         telemetry.timer("serve.request_seconds",
                         tenant=t.tenant).observe(wall)
-        _trace.instant("serve.done" if error is None else "serve.error",
-                       cat="serve", tenant=t.tenant, rid=t.rid,
-                       wall=wall,
-                       error=type(error).__name__ if error else None)
+        with _trace.trace_context(t.trace_id, t.parent_span):
+            _trace.instant(
+                "serve.done" if error is None else "serve.error",
+                cat="serve", tenant=t.tenant, rid=t.rid, wall=wall,
+                error=type(error).__name__ if error else None)
         for tid in pins:
             try:
                 catalog.unpin(tid, holder=holder)
@@ -1051,7 +1109,11 @@ class ServeEngine:
     #: (and therefore to its registered fallback's signature too)
     _CONTROL_KW = frozenset({
         "tenant", "priority", "slo", "tables", "fault_plan",
-        "idempotency_key", "fallback", "predicted_bytes"})
+        "idempotency_key", "fallback", "predicted_bytes",
+        # propagated fleet trace context (gateway → submit_named →
+        # submit): underscore-prefixed so no query kwarg can collide,
+        # excluded here so the fingerprint stays trace-independent
+        "_trace_id", "_parent_span"})
 
     def submit_named(self, name: str, *args,
                      idempotency_key: "str | None" = None,
@@ -1101,7 +1163,7 @@ class ServeEngine:
             rid=ticket.rid, key=key, name=name, args=args,
             kwargs=kwargs, tenant=ticket.tenant,
             priority=ticket.priority, slo=slo_raw,
-            tables=list(tables))
+            tables=list(tables), trace_id=ticket.trace_id)
 
     def _dispatch(self, op: "_QueryOp", ticket: QueryTicket) -> None:
         """Hand one admitted (and, if durable, journaled) request to
@@ -1217,6 +1279,14 @@ class ServeEngine:
                     # SAME publishable key as their leader
                     rec["ticket"].cache_key = ck
                 self._fanout_follower(rec, value)
+            if followers:
+                # one leader execution just answered N+1 tickets —
+                # the micro-batch itself, journaled (satellite 1)
+                _events.emit(
+                    "batch_retire", tenant=t.tenant, rid=t.rid,
+                    followers=len(followers),
+                    wall_s=round(t.finished - t.submitted, 6))
+            self._record_profile_history(op, t)
         else:
             # leader failed: followers with SLO budget left re-run as
             # their own ops; the rest fail cleanly (never silently)
@@ -1231,6 +1301,67 @@ class ServeEngine:
                     self._finish_ticket(
                         t2, error=error, idem_key=rec["key"],
                         pins=rec["pins"], holder=rec["holder"])
+
+    def _record_profile_history(self, op: "_QueryOp",
+                                t: QueryTicket) -> None:
+        """Persist one executed retirement into the measured cost
+        history: (fingerprint, pow2 row bucket) -> execution wall.
+        Runs on the scheduler thread after the request completed (the
+        row read is a host-side scalar fetch, never racing the mesh).
+        Unfingerprinted or non-durable: no-op."""
+        fp = getattr(op, "_fp", None)
+        hist = self._profile_history
+        if hist is None or fp is None:
+            return
+        bucket = None
+        try:
+            # the SAME derivation explain() uses for its lookup key:
+            # pow2 bucket of the largest input table's true rows
+            from cylon_tpu.parallel.dist_ops import batched_true_rows
+            from cylon_tpu.plan import _result_tables
+            from cylon_tpu.utils import pow2_bucket
+
+            tbls = _result_tables((list(op._args),
+                                   dict(op._kwargs)))
+            if tbls:
+                bucket = pow2_bucket(max(batched_true_rows(tbls)))
+        except Exception:  # pragma: no cover - bucket best-effort
+            bucket = None
+        started = t.started if t.started is not None else t.submitted
+        wall = max((t.finished or started) - started, 0.0)
+        hist.record(fp, bucket, wall, path="executed",
+                    degraded=t.degraded)
+
+    def explain_named(self, name: str, *args, **kwargs) -> dict:
+        """EXPLAIN a registered query with this engine's measured
+        profile history attached: the :func:`explain` plan plus
+        ``cost_estimate.predicted_wall_s`` — the median wall previous
+        executions of the same (fingerprint, row bucket) actually
+        took (None until the history has samples, or on a
+        non-durable engine)."""
+        entry = self._queries.get(str(name))
+        if entry is None:
+            raise InvalidArgument(
+                f"no query registered under {name!r}; "
+                f"register_query() it first (known: "
+                f"{sorted(self._queries)})")
+        fn, _fb, reg_tables = entry
+        qkw = {k: v for k, v in kwargs.items()
+               if k not in self._CONTROL_KW}
+        read = set(reg_tables) | {str(t) for t in
+                                  kwargs.get("tables", ())}
+        fp = (plan.query_fingerprint(name, args, qkw)
+              if read else None)
+        return _profile.explain(fn, *args,
+                                _history=self._profile_history,
+                                _fingerprint=fp, **qkw)
+
+    @property
+    def profile_history(self) -> "_profile.ProfileHistory | None":
+        """The engine's measured cost history (None when not
+        durable) — :func:`cylon_tpu.telemetry.profile.merged_history`
+        folds every fleet member's into one estimator."""
+        return self._profile_history
 
     # ------------------------------------------------------- reporting
     @property
@@ -1478,6 +1609,8 @@ class ServeEngine:
             self._cond.notify_all()
         if self._thread is not None and self._thread.is_alive():
             self._thread.join(timeout)
+        if self._profile_history is not None:
+            self._profile_history.save()
         if self._journal is not None:
             self._journal.close()
         if self._http is not None:

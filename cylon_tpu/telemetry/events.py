@@ -65,6 +65,13 @@ EVENT_KINDS: "dict[str, tuple]" = {
     "retire": ("state", "wall_s", "error"),
     "shed": ("reason",),
     "degraded": ("error",),
+    # the ISSUE 19 dedup plane, journaled (ISSUE 20): a versioned
+    # result-cache hit answered without executing; a submission
+    # attached as a coalesce follower behind a leader; a retiring
+    # leader fanned its value out to N followers at once
+    "cache_hit": ("fingerprint",),
+    "coalesced": ("leader_rid",),
+    "batch_retire": ("followers", "wall_s"),
     # memory pressure
     "oom": ("point", "error"),
     # circuit breaker transitions (engine-wide, no tenant)
@@ -82,6 +89,10 @@ EVENT_KINDS: "dict[str, tuple]" = {
     # fleet router (ISSUE 15; engine-less process — no tenant/rid)
     "failover": ("engine", "reason", "replayed", "lost"),
     "fence": ("engine", "owner"),
+    # the router's /events?since= poll saw an eviction gap: `dropped`
+    # spans fell out of the engine's ring before the cursor caught up
+    # (ISSUE 20 — storm-time observability loss, itself observable)
+    "events_gap": ("engine", "dropped"),
     # appendable tables + materialized views (ISSUE 18): a delta
     # landed on a resident table / a view folded its pending deltas in
     "append": ("table", "generation", "delta_rows"),

@@ -227,6 +227,13 @@ def to_chrome_trace(buffers, world: "int | None" = None) -> dict:
     counter track per device shard (pid ``SHARD_PID_BASE + shard``) so
     a single-controller trace still shows every rank's data volume.
 
+    Fleet process tracks (ISSUE 20): a buffer carrying a ``proc`` name
+    (a router or engine process from
+    ``FleetRouter.fleet_trace_buffers``) renders as its own process
+    track — pid is the buffer's real OS ``pid`` when known, and the
+    track is named after the process — so one artifact shows the
+    router and every engine side by side on the router's clock.
+
     Timestamps are microseconds on rank 0's clock (each buffer's
     ``clock_offset`` is subtracted). Everything is strict-JSON
     (``json_safe``); open in Perfetto / ``chrome://tracing``.
@@ -244,19 +251,36 @@ def to_chrome_trace(buffers, world: "int | None" = None) -> dict:
             t0 = t if t0 is None else min(t0, t)
     t0 = t0 or 0.0
     shard_tracks = set()
-    for buf in buffers:
-        rank = int(buf.get("rank", 0))
+    for i, buf in enumerate(buffers):
+        proc = buf.get("proc")
+        if proc is not None:
+            pid = buf.get("pid")
+            # a proc buffer with no known OS pid gets a synthetic one
+            # above the shard-track band so tracks never collide
+            rank = (int(pid) if isinstance(pid, int)
+                    else 2 * SHARD_PID_BASE + i)
+            label = str(proc)
+        else:
+            rank = int(buf.get("rank", 0))
+            label = f"rank {rank}"
         off = float(buf.get("clock_offset", 0.0) or 0.0)
         world = world or buf.get("world")
         meta.append({"ph": "M", "name": "process_name", "pid": rank,
                      "tid": 0, "ts": 0.0,
-                     "args": {"name": f"rank {rank}"}})
+                     "args": {"name": label}})
         for e in buf.get("events", ()):
             us = (e["ts"] - off - t0) * 1e6
             tid = e.get("tid", 0)
             kind = e["kind"]
             cat = e.get("cat") or "span"
             args = dict(e.get("args") or {})
+            # fleet trace-context stamps live at the event's top level
+            # (not in args) — fold them in so a stitched artifact is
+            # greppable/filterable by request trace id in Perfetto
+            for ck in ("trace_id", "parent_span"):
+                cv = e.get(ck)
+                if cv is not None:
+                    args.setdefault(ck, cv)
             if kind == "begin":
                 raw.append({"ph": "B", "pid": rank, "tid": tid,
                             "ts": us, "name": e["name"], "cat": cat,

@@ -33,9 +33,18 @@ watermark. Field set pinned by :data:`REQUIRED_PROFILE_FIELDS`
 Cost model: two registry scans plus one memory sample per step —
 host-side dict walks, no device syncs. ``CYLON_TPU_SERVE_PROFILE=0``
 disables per-request profiling entirely.
+
+**Query-profile history** (ISSUE 20): retired tickets' measured walls
+persist into a bounded per-(query fingerprint, pow2 row bucket)
+:class:`ProfileHistory` under the engine's durable tree, survive
+restarts, merge fleet-wide (:func:`merged_history` over every
+engine's ``profile_history.json``), and surface through
+:func:`explain` as ``cost_estimate.predicted_wall_s`` — the measured
+substrate ROADMAP item 5's adaptive router will learn from.
 """
 
 import contextlib
+import json
 import os
 import time
 
@@ -44,6 +53,7 @@ from cylon_tpu.telemetry.export import json_safe
 
 __all__ = [
     "REQUIRED_PROFILE_FIELDS", "profiling_enabled", "RequestProfiler",
+    "ProfileHistory", "merged_history", "HISTORY_FILE",
     "explain", "explain_text", "profile_text",
 ]
 
@@ -347,6 +357,197 @@ class RequestProfiler:
         return json_safe(prof)
 
 
+# ---------------------------------------------------------- history
+#: bound on measured samples kept per (fingerprint, bucket) key — a
+#: ring: new walls evict the oldest, so the estimate tracks the
+#: current regime instead of averaging over a month of drift.
+DEFAULT_HISTORY_SAMPLES = 64
+#: bound on distinct (fingerprint, bucket) keys — least-recently
+#: recorded keys evict first.
+DEFAULT_HISTORY_KEYS = 512
+#: file name under the engine's durable dir.
+HISTORY_FILE = "profile_history.json"
+#: persist every N records (plus at engine close) — the history is a
+#: cost-model cache, not a durability journal; losing the tail of one
+#: is a few samples, never an ack.
+_HISTORY_FLUSH_EVERY = 32
+
+
+class ProfileHistory:
+    """Bounded, persistent record of measured query walls keyed by
+    ``(query fingerprint, pow2 row bucket)``.
+
+    The engine records one sample per *executed* retirement (cache
+    hits and coalesce followers ride a leader's wall — recording them
+    would double-count); :meth:`predict` answers with the median
+    executed wall and the sample count, which :func:`explain`
+    surfaces as ``cost_estimate``. Persistence is an atomic
+    whole-file JSON swap under the durable tree
+    (:data:`HISTORY_FILE`), so a restarted engine resumes with its
+    measured past and :func:`merged_history` can fold every fleet
+    member's file into one fleet-wide estimator.
+
+    Thread-safe: the scheduler thread records, any thread may read."""
+
+    def __init__(self, path: "str | None" = None, *,
+                 max_keys: int = DEFAULT_HISTORY_KEYS,
+                 samples_per_key: int = DEFAULT_HISTORY_SAMPLES):
+        import threading
+
+        self._mu = threading.Lock()
+        self.path = path
+        self._max_keys = max(int(max_keys), 1)
+        self._n = max(int(samples_per_key), 1)
+        # "fp::bucket" -> list of sample dicts; dict insertion order
+        # doubles as the LRU order (record() moves a key to the end)
+        self._data: "dict[str, list]" = {}
+        self._unsaved = 0
+        if path is not None:
+            self._load()
+
+    @staticmethod
+    def _key(fingerprint, bucket) -> str:
+        return f"{fingerprint}::{'' if bucket is None else bucket}"
+
+    def _load(self) -> None:
+        try:
+            with open(self.path) as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError):
+            return  # absent / torn file: start empty, never raise
+        keys = doc.get("keys") if isinstance(doc, dict) else None
+        if not isinstance(keys, dict):
+            return
+        with self._mu:
+            for k, ring in keys.items():
+                if not isinstance(ring, list):
+                    continue
+                samples = [s for s in ring if isinstance(s, dict)
+                           and isinstance(s.get("wall_s"),
+                                          (int, float))]
+                if samples:
+                    self._data[str(k)] = samples[-self._n:]
+
+    # ---------------------------------------------------------- write
+    def record(self, fingerprint, bucket, wall_s: float, *,
+               path: str = "executed",
+               degraded: bool = False) -> None:
+        """Append one measured wall for ``(fingerprint, bucket)``.
+        No-op when the query is unfingerprinted (writes, ad-hoc
+        callables)."""
+        if fingerprint is None:
+            return
+        samp = {"wall_s": float(wall_s), "path": str(path),
+                "degraded": bool(degraded), "wall": time.time()}
+        k = self._key(fingerprint, bucket)
+        with self._mu:
+            ring = self._data.pop(k, None)
+            if ring is None:
+                ring = []
+                while len(self._data) >= self._max_keys:
+                    self._data.pop(next(iter(self._data)))
+            self._data[k] = ring  # (re-)insert at LRU tail
+            ring.append(samp)
+            del ring[:-self._n]
+            self._unsaved += 1
+            flush = (self.path is not None
+                     and self._unsaved >= _HISTORY_FLUSH_EVERY)
+            if flush:
+                self._unsaved = 0
+        if flush:
+            self.save()
+
+    def save(self) -> None:
+        """Atomic whole-file persist (tmp + rename); IO failure is
+        swallowed — the in-memory estimator must never pay for a full
+        disk."""
+        if self.path is None:
+            return
+        with self._mu:
+            doc = {"version": 1,
+                   "keys": {k: list(v) for k, v in self._data.items()}}
+            self._unsaved = 0
+        tmp = f"{self.path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w") as fh:
+                json.dump(json_safe(doc), fh, allow_nan=False,
+                          separators=(",", ":"))
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, self.path)
+        except OSError:
+            with contextlib.suppress(OSError):
+                os.unlink(tmp)
+
+    def merge(self, other: "ProfileHistory") -> None:
+        """Fold another history's samples into this one (fleet-wide
+        merge). Samples interleave by record time and stay bounded
+        per key."""
+        with other._mu:
+            theirs = {k: list(v) for k, v in other._data.items()}
+        with self._mu:
+            for k, ring in theirs.items():
+                mine = self._data.setdefault(k, [])
+                mine.extend(ring)
+                mine.sort(key=lambda s: s.get("wall", 0.0))
+                del mine[:-self._n]
+            while len(self._data) > self._max_keys:
+                self._data.pop(next(iter(self._data)))
+
+    # ----------------------------------------------------------- read
+    def predict(self, fingerprint, bucket=None) -> "dict | None":
+        """Measured cost estimate for ``(fingerprint, bucket)``::
+
+            {"predicted_wall_s": <median executed wall>,
+             "mean_wall_s": <mean>, "samples": <count>,
+             "bucket": <key used>}
+
+        Falls back to pooling every bucket of the fingerprint when
+        the exact bucket has no samples (a new scale inherits the
+        query's overall cost until measured). ``None`` when the
+        history has never seen the query."""
+        pooled = bucket
+        with self._mu:
+            samples = list(self._data.get(
+                self._key(fingerprint, bucket), ()))
+            if not samples:
+                pfx = f"{fingerprint}::"
+                for k, ring in self._data.items():
+                    if k.startswith(pfx):
+                        samples.extend(ring)
+                pooled = None
+        walls = sorted(s["wall_s"] for s in samples
+                       if s.get("path") == "executed"
+                       and not s.get("degraded"))
+        if not walls:  # only degraded/short-circuit samples: use all
+            walls = sorted(s["wall_s"] for s in samples)
+        if not walls:
+            return None
+        mid = len(walls) // 2
+        med = (walls[mid] if len(walls) % 2
+               else (walls[mid - 1] + walls[mid]) / 2.0)
+        return {"predicted_wall_s": med,
+                "mean_wall_s": sum(walls) / len(walls),
+                "samples": len(walls), "bucket": pooled}
+
+    def keys(self) -> list:
+        with self._mu:
+            return list(self._data)
+
+    def __len__(self) -> int:
+        with self._mu:
+            return sum(len(v) for v in self._data.values())
+
+
+def merged_history(paths) -> ProfileHistory:
+    """One fleet-wide estimator from every engine's persisted
+    :data:`HISTORY_FILE` (absent/torn files contribute nothing)."""
+    fleet = ProfileHistory()
+    for p in paths:
+        fleet.merge(ProfileHistory(path=str(p)))
+    return fleet
+
+
 # ----------------------------------------------------------- EXPLAIN
 #: relational-op vocabulary the static scan recognises in a query
 #: function's code objects — the pre-execution "ops" line of EXPLAIN.
@@ -395,7 +596,8 @@ def _input_tables(args, kwargs) -> list:
     return _result_tables((list(args), dict(kwargs)))
 
 
-def explain(fn, *args, **kwargs) -> dict:
+def explain(fn, *args, _history=None, _fingerprint=None,
+            **kwargs) -> dict:
     """Pre-execution plan for ``fn(*args, **kwargs)`` — nothing runs,
     nothing compiles.
 
@@ -407,13 +609,23 @@ def explain(fn, *args, **kwargs) -> dict:
                      "columns", "distributed"}, ...],
          "row_hint": pow2-bucket | None, "scale": int,
          "cache_state": "hit" | "miss" | "untracked",
-         "plan_cache": plan_cache_stats()}
+         "plan_cache": plan_cache_stats(),
+         "cost_estimate": ProfileHistory.predict() | None}
 
     For a :class:`~cylon_tpu.plan.CompiledQuery` (or
     ``plan.shared_compiled`` product) the scale / row hint /
     cache-state are exactly what the next call would dispatch with;
     for a bare callable they are the defaults a fresh compile would
     start from.
+
+    ``_history`` (a :class:`ProfileHistory`, e.g. the engine's own or
+    a fleet-wide :func:`merged_history`) turns the static plan into a
+    measured cost estimate: ``cost_estimate.predicted_wall_s`` is the
+    median executed wall previous runs of the same (fingerprint, row
+    bucket) actually took. ``_fingerprint`` overrides the fingerprint
+    derivation for registered queries dispatched by name (the
+    underscore prefix keeps both out of the query's own kwargs, same
+    convention as ``ServeEngine.submit``'s ``_journal_name``).
     """
     import jax
 
@@ -437,6 +649,10 @@ def explain(fn, *args, **kwargs) -> dict:
             "distributed": bool(dtable.is_distributed(t)),
         })
     hint = None if rows is None else pow2_bucket(max(rows))
+    # the history key's bucket BEFORE the compiled-query hint override
+    # below — recording (service retirement) uses the same derivation,
+    # so predict() looks up exactly the key record() wrote
+    row_bucket = hint
     scale, cache_state = 1, "untracked"
     if cq is not None:
         dyn_pos, static_pos, static_kw, dyn_kw = plan._split_args(
@@ -458,6 +674,14 @@ def explain(fn, *args, **kwargs) -> dict:
     from cylon_tpu.ops import hash_join
 
     ops = _query_ops(fn)
+    estimate = None
+    if _history is not None:
+        fp = _fingerprint
+        if fp is None:
+            with contextlib.suppress(Exception):
+                fp = plan.query_fingerprint(name, args, kwargs)
+        if fp is not None:
+            estimate = _history.predict(fp, row_bucket)
     return json_safe({
         "query": name,
         "compiled": cq is not None,
@@ -468,6 +692,9 @@ def explain(fn, *args, **kwargs) -> dict:
         "scale": scale,
         "cache_state": cache_state,
         "plan_cache": plan.plan_cache_stats(),
+        # measured cost model (ISSUE 20): None until a ProfileHistory
+        # is supplied AND has seen this query
+        "cost_estimate": estimate,
         # static join-kernel routing (which implementation an
         # algorithm="hash" join in this plan would take right now —
         # env overrides + chain-overflow fallback rules included)
@@ -503,6 +730,13 @@ def explain_text(plan_dict: dict) -> str:
     lines.append(f"  plan cache: {pc.get('hits', 0)} hits / "
                  f"{pc.get('misses', 0)} misses "
                  f"(rate {pc.get('hit_rate', 0):.2f})")
+    est = p.get("cost_estimate")
+    if est:
+        lines.append(
+            f"  cost: predicted_wall_s="
+            f"{est['predicted_wall_s']:.4f} "
+            f"(measured, {est['samples']} sample(s), "
+            f"bucket={est.get('bucket')})")
     return "\n".join(lines)
 
 

@@ -81,13 +81,19 @@ def _env_float(name: str, default: float) -> float:
 
 def quantile_from_buckets(buckets: "dict[str, int]",
                           q: float) -> "float | None":
-    """Bucket-resolution quantile from a sparse ``{le: count}`` bucket
-    dict (the snapshot/delta wire shape): the upper bound of the first
-    bucket whose cumulative count reaches ``q * total``. Overflow
-    (``+inf``) observations resolve to the largest finite bound —
-    windowed views carry no min/max to clamp by, so resolution is
-    exactly one power-of-2 bucket (the documented trade of the shared
-    ladder). None when the window holds no observations."""
+    """Quantile from a sparse ``{le: count}`` bucket dict (the
+    snapshot/delta wire shape), **log-linearly interpolated** inside
+    the power-of-2 bucket the target falls in (ISSUE 20 fix): the old
+    upper-bound answer could overstate a windowed p99 by up to 2×
+    (BENCH_r08 recorded 8.0s against a 4.8s exact p99 — a 1.67× lie
+    the router's health verdict consumed). The shared ladder doubles
+    every bound, so each bucket spans ``(le/2, le]``; assuming
+    observations spread log-uniformly inside it, the quantile at
+    in-bucket fraction ``f`` is ``(le/2) * 2**f`` — exact at both
+    edges, and never past the bound the observation provably fits
+    under. Overflow (``+inf``) observations still resolve to the
+    largest finite bound — windowed views carry no min/max to clamp
+    by. None when the window holds no observations."""
     if not 0.0 <= q <= 1.0:
         raise ValueError(f"quantile {q} not in [0, 1]")
     finite = [(float(le), n) for le, n in buckets.items()
@@ -100,9 +106,12 @@ def quantile_from_buckets(buckets: "dict[str, int]",
     target = q * total
     cum = 0
     for le, n in finite:
+        if cum + n >= target:
+            # in-bucket fraction of the target, clamped so q=0 maps
+            # to the lower edge and a full bucket to its bound
+            frac = min(max((target - cum) / n, 0.0), 1.0)
+            return (le / 2.0) * (2.0 ** frac)
         cum += n
-        if cum >= target:
-            return le
     # target falls in the overflow bucket: the ladder cannot resolve
     # past its top — report the largest finite bound seen
     return finite[-1][0] if finite else float(_r.BUCKET_BOUNDS[-1])

@@ -43,6 +43,21 @@ Timestamps are seconds on a wall-aligned monotonic clock:
 ``perf_counter`` plus a process-constant offset captured when the
 recorder arms, so durations keep ``perf_counter`` resolution while
 cross-process merges can subtract wall-clock offsets.
+
+Fleet tracing (ISSUE 20): a request that crosses PROCESSES — router →
+gateway → engine scheduler → (maybe) a failover replay on a second
+engine — carries an ambient **trace context** (:func:`trace_context`:
+a ``trace_id`` minted at the outermost entry plus the parent span id
+on the other side of the hop). Armed emitters stamp ``trace_id`` onto
+every event inside the scope, so one id names the whole causal chain
+however many processes it hops. Each recorder additionally stamps a
+monotone ``seq`` per event and exports bounded cursored segments via
+:func:`since` (the ``/trace?since=`` introspect payload — same
+cursor/gap discipline as the event journal), and
+:func:`merge_timelines` accepts process tracks (buffers carrying a
+``proc`` name and a handshake-estimated ``clock_offset``) so
+:func:`fleet_request_report` can attribute one request's wall across
+router-queue / engine-queue / dispatch / replay-hop phases.
 """
 
 import collections
@@ -52,13 +67,16 @@ import itertools
 import os
 import threading
 import time
+import uuid
 
 from cylon_tpu.telemetry.registry import current_tenant as _current_tenant
 
 __all__ = [
     "enabled", "begin", "end", "span", "instant", "counter", "complete",
-    "events", "clear", "dropped", "merge_timelines", "rank_buffers",
-    "critical_path", "stage_coverage", "filter_tenant",
+    "events", "clear", "dropped", "since", "merge_timelines",
+    "rank_buffers", "critical_path", "stage_coverage", "filter_tenant",
+    "new_trace_id", "trace_context", "current_trace_id",
+    "current_parent_span", "request_timeline", "fleet_request_report",
     "DEFAULT_CAPACITY",
 ]
 
@@ -101,8 +119,12 @@ class TraceRecorder:
                 # this append evicts the oldest event: the recording
                 # is silently lossy from here on — say so ONCE
                 self._warned = warn = True
-            self._buf.append(evt)
             self._appended += 1
+            # the monotone per-event cursor /trace?since= resumes from
+            # (survives ring eviction, so a consumer that fell behind
+            # sees the GAP instead of silently missing spans)
+            evt["seq"] = self._appended
+            self._buf.append(evt)
         if warn:
             from cylon_tpu.utils.logging import get_logger
 
@@ -115,6 +137,23 @@ class TraceRecorder:
     def events(self) -> list:
         with self._lock:
             return list(self._buf)
+
+    def since(self, cursor: int = 0) -> dict:
+        """Events with ``seq > cursor`` plus the cursor to resume from
+        and how many matching events the ring already evicted — the
+        same cursor/gap discipline as
+        :meth:`telemetry.events.EventJournal.since`, so the
+        ``/trace?since=`` consumer (the fleet router's poll loop) can
+        fall behind without silently losing spans."""
+        cursor = int(cursor)
+        with self._lock:
+            evts = [e for e in self._buf if e.get("seq", 0) > cursor]
+            seq = self._appended
+        oldest_held = evts[0]["seq"] if evts else seq + 1
+        # everything in (cursor, oldest_held) was evicted before read
+        dropped = max(oldest_held - cursor - 1, 0)
+        return {"events": evts, "cursor": seq, "dropped": dropped,
+                "armed": True}
 
     def dropped(self) -> int:
         """Events evicted by the ring bound (total appended - held)."""
@@ -136,6 +175,51 @@ _RECORDER: "TraceRecorder | None" = None
 #: ``contextvars.copy_context``)
 _STACK: contextvars.ContextVar = contextvars.ContextVar(
     "cylon_trace_stack", default=())
+
+#: ambient distributed-trace context: ``(trace_id, parent_span)`` — the
+#: id minted at the fleet request's outermost entry plus the span id on
+#: the other side of the process hop. None outside any scope; entered
+#: only on armed paths, so the unarmed world never touches it.
+_TRACE_CTX: contextvars.ContextVar = contextvars.ContextVar(
+    "cylon_trace_ctx", default=None)
+
+
+def new_trace_id() -> str:
+    """Mint one fleet-unique trace id (64 random bits, hex — short
+    enough for a header, long enough that ids never collide across a
+    bench run's worth of requests)."""
+    return uuid.uuid4().hex[:16]
+
+
+def current_trace_id() -> "str | None":
+    """The ambient trace id (None outside any :func:`trace_context`)."""
+    c = _TRACE_CTX.get()
+    return c[0] if c is not None else None
+
+
+def current_parent_span():
+    """The cross-process parent span id carried by the ambient
+    context (None outside any scope or when the hop carried none)."""
+    c = _TRACE_CTX.get()
+    return c[1] if c is not None else None
+
+
+@contextlib.contextmanager
+def trace_context(trace_id: "str | None", parent_span=None):
+    """Ambient distributed-trace scope: every armed event emitted
+    inside is stamped with ``trace_id`` (and begin/instant events with
+    no LOCAL parent span link to ``parent_span`` — the span id on the
+    other side of the process hop — via ``parent_span``). A None
+    ``trace_id`` makes the whole scope a no-op, so call sites can pass
+    an unstamped request straight through."""
+    if trace_id is None:
+        yield
+        return
+    tok = _TRACE_CTX.set((str(trace_id), parent_span))
+    try:
+        yield
+    finally:
+        _TRACE_CTX.reset(tok)
 
 
 def _rec() -> TraceRecorder:
@@ -162,13 +246,23 @@ def now() -> "float | None":
 def _stamp_tenant(evt: dict) -> None:
     """Attach the ambient tenant attribution
     (:func:`cylon_tpu.telemetry.tenant_scope`) as a top-level
-    ``"tenant"`` key — only when a scope is active, so events outside
-    the serving layer keep their historical shape. Reached only on the
-    armed path (emitters return before it when tracing is off), so the
-    off-path cost stays one env read."""
+    ``"tenant"`` key and the ambient distributed-trace context
+    (:func:`trace_context`) as ``"trace_id"`` — only when a scope is
+    active, so events outside the serving layer keep their historical
+    shape. Reached only on the armed path (emitters return before it
+    when tracing is off), so the off-path cost stays one env read."""
     t = _current_tenant()
     if t is not None:
         evt["tenant"] = t
+    c = _TRACE_CTX.get()
+    if c is not None:
+        evt["trace_id"] = c[0]
+        if (c[1] is not None and evt.get("parent") is None
+                and evt.get("kind") in ("begin", "instant")):
+            # first span/instant after a process hop: link back to the
+            # span on the sending side (ids are per-process counters,
+            # so the link is advisory — the trace_id is the chain)
+            evt["parent_span"] = c[1]
 
 
 # ------------------------------------------------------------- emitters
@@ -266,6 +360,16 @@ def events() -> list:
     return _RECORDER.events() if _RECORDER is not None else []
 
 
+def since(cursor: int = 0) -> dict:
+    """The ``/trace?since=`` payload (cursored segment + eviction gap,
+    same discipline as ``events.since``). When the recorder was never
+    armed, says so instead of returning a deceptively empty stream."""
+    if _RECORDER is None:
+        return {"events": [], "cursor": int(cursor), "dropped": 0,
+                "armed": enabled()}
+    return _RECORDER.since(cursor)
+
+
 def dropped() -> int:
     return _RECORDER.dropped() if _RECORDER is not None else 0
 
@@ -333,11 +437,22 @@ def merge_timelines(buffers) -> list:
     subtracting the buffer's ``clock_offset`` — after the shift,
     same-instant events across hosts line up to within the barrier
     jitter of the offset estimate (see ``CylonEnv.clock_offset``).
+
+    Process tracks (ISSUE 20): a buffer may carry a ``proc`` name (a
+    fleet router or engine process — ``clock_offset`` then comes from
+    the router's ping handshake, not a barrier). The proc name becomes
+    the timeline's track key (each event's ``rank`` AND ``proc``), so
+    :func:`critical_path` / ``straggler_report`` attribute per-process
+    exactly as they attribute per-rank. Do not mix named-proc and
+    integer-rank buffers in one merge — track keys must stay
+    comparably typed.
     """
     merged = []
     for buf in buffers:
+        proc = None
         if isinstance(buf, dict):
             rank = buf.get("rank", 0)
+            proc = buf.get("proc")
             off = float(buf.get("clock_offset", 0.0) or 0.0)
             evts = buf.get("events", [])
         else:
@@ -345,11 +460,96 @@ def merge_timelines(buffers) -> list:
             off = 0.0
         for e in evts:
             e = dict(e)
-            e["rank"] = rank
+            e["rank"] = proc if proc is not None else rank
+            if proc is not None:
+                e["proc"] = proc
             e["ts"] = e["ts"] - off
             merged.append(e)
     merged.sort(key=lambda e: e["ts"])
     return merged
+
+
+def request_timeline(merged, trace_id: str) -> list:
+    """The slice of a merged timeline belonging to ONE distributed
+    request: events stamped with ``trace_id`` directly, plus end
+    events and children whose begin/parent was stamped (end events
+    carry no ambient stamps — they follow their begin's verdict, the
+    same track-namespaced id discipline as :func:`filter_tenant`)."""
+    tid = str(trace_id)
+    keep_ids: set = set()
+    out = []
+    for e in merged:
+        rank = e.get("rank")
+        mine = e.get("trace_id") == tid
+        if not mine and e.get("kind") == "end":
+            mine = (rank, e.get("id")) in keep_ids
+        if not mine and e.get("parent") is not None:
+            mine = (rank, e["parent"]) in keep_ids
+        if mine:
+            if e.get("kind") == "begin":
+                keep_ids.add((rank, e.get("id")))
+            out.append(e)
+    return out
+
+
+def fleet_request_report(merged, trace_id: str) -> dict:
+    """Causal phase attribution for one fleet request across process
+    tracks: where did its wall go — router queue, engine queue,
+    dispatch steps, replay hops?
+
+    Reads the spans the serve/fleet layers emit under the request's
+    :func:`trace_context`: the router's ``fleet.submit`` span, each
+    engine's ``serve.admit`` instant and ``serve.step`` spans, and
+    ``fleet.replay_hop`` instants (a failover re-running the request
+    on a surviving peer under the ORIGINAL trace id). Returns::
+
+        {"trace_id", "procs",            # tracks the request touched
+         "spans": <matched span count>,
+         "events": <total>,
+         "monotone": bool,               # causally ordered post-merge
+         "replay_hops": [{"engine", "ts"}, ...],
+         "phases": {"router_queue_s",    # router admit -> engine admit
+                    "engine_queue_s": {proc: s},   # admit -> 1st step
+                    "dispatch_s": {proc: s}}}      # sum of step spans
+    """
+    evts = request_timeline(merged, trace_id)
+    by_track: "dict[object, list]" = {}
+    for e in evts:
+        by_track.setdefault(e.get("rank"), []).append(e)
+    procs = sorted(str(k) for k in by_track)
+    monotone = all(a["ts"] <= b["ts"] for a, b in zip(evts, evts[1:]))
+    replay_hops = [{"engine": e.get("args", {}).get("engine"),
+                    "ts": e["ts"]}
+                   for e in evts if e.get("name") == "fleet.replay_hop"]
+    submit_ts = min((e["ts"] for e in evts
+                     if e.get("name") == "fleet.submit"
+                     and e.get("kind") == "begin"), default=None)
+    engine_queue: "dict[str, float]" = {}
+    dispatch: "dict[str, float]" = {}
+    first_admit = None
+    spans = 0
+    for track, tevts in by_track.items():
+        admits = [e["ts"] for e in tevts
+                  if e.get("name") == "serve.admit"]
+        steps = [(b, d) for b, d in _matched_spans(tevts)
+                 if b.get("name") == "serve.step"]
+        spans += len(_matched_spans(tevts))
+        if admits and (first_admit is None
+                       or admits[0] < first_admit):
+            first_admit = admits[0]
+        if admits and steps:
+            engine_queue[str(track)] = max(
+                min(b["ts"] for b, _ in steps) - admits[0], 0.0)
+        if steps:
+            dispatch[str(track)] = sum(d for _, d in steps)
+    phases: dict = {"engine_queue_s": engine_queue,
+                    "dispatch_s": dispatch}
+    phases["router_queue_s"] = (
+        max(first_admit - submit_ts, 0.0)
+        if submit_ts is not None and first_admit is not None else None)
+    return {"trace_id": str(trace_id), "procs": procs, "spans": spans,
+            "events": len(evts), "monotone": monotone,
+            "replay_hops": replay_hops, "phases": phases}
 
 
 def _matched_spans(evts):

@@ -1053,3 +1053,114 @@ def test_views_endpoint_routed_through_introspect():
     from cylon_tpu.serve import introspect
 
     assert "/views" in introspect.ENDPOINTS
+
+
+# ------------------------------------------- fleet-trace guards
+def test_fleet_trace_record_schema_pinned():
+    """ISSUE 20 satellite: the --fleet-trace record must pin the
+    stitched-artifact surface — where the Chrome trace landed, the
+    span and engine-track counts, the clock-handshake jitter bound and
+    the replay-hop count — and main() asserts the set before
+    emitting."""
+    from cylon_tpu.serve.bench import REQUIRED_FLEET_TRACE_FIELDS
+
+    assert REQUIRED_FLEET_TRACE_FIELDS == frozenset({
+        "trace_path", "spans", "engines_stitched", "offset_jitter_s",
+        "replay_hops"})
+    src = (REPO / "cylon_tpu" / "serve" / "bench.py").read_text()
+    assert "REQUIRED_FLEET_TRACE_FIELDS - record.keys()" in src
+
+
+def test_trace_endpoint_routed_through_introspect():
+    """ISSUE 20 satellite: /trace rides the SAME statically read-only
+    introspection surface as /events — advertised in ENDPOINTS and
+    dispatched inside introspect._route, so the mutating-call lint
+    above covers it by construction and it can never quietly move to
+    a writable port."""
+    from cylon_tpu.serve import introspect
+
+    assert "/trace" in introspect.ENDPOINTS
+    path = REPO / "cylon_tpu" / "serve" / "introspect.py"
+    tree = ast.parse(path.read_text(), filename=str(path))
+    route_fn = next(n for n in ast.walk(tree)
+                    if isinstance(n, _FN) and n.name == "_route")
+    routed = {n.value for n in ast.walk(route_fn)
+              if isinstance(n, ast.Constant)
+              and isinstance(n.value, str) and n.value.startswith("/")}
+    assert "/trace" in routed, "/trace not dispatched inside _route"
+
+
+def test_dedup_event_kinds_registered_and_emitted():
+    """ISSUE 20 satellite (extends the literal-emit lint): the PR 19
+    dedup-plane outcomes — cache_hit, coalesced, batch_retire — and
+    the router's events_gap are in the typed schema AND wired at their
+    owning call sites (service.py for the engine-side three, fleet.py
+    for the gap counter)."""
+    from cylon_tpu.telemetry.events import EVENT_KINDS
+
+    assert {"cache_hit", "coalesced", "batch_retire",
+            "events_gap"} <= set(EVENT_KINDS)
+    by_kind: dict = {}
+    for p, _, k in _emit_call_kinds():
+        by_kind.setdefault(k, set()).add(p)
+    for kind in ("cache_hit", "coalesced", "batch_retire"):
+        assert "cylon_tpu/serve/service.py" in by_kind.get(kind, set()), (
+            f"{kind} is registered but never emitted from the serve "
+            "engine")
+    assert "cylon_tpu/serve/fleet.py" in by_kind.get("events_gap",
+                                                     set())
+
+
+def _class_method(tree: ast.Module, cls: str, meth: str):
+    cnode = next(n for n in ast.walk(tree)
+                 if isinstance(n, ast.ClassDef) and n.name == cls)
+    return next(n for n in ast.iter_child_nodes(cnode)
+                if isinstance(n, _FN) and n.name == meth)
+
+
+def _string_constants(fn) -> set:
+    return {n.value for n in ast.walk(fn)
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)}
+
+
+def test_every_fleet_submit_path_stamps_trace_context():
+    """ISSUE 20 satellite (CI lint): each hop of a fleet request's
+    admission chain must carry the trace context — the gateway's POST
+    handler reads the X-Cylon-Trace-Id header into submit_named's
+    control kwargs, the router's submit mints the id and opens the
+    fleet.submit span, and the failover replay re-enters the ORIGINAL
+    id with a fleet.replay_hop marker. A future submit path added
+    without these would produce requests that silently vanish from
+    stitched timelines."""
+    path = REPO / "cylon_tpu" / "serve" / "fleet.py"
+    tree = ast.parse(path.read_text(), filename=str(path))
+
+    post = _class_method(tree, "EngineGateway", "_post")
+    assert "X-Cylon-Trace-Id" in _string_constants(post), (
+        "EngineGateway._post no longer reads the trace header")
+    assert "_trace_id" in _string_constants(post) \
+        or "_trace_id" in {kw.arg for n in ast.walk(post)
+                           if isinstance(n, ast.Call)
+                           for kw in n.keywords}, (
+        "EngineGateway._post no longer forwards _trace_id to "
+        "submit_named")
+
+    submit = _class_method(tree, "FleetRouter", "submit")
+    refs = _fn_references(submit)
+    assert {"new_trace_id", "trace_context"} <= refs, (
+        "FleetRouter.submit no longer mints/enters the trace context")
+    assert "fleet.submit" in _string_constants(submit), (
+        "FleetRouter.submit no longer opens the fleet.submit span")
+
+    replay = _class_method(tree, "FleetRouter", "_replay_journal")
+    assert "trace_context" in _fn_references(replay), (
+        "_replay_journal no longer re-enters the original trace id")
+    assert "fleet.replay_hop" in _string_constants(replay), (
+        "_replay_journal no longer marks the replay hop")
+
+    # and the engine side accepts the propagated context as control
+    # kwargs (stripped before fingerprinting)
+    from cylon_tpu.serve.service import ServeEngine
+
+    assert {"_trace_id",
+            "_parent_span"} <= set(ServeEngine._CONTROL_KW)
